@@ -1,0 +1,80 @@
+"""Scenario runner facade (reference: ``python/fedml/runner.py:14-123``).
+
+Chooses the scenario runtime from ``args.training_type`` / ``args.backend`` /
+``args.role`` and accepts custom ``ClientTrainer`` / ``ServerAggregator``
+override points, exactly like the reference's FedMLRunner.
+"""
+
+from __future__ import annotations
+
+from . import constants
+
+
+class FedMLRunner:
+    def __init__(
+        self,
+        args,
+        device,
+        dataset,
+        model,
+        client_trainer=None,
+        server_aggregator=None,
+    ):
+        self.args = args
+        if args.training_type == constants.FEDML_TRAINING_PLATFORM_SIMULATION:
+            self.runner = self._init_simulation_runner(
+                args, device, dataset, model, client_trainer, server_aggregator
+            )
+        elif args.training_type == constants.FEDML_TRAINING_PLATFORM_CROSS_SILO:
+            self.runner = self._init_cross_silo_runner(
+                args, device, dataset, model, client_trainer, server_aggregator
+            )
+        elif args.training_type == constants.FEDML_TRAINING_PLATFORM_DISTRIBUTED:
+            self.runner = self._init_distributed_runner(args, device, dataset, model)
+        elif args.training_type == constants.FEDML_TRAINING_PLATFORM_CROSS_DEVICE:
+            self.runner = self._init_cross_device_runner(
+                args, device, dataset, model, server_aggregator
+            )
+        else:
+            raise ValueError(f"unsupported training_type {args.training_type!r}")
+
+    @staticmethod
+    def _init_simulation_runner(
+        args, device, dataset, model, client_trainer, server_aggregator
+    ):
+        from .simulation.simulator import SimulatorMesh, SimulatorSingleProcess
+
+        if args.backend == constants.FEDML_SIMULATION_TYPE_SP:
+            return SimulatorSingleProcess(
+                args, device, dataset, model, client_trainer, server_aggregator
+            )
+        if args.backend == constants.FEDML_SIMULATION_TYPE_MESH:
+            return SimulatorMesh(
+                args, device, dataset, model, client_trainer, server_aggregator
+            )
+        raise ValueError(f"unsupported simulation backend {args.backend!r}")
+
+    @staticmethod
+    def _init_cross_silo_runner(
+        args, device, dataset, model, client_trainer, server_aggregator
+    ):
+        from .cross_silo import FedMLCrossSiloClient, FedMLCrossSiloServer
+
+        if args.role == "server":
+            return FedMLCrossSiloServer(args, device, dataset, model, server_aggregator)
+        return FedMLCrossSiloClient(args, device, dataset, model, client_trainer)
+
+    @staticmethod
+    def _init_distributed_runner(args, device, dataset, model):
+        from .cheetah import CheetahRunner
+
+        return CheetahRunner(args, device, dataset, model)
+
+    @staticmethod
+    def _init_cross_device_runner(args, device, dataset, model, server_aggregator):
+        from .cross_device import ServerMNN
+
+        return ServerMNN(args, device, dataset, model, server_aggregator)
+
+    def run(self):
+        return self.runner.run()

@@ -1,0 +1,13 @@
+from .seed import seed_everything, new_rng  # noqa: F401
+from .tree import (  # noqa: F401
+    tree_flatten_to_vector,
+    tree_unflatten_from_vector,
+    tree_zeros_like,
+    tree_add,
+    tree_sub,
+    tree_scale,
+    tree_dot,
+    tree_l2_norm,
+    tree_cast,
+    global_norm,
+)

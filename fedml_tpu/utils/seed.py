@@ -1,0 +1,25 @@
+"""Deterministic seeding.
+
+The reference seeds python/numpy/torch RNGs globally at init
+(``python/fedml/__init__.py:45-50``). JAX is functional: we seed the host RNGs
+for data partitioning / client sampling and hand out explicit ``PRNGKey``s for
+everything on-device — determinism by construction rather than global state.
+"""
+
+from __future__ import annotations
+
+import random
+
+import jax
+import numpy as np
+
+
+def seed_everything(seed: int) -> jax.Array:
+    """Seed host RNGs and return the root PRNGKey for device-side randomness."""
+    random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+def new_rng(seed: int = 0) -> jax.Array:
+    return jax.random.PRNGKey(seed)

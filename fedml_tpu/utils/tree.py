@@ -1,0 +1,76 @@
+"""Pytree utilities — the framework's equivalent of the reference's
+``python/fedml/utils/model_utils.py`` (named-param flatten/unflatten,
+tensor↔list transforms), re-expressed over JAX pytrees.
+
+Everything here is pure and jit-compatible; these are the primitives the
+aggregation/defense/DP kernels are built from.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+def tree_flatten_to_vector(tree: PyTree) -> Tuple[jax.Array, Any, list]:
+    """Flatten a pytree of arrays into one 1-D vector.
+
+    Returns (vector, treedef, shapes) so the tree can be reconstructed.
+    Replaces model_utils.py's named-param flatten (dict-of-tensors → list).
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    shapes = [l.shape for l in leaves]
+    vec = jnp.concatenate([jnp.ravel(l) for l in leaves]) if leaves else jnp.zeros((0,))
+    return vec, treedef, shapes
+
+
+def tree_unflatten_from_vector(vec: jax.Array, treedef, shapes) -> PyTree:
+    leaves = []
+    offset = 0
+    for shape in shapes:
+        size = 1
+        for s in shape:
+            size *= s
+        leaves.append(jnp.reshape(vec[offset : offset + size], shape))
+        offset += size
+    if offset != vec.size:
+        raise ValueError(
+            f"vector length {vec.size} does not match total leaf size {offset}"
+        )
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def tree_zeros_like(tree: PyTree) -> PyTree:
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def tree_add(a: PyTree, b: PyTree) -> PyTree:
+    return jax.tree.map(jnp.add, a, b)
+
+
+def tree_sub(a: PyTree, b: PyTree) -> PyTree:
+    return jax.tree.map(jnp.subtract, a, b)
+
+
+def tree_scale(tree: PyTree, scalar) -> PyTree:
+    return jax.tree.map(lambda x: x * scalar, tree)
+
+
+def tree_dot(a: PyTree, b: PyTree) -> jax.Array:
+    parts = jax.tree.leaves(jax.tree.map(lambda x, y: jnp.vdot(x, y), a, b))
+    return jnp.sum(jnp.stack(parts)) if parts else jnp.zeros(())
+
+
+def tree_l2_norm(tree: PyTree) -> jax.Array:
+    return jnp.sqrt(tree_dot(tree, tree))
+
+
+global_norm = tree_l2_norm
+
+
+def tree_cast(tree: PyTree, dtype) -> PyTree:
+    return jax.tree.map(lambda x: x.astype(dtype), tree)

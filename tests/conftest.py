@@ -1,0 +1,19 @@
+"""Test bootstrap: force an 8-device virtual CPU platform BEFORE jax backend
+initialisation, so multi-chip sharding paths are exercised without TPU hardware
+(SURVEY.md §4: multi-host emulation via --xla_force_host_platform_device_count).
+
+The environment pins JAX_PLATFORMS=axon (the TPU tunnel), so we must override
+via jax.config, not the env var.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

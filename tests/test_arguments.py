@@ -1,0 +1,54 @@
+import pytest
+
+from fedml_tpu.arguments import Arguments
+from fedml_tpu import constants
+
+
+def test_defaults():
+    args = Arguments()
+    assert args.training_type == constants.FEDML_TRAINING_PLATFORM_SIMULATION
+    assert args.backend == constants.FEDML_SIMULATION_TYPE_SP
+    assert args.client_num_in_total == 10
+
+
+def test_yaml_family_flatten(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        """
+common_args:
+  training_type: "simulation"
+  random_seed: 7
+data_args:
+  dataset: "mnist"
+  batch_size: 16
+train_args:
+  client_num_in_total: 100
+  client_num_per_round: 10
+  comm_round: 3
+  learning_rate: "0.5"
+"""
+    )
+    args = Arguments()
+    args.load_yaml_config(str(cfg))
+    args.validate()
+    assert args.dataset == "mnist"
+    assert args.random_seed == 7
+    assert args.client_num_in_total == 100
+    # typed coercion: "0.5" string -> float
+    assert args.learning_rate == 0.5
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError):
+        Arguments(overrides={"training_type": "nope"})
+    with pytest.raises(ValueError):
+        Arguments(overrides={"client_num_per_round": 20, "client_num_in_total": 5})
+    with pytest.raises(ValueError):
+        Arguments(overrides={"batch_size": 0})
+    with pytest.raises(ValueError):
+        Arguments(overrides={"learning_rate": "abc"})
+
+
+def test_mesh_shape_parse():
+    args = Arguments(overrides={"mesh_shape": "data:2, tensor:4"})
+    assert args.parse_mesh_shape() == {"data": 2, "tensor": 4}

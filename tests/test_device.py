@@ -1,0 +1,31 @@
+import jax
+import pytest
+
+from fedml_tpu import device
+from fedml_tpu.arguments import Arguments
+
+
+def test_virtual_8_devices():
+    assert jax.device_count() == 8
+
+
+def test_build_default_clients_mesh():
+    mesh = device.build_mesh()
+    assert mesh.axis_names == ("clients",)
+    assert mesh.devices.size == 8
+
+
+def test_build_2d_mesh_with_inference():
+    mesh = device.build_mesh({"data": 2, "tensor": -1})
+    assert mesh.devices.shape == (2, 4)
+
+
+def test_mesh_size_mismatch():
+    with pytest.raises(ValueError):
+        device.build_mesh({"data": 3})
+
+
+def test_get_mesh_from_args():
+    args = Arguments(overrides={"mesh_shape": "clients:8"})
+    mesh = device.get_mesh(args)
+    assert mesh.axis_names == ("clients",)
