@@ -1,0 +1,5 @@
+from .aggregate import FedMLAggOperator, stack_trees, unstack_tree, weighted_average  # noqa: F401
+from .partition import (  # noqa: F401
+    homo_partition,
+    non_iid_partition_with_dirichlet_distribution,
+)

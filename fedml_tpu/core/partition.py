@@ -1,0 +1,121 @@
+"""Non-IID data partitioning.
+
+Re-implements the reference's Dirichlet (LDA) partitioner
+(``python/fedml/core/data/noniid_partition.py:6-124``) and the homogeneous
+splitter used by the dataset loaders (``data/cifar10/data_loader.py`` homo
+branch). Host-side numpy: partitioning happens once at load time, device code
+only ever sees the resulting packed arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+
+def non_iid_partition_with_dirichlet_distribution(
+    label_list: np.ndarray,
+    client_num: int,
+    classes: int,
+    alpha: float,
+    task: str = "classification",
+    seed: int = 0,
+) -> Dict[int, np.ndarray]:
+    """Partition sample indices among clients with a per-class Dirichlet draw.
+
+    Reference semantics (noniid_partition.py:6-69): for each class, draw
+    proportions ~ Dir(alpha) over clients, capped so no client exceeds N/num
+    samples on average, and assign that class's (shuffled) indices by the
+    proportions. Smaller alpha → more skew.
+    """
+    rng = np.random.RandomState(seed)
+    net_dataidx_map: Dict[int, List[int]] = {i: [] for i in range(client_num)}
+    idx_batch: List[List[int]] = [[] for _ in range(client_num)]
+    N = label_list.shape[0]
+
+    for k in range(classes):
+        if task == "segmentation":
+            # labels are per-sample sets of present classes
+            idx_k = np.asarray(
+                [i for i, labels in enumerate(label_list) if k in labels]
+            )
+        else:
+            idx_k = np.where(label_list == k)[0]
+        rng.shuffle(idx_k)
+        proportions = rng.dirichlet(np.repeat(alpha, client_num))
+        # cap: clients already at average size get 0 share (reference :101-103)
+        proportions = np.array(
+            [
+                p * (len(idx_j) < N / client_num)
+                for p, idx_j in zip(proportions, idx_batch)
+            ]
+        )
+        s = proportions.sum()
+        if s == 0:
+            proportions = np.repeat(1.0 / client_num, client_num)
+        else:
+            proportions = proportions / s
+        cuts = (np.cumsum(proportions) * len(idx_k)).astype(int)[:-1]
+        for j, split in enumerate(np.split(idx_k, cuts)):
+            idx_batch[j].extend(split.tolist())
+
+    for i in range(client_num):
+        rng.shuffle(idx_batch[i])
+        net_dataidx_map[i] = np.asarray(idx_batch[i], dtype=np.int64)
+    return net_dataidx_map
+
+
+def homo_partition(
+    total_num: int, client_num: int, seed: int = 0
+) -> Dict[int, np.ndarray]:
+    """IID partition: shuffle and split evenly (reference homo branch)."""
+    rng = np.random.RandomState(seed)
+    idxs = rng.permutation(total_num)
+    return {
+        i: np.asarray(part, dtype=np.int64)
+        for i, part in enumerate(np.array_split(idxs, client_num))
+    }
+
+
+def record_data_stats(
+    label_list: np.ndarray, net_dataidx_map: Dict[int, np.ndarray], task="classification"
+) -> Dict[int, Dict[int, int]]:
+    """Per-client class histogram (reference: noniid_partition.py:72-96)."""
+    stats: Dict[int, Dict[int, int]] = {}
+    for client, idxs in net_dataidx_map.items():
+        if task == "segmentation":
+            unq: Dict[int, int] = {}
+            for i in idxs:
+                for c in label_list[i]:
+                    unq[int(c)] = unq.get(int(c), 0) + 1
+        else:
+            vals, counts = np.unique(label_list[idxs], return_counts=True)
+            unq = {int(v): int(c) for v, c in zip(vals, counts)}
+        stats[client] = unq
+    return stats
+
+
+def pack_partitions(
+    data: np.ndarray,
+    labels: np.ndarray,
+    net_dataidx_map: Dict[int, np.ndarray],
+    max_samples: int | None = None,
+):
+    """Pack per-client shards into dense ``[clients, max_samples, ...]`` arrays
+    plus a sample-count vector.
+
+    This is the TPU-native data residency layout (SURVEY.md §7 "Heterogeneous
+    per-client data residency"): static shapes for jit, masks for ragged
+    client sizes; shards then shard directly over a ``clients`` mesh axis.
+    """
+    client_num = len(net_dataidx_map)
+    counts = np.array([len(net_dataidx_map[i]) for i in range(client_num)])
+    cap = int(max_samples or counts.max())
+    x = np.zeros((client_num, cap) + data.shape[1:], dtype=data.dtype)
+    y = np.zeros((client_num, cap) + labels.shape[1:], dtype=labels.dtype)
+    for i in range(client_num):
+        idxs = net_dataidx_map[i][:cap]
+        x[i, : len(idxs)] = data[idxs]
+        y[i, : len(idxs)] = labels[idxs]
+    return x, y, np.minimum(counts, cap)

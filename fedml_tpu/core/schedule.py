@@ -1,0 +1,60 @@
+"""Heterogeneous client workload scheduling.
+
+Re-implements the reference's workload scheduler
+(``python/fedml/core/schedule/scheduler.py:4-183`` — branch-and-bound DP
+assignment of per-client runtimes to devices, with ``np.array_split`` as the
+fallback used by fedavg_seq / the NCCL simulator at
+``simulation/nccl/base_framework/Server.py:124``).
+
+Host-side: schedules are computed between rounds from recorded runtimes, then
+materialised as *padded static-shape* schedule arrays (the trick that survives
+jit — reference precedent ``Server.py:126-128``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+
+def uniform_schedule(client_ids: np.ndarray, num_workers: int) -> List[np.ndarray]:
+    """Fallback: even split (reference fallback np.array_split)."""
+    return [np.asarray(a) for a in np.array_split(client_ids, num_workers)]
+
+
+def lpt_schedule(
+    client_ids: np.ndarray, runtimes: np.ndarray, num_workers: int
+) -> List[np.ndarray]:
+    """Longest-Processing-Time-first makespan minimisation.
+
+    Equivalent role to the reference's branch-and-bound `DP_schedule` (min-max
+    device runtime) with a 4/3-approximation at O(n log n) — appropriate since
+    the reference's exact search call sites are commented out anyway
+    (SURVEY.md §2.4).
+    """
+    order = np.argsort(-np.asarray(runtimes))
+    loads = np.zeros(num_workers)
+    buckets: List[List[int]] = [[] for _ in range(num_workers)]
+    for i in order:
+        j = int(np.argmin(loads))
+        buckets[j].append(int(client_ids[i]))
+        loads[j] += runtimes[i]
+    return [np.asarray(b, dtype=np.int64) for b in buckets]
+
+
+def pad_schedules(
+    schedules: List[np.ndarray], pad_value: int = -1
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad ragged per-worker schedules to ``[workers, max_len]`` + mask.
+
+    Static shape for jit; masked slots are skipped on-device (reference
+    precedent: padded schedule tensors broadcast at Server.py:126-128).
+    """
+    max_len = max((len(s) for s in schedules), default=0)
+    out = np.full((len(schedules), max(max_len, 1)), pad_value, dtype=np.int64)
+    mask = np.zeros_like(out, dtype=np.float32)
+    for i, s in enumerate(schedules):
+        out[i, : len(s)] = s
+        mask[i, : len(s)] = 1.0
+    return out, mask

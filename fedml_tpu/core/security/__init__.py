@@ -1,0 +1,2 @@
+from .attacker import FedMLAttacker  # noqa: F401
+from .defender import FedMLDefender  # noqa: F401

@@ -1,0 +1,77 @@
+"""Attacker manager singleton (reference:
+``python/fedml/core/security/fedml_attacker.py:6-64``): enabled by
+``args.enable_attack``, dispatches on ``args.attack_type``, and exposes hook
+points the simulators call on the stacked client update matrix.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import attacks
+
+
+class FedMLAttacker:
+    _instance = None
+
+    def __init__(self):
+        self.is_enabled = False
+        self.attack_type = ""
+        self.args = None
+
+    @classmethod
+    def get_instance(cls) -> "FedMLAttacker":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def init(self, args) -> None:
+        self.is_enabled = bool(getattr(args, "enable_attack", False))
+        self.attack_type = (getattr(args, "attack_type", "") or "").strip().lower()
+        self.args = args
+
+    def is_model_attack(self) -> bool:
+        return self.is_enabled and self.attack_type in (
+            "byzantine_random",
+            "byzantine_zero",
+            "byzantine_flip",
+            "model_replacement",
+        )
+
+    def is_data_attack(self) -> bool:
+        return self.is_enabled and self.attack_type == "label_flipping"
+
+    def attack_model(
+        self, updates: jax.Array, weights: jax.Array, key: jax.Array, round_idx: int = 0
+    ) -> jax.Array:
+        """Corrupt a fraction of clients' updates (hook: before aggregation)."""
+        if not self.is_model_attack():
+            return updates
+        n = updates.shape[0]
+        frac = float(getattr(self.args, "byzantine_client_frac", 0.2))
+        num_bad = int(round(n * frac))
+        if num_bad == 0:
+            return updates
+        rng = np.random.RandomState(int(getattr(self.args, "random_seed", 0)) + round_idx)
+        mask = np.zeros((n,), np.float32)
+        mask[rng.choice(n, num_bad, replace=False)] = 1.0
+        mask = jnp.asarray(mask)
+        if self.attack_type.startswith("byzantine_"):
+            return attacks.byzantine_attack(
+                updates, mask, key, self.attack_type.split("_", 1)[1]
+            )
+        boost = float(getattr(self.args, "attack_boost", float(n)))
+        global_vec = jnp.average(updates, axis=0, weights=weights)
+        boosted = attacks.model_replacement_scale(updates, global_vec, boost)
+        return updates * (1 - mask[:, None]) + boosted * mask[:, None]
+
+    def attack_data(self, labels: jax.Array) -> jax.Array:
+        if not self.is_data_attack():
+            return labels
+        return attacks.label_flipping(
+            labels,
+            int(getattr(self.args, "original_class", 0)),
+            int(getattr(self.args, "target_class", 1)),
+        )

@@ -1,0 +1,108 @@
+"""Defender manager singleton (reference:
+``python/fedml/core/security/fedml_defender.py:20-78``): enabled by
+``args.enable_defense``, dispatches on ``args.defense_type``, and is called
+from the aggregation hook order on_before_agg → defend → agg → on_after_agg
+(SURVEY.md §7 protocol semantics).
+
+Kernels take the flattened stacked updates ``[n, dim]``; the caller handles
+tree↔vector conversion once per round (utils.tree).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import defenses
+
+DEFENSE_TYPES = (
+    "krum",
+    "multikrum",
+    "geometric_median",
+    "median",
+    "trimmed_mean",
+    "bulyan",
+    "norm_diff_clipping",
+    "cclip",
+    "robust_learning_rate",
+    "weak_dp",
+)
+
+
+class FedMLDefender:
+    _instance = None
+
+    def __init__(self):
+        self.is_enabled = False
+        self.defense_type = ""
+        self.args = None
+
+    @classmethod
+    def get_instance(cls) -> "FedMLDefender":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def init(self, args) -> None:
+        self.is_enabled = bool(getattr(args, "enable_defense", False))
+        self.defense_type = (getattr(args, "defense_type", "") or "").strip().lower()
+        self.args = args
+        if self.is_enabled and self.defense_type not in DEFENSE_TYPES:
+            raise ValueError(
+                f"unknown defense_type {self.defense_type!r}; known: {DEFENSE_TYPES}"
+            )
+
+    def is_defense_enabled(self) -> bool:
+        return self.is_enabled
+
+    def defend(
+        self,
+        updates: jax.Array,
+        weights: jax.Array,
+        global_vec: jax.Array,
+        key: jax.Array,
+    ) -> jax.Array:
+        """Robust-aggregate the stacked updates → one aggregated vector."""
+        a = self.args
+        f = int(getattr(a, "byzantine_client_num", 1))
+        t = self.defense_type
+        if t == "krum":
+            agg, _ = defenses.krum(updates, f, 1)
+            return agg
+        if t == "multikrum":
+            m = int(getattr(a, "krum_param_m", max(updates.shape[0] - f, 1)))
+            return defenses.multikrum_weighted(updates, weights, f, m)
+        if t == "geometric_median":
+            return defenses.geometric_median(updates, weights)
+        if t == "median":
+            return defenses.coordinate_median(updates)
+        if t == "trimmed_mean":
+            return defenses.trimmed_mean(
+                updates, float(getattr(a, "trim_ratio", 0.1))
+            )
+        if t == "bulyan":
+            return defenses.bulyan(updates, f)
+        if t == "norm_diff_clipping":
+            clipped = defenses.norm_diff_clipping(
+                updates, global_vec, float(getattr(a, "norm_bound", 5.0))
+            )
+            w = weights / jnp.sum(weights)
+            return (w[:, None] * clipped).sum(0)
+        if t == "cclip":
+            return defenses.cclip(
+                updates, weights, tau=float(getattr(a, "tau", 10.0))
+            )
+        if t == "robust_learning_rate":
+            return defenses.robust_learning_rate(
+                updates,
+                global_vec,
+                int(getattr(a, "robust_threshold", updates.shape[0] // 2)),
+                float(getattr(a, "server_lr", 1.0)),
+            )
+        if t == "weak_dp":
+            w = weights / jnp.sum(weights)
+            agg = (w[:, None] * updates).sum(0)
+            return defenses.weak_dp(
+                agg, key, float(getattr(a, "stddev", 0.002))
+            )
+        raise ValueError(f"unknown defense_type {t!r}")

@@ -1,0 +1,169 @@
+"""Robust-aggregation defense kernels.
+
+Re-founds the reference's defense suite (``python/fedml/core/security/defense/``:
+Krum/Multi-Krum ``krum_defense.py:13-40``, geometric median, Bulyan, CClip,
+SLSGD trimmed mean, robust learning rate, norm-diff clipping, weak DP) as pure
+JAX kernels over a **stacked client matrix** ``updates [n_clients, dim]`` plus
+``weights [n_clients]``.
+
+TPU-first design: Krum's pairwise distance matrix is one Gram matmul (MXU)
+instead of the reference's O(n²) Python double loop; medians/sorts ride the
+VPU; everything is jit-compatible with static shapes (k, byzantine counts are
+static Python ints).
+
+Uniform contract mirroring the reference's ``run(raw_client_grad_list,
+base_aggregation_func, extra_auxiliary_info)``: each kernel either reweights
+clients (returns new weights) or directly returns the aggregate vector.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def pairwise_sq_dists(updates: jax.Array) -> jax.Array:
+    """[n, n] squared euclidean distances via one Gram matmul."""
+    sq = jnp.sum(updates * updates, axis=1)
+    gram = updates @ updates.T
+    d = sq[:, None] + sq[None, :] - 2.0 * gram
+    return jnp.maximum(d, 0.0)
+
+
+def krum_scores(updates: jax.Array, byzantine_count: int) -> jax.Array:
+    """Krum score per client: sum of its n-f-2 smallest distances to others
+    (reference: krum_defense.py:25-40, `_compute_krum_score`)."""
+    n = updates.shape[0]
+    d = pairwise_sq_dists(updates)
+    d = d + jnp.diag(jnp.full((n,), jnp.inf, d.dtype))  # exclude self
+    k = max(n - byzantine_count - 2, 1)
+    neg_topk, _ = jax.lax.top_k(-d, k)  # k smallest distances
+    return jnp.sum(-neg_topk, axis=1)
+
+
+def krum(
+    updates: jax.Array, byzantine_count: int, krum_param_m: int = 1
+) -> Tuple[jax.Array, jax.Array]:
+    """(Multi-)Krum: select the m lowest-score clients; return (aggregate,
+    selection mask). m=1 → Krum, m>1 → Multi-Krum averaging the selected."""
+    scores = krum_scores(updates, byzantine_count)
+    _, sel = jax.lax.top_k(-scores, krum_param_m)
+    mask = jnp.zeros((updates.shape[0],)).at[sel].set(1.0)
+    agg = jnp.mean(updates[sel], axis=0)
+    return agg, mask
+
+
+def geometric_median(
+    updates: jax.Array, weights: jax.Array, iters: int = 10, eps: float = 1e-8
+) -> jax.Array:
+    """Weighted geometric median by Weiszfeld iteration
+    (reference: geometric_median_defense.py). Fixed iteration count → static
+    control flow under jit (lax.fori_loop)."""
+    w = weights / jnp.sum(weights)
+
+    def body(_, z):
+        dist = jnp.linalg.norm(updates - z[None, :], axis=1)
+        inv = w / jnp.maximum(dist, eps)
+        return (inv[:, None] * updates).sum(0) / jnp.sum(inv)
+
+    z0 = (w[:, None] * updates).sum(0)
+    return jax.lax.fori_loop(0, iters, body, z0)
+
+
+def coordinate_median(updates: jax.Array) -> jax.Array:
+    """Coordinate-wise median (building block for Bulyan)."""
+    return jnp.median(updates, axis=0)
+
+
+def trimmed_mean(updates: jax.Array, trim_ratio: float) -> jax.Array:
+    """Coordinate-wise trimmed mean (reference: slsgd_defense.py 'option 2',
+    drop b largest and b smallest per coordinate)."""
+    n = updates.shape[0]
+    b = int(n * trim_ratio)
+    if 2 * b >= n:
+        raise ValueError(f"trim_ratio {trim_ratio} removes all {n} clients")
+    s = jnp.sort(updates, axis=0)
+    return jnp.mean(s[b : n - b], axis=0)
+
+
+def bulyan(updates: jax.Array, byzantine_count: int) -> jax.Array:
+    """Bulyan (reference: bulyan_defense.py): iteratively Multi-Krum-select
+    theta = n - 2f clients, then coordinate-wise trimmed mean around the
+    median of the selected set."""
+    n = updates.shape[0]
+    f = byzantine_count
+    theta = max(n - 2 * f, 1)
+    scores = krum_scores(updates, f)
+    _, sel = jax.lax.top_k(-scores, theta)
+    selected = updates[sel]
+    beta = max(theta - 2 * f, 1)
+    med = jnp.median(selected, axis=0)
+    dist = jnp.abs(selected - med[None, :])
+    # beta closest-to-median values per coordinate
+    idx = jnp.argsort(dist, axis=0)[:beta]
+    closest = jnp.take_along_axis(selected, idx, axis=0)
+    return jnp.mean(closest, axis=0)
+
+
+def norm_diff_clipping(
+    updates: jax.Array, global_vec: jax.Array, norm_bound: float
+) -> jax.Array:
+    """Clip each client's delta from the global model to an L2 ball
+    (reference: norm_diff_clipping_defense.py)."""
+    delta = updates - global_vec[None, :]
+    norms = jnp.linalg.norm(delta, axis=1, keepdims=True)
+    factor = jnp.minimum(1.0, norm_bound / jnp.maximum(norms, 1e-12))
+    return global_vec[None, :] + delta * factor
+
+
+def cclip(
+    updates: jax.Array,
+    weights: jax.Array,
+    tau: float = 10.0,
+    iters: int = 3,
+) -> jax.Array:
+    """Centered clipping (reference: cclip_defense.py): iteratively move a
+    center v by clipped client deviations."""
+    w = weights / jnp.sum(weights)
+
+    def body(_, v):
+        delta = updates - v[None, :]
+        norms = jnp.linalg.norm(delta, axis=1, keepdims=True)
+        factor = jnp.minimum(1.0, tau / jnp.maximum(norms, 1e-12))
+        return v + (w[:, None] * delta * factor).sum(0)
+
+    v0 = (w[:, None] * updates).sum(0)
+    return jax.lax.fori_loop(0, iters, body, v0)
+
+
+def robust_learning_rate(
+    updates: jax.Array, global_vec: jax.Array, threshold: int, server_lr: float = 1.0
+) -> jax.Array:
+    """Sign-vote robust LR (reference: robust_learning_rate_defense.py):
+    per-coordinate, if |sum of client update signs| < threshold flip the lr."""
+    delta = updates - global_vec[None, :]
+    sign_sum = jnp.abs(jnp.sum(jnp.sign(delta), axis=0))
+    lr = jnp.where(sign_sum >= threshold, server_lr, -server_lr)
+    return global_vec + lr * jnp.mean(delta, axis=0)
+
+
+def weak_dp(
+    aggregate: jax.Array, key: jax.Array, stddev: float = 0.002
+) -> jax.Array:
+    """Add small Gaussian noise to the aggregate (reference:
+    weak_dp_defense.py)."""
+    return aggregate + stddev * jax.random.normal(key, aggregate.shape, aggregate.dtype)
+
+
+def multikrum_weighted(
+    updates: jax.Array, weights: jax.Array, byzantine_count: int, m: int
+) -> jax.Array:
+    """Multi-Krum then weighted average of the survivors (reference
+    krum_defense.py:20-23 averages selected with sample weights)."""
+    scores = krum_scores(updates, byzantine_count)
+    _, sel = jax.lax.top_k(-scores, m)
+    w = weights[sel]
+    w = w / jnp.sum(w)
+    return (w[:, None] * updates[sel]).sum(0)

@@ -1,0 +1,102 @@
+"""Topology managers for decentralized FL.
+
+Re-implements the reference's ``python/fedml/core/distributed/topology/``
+(``BaseTopologyManager`` abstract at base_topology_manager.py:4-22,
+``SymmetricTopologyManager`` ring-with-neighbors at
+symmetric_topology_manager.py:7-80, ``AsymmetricTopologyManager`` directed
+graphs at asymmetric_topology_manager.py:7-108).
+
+The topology is exported as a dense row-stochastic mixing matrix ``W [n, n]``
+— the TPU-native representation: one gossip round for all nodes is then a
+single matmul ``W @ params_stack`` on the MXU (instead of per-node neighbor
+message loops).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import List
+
+import numpy as np
+
+
+class BaseTopologyManager(ABC):
+    @abstractmethod
+    def generate_topology(self) -> None: ...
+
+    @abstractmethod
+    def get_in_neighbor_idx_list(self, index: int) -> List[int]: ...
+
+    @abstractmethod
+    def get_out_neighbor_idx_list(self, index: int) -> List[int]: ...
+
+    def get_in_neighbor_weights(self, index: int) -> np.ndarray:
+        return self.topology[index]
+
+    def get_out_neighbor_weights(self, index: int) -> np.ndarray:
+        return self.topology[:, index]
+
+    def mixing_matrix(self) -> np.ndarray:
+        """Row-stochastic W for one-matmul gossip."""
+        return np.asarray(self.topology, dtype=np.float32)
+
+
+class SymmetricTopologyManager(BaseTopologyManager):
+    """Ring with `neighbor_num` symmetric neighbors, uniform weights
+    (reference: symmetric_topology_manager.py — ring + random undirected
+    edges, here deterministic ring-k for reproducibility)."""
+
+    def __init__(self, n: int, neighbor_num: int = 2):
+        if neighbor_num % 2 != 0:
+            raise ValueError("neighbor_num must be even (k/2 each side of ring)")
+        self.n = n
+        self.neighbor_num = neighbor_num
+        self.topology = np.zeros((n, n), dtype=np.float32)
+
+    def generate_topology(self) -> None:
+        n, k = self.n, self.neighbor_num
+        A = np.eye(n, dtype=np.float32)
+        # offsets beyond n//2 wrap onto already-set edges; capping keeps the
+        # requested degree meaningful for small rings (n=2 still mixes)
+        for off in range(1, min(k // 2, n // 2) + 1):
+            for i in range(n):
+                A[i, (i + off) % n] = 1.0
+                A[i, (i - off) % n] = 1.0
+        self.topology = A / A.sum(axis=1, keepdims=True)
+
+    def get_in_neighbor_idx_list(self, index: int) -> List[int]:
+        return [j for j in range(self.n) if self.topology[index, j] > 0 and j != index]
+
+    def get_out_neighbor_idx_list(self, index: int) -> List[int]:
+        return [j for j in range(self.n) if self.topology[j, index] > 0 and j != index]
+
+
+class AsymmetricTopologyManager(BaseTopologyManager):
+    """Directed ring + random extra out-edges (reference:
+    asymmetric_topology_manager.py)."""
+
+    def __init__(self, n: int, out_neighbor_num: int = 2, seed: int = 0):
+        self.n = n
+        self.out_neighbor_num = min(out_neighbor_num, n - 1)
+        self.seed = seed
+        self.topology = np.zeros((n, n), dtype=np.float32)
+
+    def generate_topology(self) -> None:
+        rng = np.random.RandomState(self.seed)
+        n = self.n
+        A = np.eye(n, dtype=np.float32)
+        for i in range(n):
+            ring = (i + 1) % n
+            A[i, ring] = 1.0  # directed ring
+            pool = [j for j in range(n) if j != i and j != ring]
+            extra = rng.choice(
+                pool, min(self.out_neighbor_num - 1, len(pool)), replace=False
+            )
+            A[i, extra] = 1.0
+        self.topology = A / A.sum(axis=1, keepdims=True)
+
+    def get_in_neighbor_idx_list(self, index: int) -> List[int]:
+        return [j for j in range(self.n) if self.topology[index, j] > 0 and j != index]
+
+    def get_out_neighbor_idx_list(self, index: int) -> List[int]:
+        return [j for j in range(self.n) if self.topology[j, index] > 0 and j != index]

@@ -1,0 +1,179 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.core import aggregate, compression, dp, partition, schedule, topology
+
+
+# ---------------- aggregation ----------------
+def test_weighted_average_matches_manual():
+    trees = [
+        {"w": jnp.full((3,), 1.0), "b": jnp.ones(())},
+        {"w": jnp.full((3,), 2.0), "b": jnp.zeros(())},
+    ]
+    stacked = aggregate.stack_trees(trees)
+    agg = aggregate.weighted_average(stacked, jnp.array([1.0, 3.0]))
+    np.testing.assert_allclose(agg["w"], np.full((3,), 1.75), rtol=1e-6)
+    np.testing.assert_allclose(agg["b"], 0.25, rtol=1e-6)
+
+
+def test_masked_weighted_average_ignores_padding():
+    stacked = {"w": jnp.array([[1.0], [2.0], [99.0]])}
+    agg = aggregate.masked_weighted_average(
+        stacked, jnp.array([1.0, 1.0, 5.0]), jnp.array([1.0, 1.0, 0.0])
+    )
+    np.testing.assert_allclose(agg["w"], [1.5])
+
+
+def test_stack_unstack_roundtrip():
+    trees = [{"a": jnp.arange(4.0)}, {"a": jnp.arange(4.0) + 10}]
+    stacked = aggregate.stack_trees(trees)
+    back = aggregate.unstack_tree(stacked, 2)
+    np.testing.assert_allclose(back[1]["a"], trees[1]["a"])
+
+
+# ---------------- partition ----------------
+def test_dirichlet_partition_covers_all_samples():
+    labels = np.random.RandomState(0).randint(0, 10, size=1000)
+    m = partition.non_iid_partition_with_dirichlet_distribution(labels, 7, 10, 0.5)
+    all_idx = np.concatenate([m[i] for i in range(7)])
+    assert sorted(all_idx.tolist()) == list(range(1000))
+
+
+def test_dirichlet_skew_increases_with_small_alpha():
+    labels = np.random.RandomState(0).randint(0, 10, size=2000)
+    m_skew = partition.non_iid_partition_with_dirichlet_distribution(
+        labels, 5, 10, 0.05, seed=1
+    )
+    stats = partition.record_data_stats(labels, m_skew)
+    # with heavy skew, some client should be missing several classes
+    missing = [10 - len(stats[i]) for i in range(5)]
+    assert max(missing) >= 1
+
+
+def test_homo_partition_even():
+    m = partition.homo_partition(100, 4)
+    sizes = [len(m[i]) for i in range(4)]
+    assert sizes == [25, 25, 25, 25]
+
+
+def test_pack_partitions_shapes_and_mask():
+    data = np.arange(20, dtype=np.float32).reshape(10, 2)
+    labels = np.arange(10)
+    m = {0: np.array([0, 1, 2]), 1: np.array([3, 4])}
+    x, y, counts = partition.pack_partitions(data, labels, m)
+    assert x.shape == (2, 3, 2)
+    assert counts.tolist() == [3, 2]
+    np.testing.assert_allclose(x[1, 2], 0)  # padded slot zeroed
+
+
+# ---------------- dp ----------------
+def test_gaussian_mechanism_noise_scale():
+    mech = dp.GaussianMechanism(epsilon=1.0, delta=1e-5, sensitivity=1.0)
+    tree = {"w": jnp.zeros((20000,))}
+    noised = mech.add_noise(tree, jax.random.PRNGKey(0))
+    emp = jnp.std(noised["w"])
+    assert abs(float(emp) - mech.sigma) / mech.sigma < 0.05
+
+
+def test_laplace_mechanism_changes_values():
+    mech = dp.LaplaceMechanism(epsilon=0.5)
+    tree = {"w": jnp.ones((100,))}
+    noised = mech.add_noise(tree, jax.random.PRNGKey(1))
+    assert not np.allclose(noised["w"], 1.0)
+
+
+def test_clip_by_global_norm():
+    tree = {"a": jnp.full((4,), 3.0)}  # norm 6
+    clipped = dp.clip_tree_by_global_norm(tree, 1.0)
+    total = jnp.sqrt(sum(jnp.sum(x**2) for x in jax.tree.leaves(clipped)))
+    np.testing.assert_allclose(float(total), 1.0, rtol=1e-5)
+
+
+def test_fed_privacy_mechanism_dispatch():
+    m = dp.FedPrivacyMechanism(1.0, mechanism_type="gaussian", dp_type="ldp")
+    out = m.randomize({"w": jnp.zeros((10,))}, jax.random.PRNGKey(0))
+    assert out["w"].shape == (10,)
+    with pytest.raises(ValueError):
+        dp.FedPrivacyMechanism(1.0, mechanism_type="nope")
+
+
+# ---------------- compression ----------------
+def test_topk_roundtrip_keeps_largest():
+    vec = jnp.array([0.1, -5.0, 0.2, 3.0, -0.05])
+    payload = compression.topk_compress(vec, 2)
+    dec = compression.topk_decompress(payload)
+    np.testing.assert_allclose(dec, [0, -5.0, 0, 3.0, 0])
+
+
+def test_ef_topk_carries_residual():
+    vec = jnp.array([1.0, 2.0, 3.0])
+    payload, res = compression.ef_topk_compress(vec, jnp.zeros(3), 1)
+    np.testing.assert_allclose(res, [1.0, 2.0, 0.0])
+    # next round: residual compensates
+    payload2, res2 = compression.ef_topk_compress(jnp.zeros(3), res, 1)
+    np.testing.assert_allclose(compression.topk_decompress(payload2), [0, 2.0, 0])
+
+
+def test_qsgd_unbiased():
+    vec = jnp.linspace(-1, 1, 64)
+    keys = jax.random.split(jax.random.PRNGKey(0), 200)
+    decs = jax.vmap(
+        lambda k: compression.qsgd_decompress(compression.qsgd_compress(vec, k, s=16))
+    )(keys)
+    np.testing.assert_allclose(decs.mean(0), vec, atol=0.02)
+
+
+def test_uniform_quantize_roundtrip():
+    vec = jnp.linspace(-2, 5, 100)
+    p = compression.uniform_quantize(vec, bits=8)
+    dec = compression.uniform_dequantize(p)
+    assert float(jnp.max(jnp.abs(dec - vec))) < (7.0 / 255) + 1e-6
+
+
+# ---------------- schedule ----------------
+def test_lpt_schedule_balances_makespan():
+    ids = np.arange(6)
+    runtimes = np.array([10.0, 9, 8, 1, 1, 1])
+    buckets = schedule.lpt_schedule(ids, runtimes, 3)
+    loads = [float(runtimes[b].sum()) for b in buckets]
+    assert max(loads) <= 12  # LPT: 10+1, 9+1, 8+1
+    assert sorted(np.concatenate(buckets).tolist()) == ids.tolist()
+
+
+def test_pad_schedules_static_shape():
+    padded, mask = schedule.pad_schedules([np.array([1, 2, 3]), np.array([4])])
+    assert padded.shape == (2, 3)
+    assert mask.sum() == 4
+
+
+# ---------------- topology ----------------
+def test_symmetric_topology_row_stochastic():
+    tm = topology.SymmetricTopologyManager(6, 2)
+    tm.generate_topology()
+    W = tm.mixing_matrix()
+    np.testing.assert_allclose(W.sum(1), 1.0, rtol=1e-6)
+    assert tm.get_in_neighbor_idx_list(0) == [1, 5]
+
+
+def test_asymmetric_topology_out_neighbors():
+    tm = topology.AsymmetricTopologyManager(5, 2, seed=0)
+    tm.generate_topology()
+    W = tm.mixing_matrix()
+    np.testing.assert_allclose(W.sum(1), 1.0, rtol=1e-6)
+    assert len(tm.get_in_neighbor_idx_list(0)) >= 1
+
+
+def test_all_zero_mask_yields_zeros_not_nan():
+    stacked = {"w": jnp.ones((3, 2))}
+    agg = aggregate.masked_weighted_average(
+        stacked, jnp.ones(3), jnp.zeros(3)
+    )
+    assert not np.any(np.isnan(agg["w"]))
+
+
+def test_two_node_ring_still_mixes():
+    tm = topology.SymmetricTopologyManager(2, 2)
+    tm.generate_topology()
+    assert tm.get_in_neighbor_idx_list(0) == [1]
