@@ -1,0 +1,67 @@
+"""Headline benchmark — run on real TPU by the driver each round.
+
+Metric (BASELINE.json north star): Parrot FedAvg rounds/sec with 100 simulated
+clients on CIFAR-10-shaped data, ResNet-20, 10 clients/round, 1 local epoch.
+The reference publishes no throughput baseline (``published = {}``), so
+``vs_baseline`` is measured against a fixed reference point: the reference's
+single-process torch loop timed at ~REF_ROUNDS_PER_SEC on this class of config
+(its per-round cost is dominated by K sequential client loops; ours is one
+fused vmap program). Until a measured torch/GPU number exists, REF is an
+estimated 0.2 rounds/s (5 s/round for 10 ResNet-20 clients × 1 epoch × 500
+samples, typical of the reference's sp backend on a single accelerator).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+REF_ROUNDS_PER_SEC = 0.2  # estimated reference sp-backend throughput
+
+
+def main() -> None:
+    import fedml_tpu as fedml
+    from fedml_tpu import data as data_mod
+    from fedml_tpu import models as model_mod
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.simulation.sp_api import FedAvgAPI
+
+    args = Arguments(overrides=dict(
+        dataset="cifar10", model="resnet20", client_num_in_total=100,
+        client_num_per_round=10, comm_round=12, epochs=1, batch_size=32,
+        learning_rate=0.1, frequency_of_the_test=1000,
+    ))
+    args = fedml.init(args, should_init_logs=False)
+    ds, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    api = FedAvgAPI(args, fedml.get_device(args), ds, bundle)
+
+    # warmup (compile) — 2 rounds
+    for r in range(2):
+        args.round_idx = r
+        api._train_round(r)
+
+    n_rounds = 10
+    t0 = time.perf_counter()
+    for r in range(2, 2 + n_rounds):
+        args.round_idx = r
+        api._train_round(r)
+    # block on the result
+    import jax
+
+    jax.block_until_ready(api.global_params)
+    dt = time.perf_counter() - t0
+
+    value = n_rounds / dt
+    print(json.dumps({
+        "metric": "fedavg_rounds_per_sec_100clients_cifar10_resnet20",
+        "value": round(value, 4),
+        "unit": "rounds/s",
+        "vs_baseline": round(value / REF_ROUNDS_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+"""``fedml_tpu.cheetah`` — the distributed-training pillar.
+
+In the reference this pillar is an EMPTY STUB (``python/fedml/distributed/``
+contains one empty ``__init__.py``; ``constants.py:5`` names the platform but
+``runner.py:29-38`` has no branch for it — SURVEY.md intro). Here it is real:
+LLM pretraining over an N-D device mesh (data/fsdp/tensor/sequence axes),
+built on ``fedml_tpu.parallel``.
+"""
+
+from .runner import CheetahRunner
+
+__all__ = ["CheetahRunner"]

@@ -1,0 +1,127 @@
+"""Algorithm frame: the user-override seam.
+
+Mirrors the reference's ``core/alg_frame/`` ABCs —
+``ClientTrainer`` (client_trainer.py:4-39), ``ServerAggregator``
+(server_aggregator.py:7-42), ``Params``/``Context`` (params.py:1-30,
+context.py:5-8) — the one abstraction the survey says to copy verbatim as a
+*seam* (SURVEY.md §7 "Async FSM vs SPMD lockstep"). JAX adaptation: model
+parameters are explicit pytrees, and a trainer may expose a *pure* local-train
+function so the SPMD runtimes can ``vmap``/``shard_map`` it; the imperative
+``train`` method remains for message-driven runtimes (cross-silo).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, Optional
+
+PyTree = Any
+
+
+class Params:
+    """Dict-like argument bag (reference: core/alg_frame/params.py)."""
+
+    def __init__(self, **kwargs):
+        self.__dict__.update(kwargs)
+
+    def add(self, name: str, value: Any) -> "Params":
+        self.__dict__[name] = value
+        return self
+
+    def get(self, name: str, default: Any = None) -> Any:
+        return self.__dict__.get(name, default)
+
+    def keys(self):
+        return self.__dict__.keys()
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.__dict__
+
+
+class Context(Params):
+    """Process-wide singleton Params (reference: context.py + singleton.py)."""
+
+    _instance: Optional["Context"] = None
+
+    def __new__(cls, *a, **kw):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+
+class ClientTrainer(abc.ABC):
+    """Local-training operator bound to one model + one client's data.
+
+    Contract preserved from the reference (client_trainer.py:4-39):
+    get/set_model_params, train, optional test, on_before/after hooks.
+    """
+
+    def __init__(self, model, args=None):
+        self.model = model  # ModelBundle
+        self.args = args
+        self.id = 0
+        self.model_params: Optional[PyTree] = None
+        self.local_train_fn = None  # pure fn for SPMD runtimes (may be None)
+
+    def set_id(self, trainer_id: int) -> None:
+        self.id = trainer_id
+
+    def get_model_params(self) -> PyTree:
+        return self.model_params
+
+    def set_model_params(self, model_parameters: PyTree) -> None:
+        self.model_params = model_parameters
+
+    def on_before_local_training(self, train_data, device, args) -> None:
+        pass
+
+    @abc.abstractmethod
+    def train(self, train_data, device, args) -> Dict[str, Any]:
+        ...
+
+    def on_after_local_training(self, train_data, device, args) -> None:
+        pass
+
+    def test(self, test_data, device, args):
+        return None
+
+
+class ServerAggregator(abc.ABC):
+    """Aggregation operator (reference: server_aggregator.py:7-42)."""
+
+    def __init__(self, model, args=None):
+        self.model = model
+        self.args = args
+        self.id = 0
+        self.model_params: Optional[PyTree] = None
+
+    def set_id(self, aggregator_id: int) -> None:
+        self.id = aggregator_id
+
+    def get_model_params(self) -> PyTree:
+        return self.model_params
+
+    def set_model_params(self, model_parameters: PyTree) -> None:
+        self.model_params = model_parameters
+
+    def on_before_aggregation(self, raw_client_model_or_grad_list):
+        return raw_client_model_or_grad_list
+
+    def aggregate(self, raw_client_model_or_grad_list) -> PyTree:
+        """Default: weighted average (reference defers to FedMLAggOperator)."""
+        from .aggregate import stack_trees, weighted_average
+        import jax.numpy as jnp
+
+        weights = jnp.asarray([float(n) for n, _ in raw_client_model_or_grad_list])
+        stacked = stack_trees([p for _, p in raw_client_model_or_grad_list])
+        return weighted_average(stacked, weights)
+
+    def on_after_aggregation(self, aggregated_model_or_grad: PyTree) -> PyTree:
+        return aggregated_model_or_grad
+
+    @abc.abstractmethod
+    def test(self, test_data, device, args):
+        ...
+
+    def test_all(self, train_data_local_dict, test_data_local_dict, device, args) -> bool:
+        return True

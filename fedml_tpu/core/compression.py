@@ -50,30 +50,33 @@ def ef_topk_compress(
 
 class QSGDPayload(NamedTuple):
     norm: jax.Array
-    signs: jax.Array
-    levels: jax.Array  # integer quantization levels
+    signed_levels: jax.Array  # int16: sign folded into the quantization level
     s: int
 
 
 def qsgd_compress(vec: jax.Array, key: jax.Array, s: int = 256) -> QSGDPayload:
     """QSGD stochastic quantization to s levels (reference: QSGDCompressor).
 
-    q_i = sign(v_i) * norm * (l_i / s) where l_i is |v_i|/norm*s stochastically
-    rounded — unbiased: E[decompress(compress(v))] = v.
+    q_i = norm * (sl_i / s) where sl_i = sign(v_i)·round_stoch(|v_i|/norm·s) —
+    unbiased: E[decompress(compress(v))] = v. The sign is folded into an int16
+    level so the payload is 2 bytes/element (vs 4 uncompressed) for s ≤ 2**15.
     """
+    if s > (1 << 15) - 1:
+        raise ValueError(f"s={s} overflows the int16 signed-level encoding")
     norm = jnp.linalg.norm(vec)
     safe_norm = jnp.maximum(norm, 1e-12)
     scaled = jnp.abs(vec) / safe_norm * s
     floor = jnp.floor(scaled)
     prob = scaled - floor
     rnd = jax.random.uniform(key, vec.shape)
-    levels = (floor + (rnd < prob)).astype(jnp.int32)
-    return QSGDPayload(norm=norm, signs=jnp.sign(vec), levels=levels, s=s)
+    levels = floor + (rnd < prob)
+    signed = (jnp.sign(vec) * levels).astype(jnp.int16)
+    return QSGDPayload(norm=norm, signed_levels=signed, s=s)
 
 
 def qsgd_decompress(payload: QSGDPayload) -> jax.Array:
     return (
-        payload.signs * payload.norm * payload.levels.astype(payload.norm.dtype)
+        payload.norm * payload.signed_levels.astype(payload.norm.dtype)
         / payload.s
     )
 

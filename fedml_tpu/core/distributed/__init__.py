@@ -1,0 +1,17 @@
+"""``fedml_tpu.core.distributed`` — message plane for cross-silo FL."""
+
+from .base_com_manager import (
+    BaseCommunicationManager,
+    CommunicationConstants,
+    Observer,
+)
+from .comm_manager import FedMLCommManager
+from .message import Message
+
+__all__ = [
+    "BaseCommunicationManager",
+    "CommunicationConstants",
+    "Observer",
+    "FedMLCommManager",
+    "Message",
+]

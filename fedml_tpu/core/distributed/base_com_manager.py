@@ -1,0 +1,51 @@
+"""Abstract communication manager + observer contract.
+
+reference: ``core/distributed/communication/base_com_manager.py:7-25`` and
+``observer.py:4-7`` — send_message / add_observer / handle_receive_message /
+stop_receive_message, with observers receiving (msg_type, msg_params).
+"""
+
+from __future__ import annotations
+
+import abc
+
+from .message import Message
+
+
+class Observer(abc.ABC):
+    @abc.abstractmethod
+    def receive_message(self, msg_type: str, msg: Message) -> None:
+        ...
+
+
+class BaseCommunicationManager(abc.ABC):
+    @abc.abstractmethod
+    def send_message(self, msg: Message) -> None:
+        ...
+
+    @abc.abstractmethod
+    def add_observer(self, observer: Observer) -> None:
+        ...
+
+    @abc.abstractmethod
+    def remove_observer(self, observer: Observer) -> None:
+        ...
+
+    @abc.abstractmethod
+    def handle_receive_message(self) -> None:
+        """Block in the receive loop until stopped."""
+        ...
+
+    @abc.abstractmethod
+    def stop_receive_message(self) -> None:
+        ...
+
+
+class CommunicationConstants:
+    """reference: communication/constants.py:1-11."""
+
+    MSG_TYPE_CONNECTION_IS_READY = "connection_ready"
+    MSG_CLIENT_STATUS_OFFLINE = "OFFLINE"
+    MSG_CLIENT_STATUS_IDLE = "IDLE"
+    GRPC_BASE_PORT = 8890
+    TCP_BASE_PORT = 8950

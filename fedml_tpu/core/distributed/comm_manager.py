@@ -1,0 +1,113 @@
+"""FedMLCommManager — the event-driven actor base every cross-silo node
+subclasses.
+
+reference: ``core/distributed/fedml_comm_manager.py:11-135`` — an Observer
+holding a handler registry keyed by message type; ``run()`` blocks in the
+backend's receive loop; ``_init_manager`` is the backend factory. Preserved
+contract: register_message_receive_handler / send_message / finish. Backends:
+LOOPBACK (in-process test fixture) and GRPC; the reference's MQTT/S3/TRPC
+transports collapse into these two (SURVEY.md §5 "Distributed communication
+backend": one DCN message plane instead of five broker stacks).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, Optional
+
+from ... import constants
+from .base_com_manager import BaseCommunicationManager, Observer
+from .message import Message
+
+logger = logging.getLogger(__name__)
+
+MessageHandler = Callable[[Message], None]
+
+
+class FedMLCommManager(Observer):
+    def __init__(self, args, comm=None, rank: int = 0, size: int = 0,
+                 backend: str = constants.COMM_BACKEND_LOOPBACK):
+        self.args = args
+        self.size = int(size)
+        self.rank = int(rank)
+        self.backend = backend
+        self.com_manager: Optional[BaseCommunicationManager] = comm
+        self.message_handler_dict: Dict[str, MessageHandler] = {}
+        self._thread: Optional[threading.Thread] = None
+        if self.com_manager is None:
+            self._init_manager()
+        self.com_manager.add_observer(self)
+
+    # -- registry (reference :52-63) ----------------------------------------
+    def register_comm_manager(self, comm_manager: BaseCommunicationManager):
+        self.com_manager = comm_manager
+
+    def register_message_receive_handler(
+        self, msg_type: str, handler: MessageHandler
+    ) -> None:
+        self.message_handler_dict[str(msg_type)] = handler
+
+    def register_message_receive_handlers(self) -> None:
+        """Subclasses register their FSM edges here (called by run())."""
+
+    # -- loop (reference :25-50) --------------------------------------------
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        self.com_manager.handle_receive_message()
+        logger.info("rank %d comm loop exited", self.rank)
+
+    def run_async(self) -> threading.Thread:
+        """Run the receive loop on a daemon thread (test/process embedding)."""
+        self.register_message_receive_handlers()
+        self._thread = threading.Thread(
+            target=self.com_manager.handle_receive_message, daemon=True
+        )
+        self._thread.start()
+        return self._thread
+
+    def send_message(self, message: Message) -> None:
+        self.com_manager.send_message(message)
+
+    def receive_message(self, msg_type: str, msg: Message) -> None:
+        handler = self.message_handler_dict.get(str(msg_type))
+        if handler is None:
+            logger.debug("rank %d: no handler for %r", self.rank, msg_type)
+            return
+        handler(msg)
+
+    def finish(self) -> None:
+        """Stop the loop (reference :57-60 calls MPI Abort; we just stop)."""
+        self.com_manager.stop_receive_message()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # -- backend factory (reference :72-133) --------------------------------
+    def _init_manager(self) -> None:
+        if self.backend == constants.COMM_BACKEND_LOOPBACK:
+            from .loopback import LoopbackCommManager
+
+            world = str(getattr(self.args, "run_id", "default"))
+            self.com_manager = LoopbackCommManager(self.rank, self.size, world)
+        elif self.backend == constants.COMM_BACKEND_GRPC:
+            from .base_com_manager import CommunicationConstants
+            from .grpc_backend import GRPCCommManager
+
+            base_port = int(
+                getattr(self.args, "comm_port", CommunicationConstants.GRPC_BASE_PORT)
+            )
+            self.com_manager = GRPCCommManager(
+                host=str(getattr(self.args, "comm_host", "0.0.0.0")),
+                port=base_port + self.rank,
+                rank=self.rank,
+                world_size=self.size,
+                ip_config_path=str(getattr(self.args, "grpc_ipconfig_path", "")),
+                base_port=base_port,
+            )
+        else:
+            raise ValueError(
+                f"unsupported comm backend {self.backend!r}; "
+                f"known: {constants.COMM_BACKENDS}"
+            )

@@ -1,0 +1,142 @@
+"""gRPC comm backend for cross-host FL (DCN message plane).
+
+reference: ``core/distributed/communication/grpc/grpc_comm_manager.py:30-177``
+— one gRPC server per node at base_port+rank, static CSV ip table, 1 GB max
+message, pickled Message in a proto bytes field. Differences here:
+- no protoc/codegen: a generic bytes-in/bytes-out unary handler (the wire
+  format is ``Message.serialize`` — JSON header + npz arrays, no pickle)
+- a persistent channel per peer (the reference dials a fresh channel per send)
+"""
+
+from __future__ import annotations
+
+import csv
+import logging
+import queue
+import threading
+from concurrent import futures
+from typing import Dict, List, Optional, Tuple
+
+import grpc
+
+from .base_com_manager import BaseCommunicationManager, CommunicationConstants, Observer
+from .message import Message
+
+logger = logging.getLogger(__name__)
+
+MAX_MESSAGE_BYTES = 1024 * 1024 * 1024  # 1 GB, reference parity
+_SERVICE = "fedml_tpu.Comm"
+_METHOD = f"/{_SERVICE}/Send"
+
+_GRPC_OPTIONS = [
+    ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+    ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
+]
+
+
+def load_ip_config(path: str) -> Dict[int, str]:
+    """CSV ``receiver_id,ip`` (reference: grpc_ipconfig.csv)."""
+    table: Dict[int, str] = {}
+    with open(path) as f:
+        for row in csv.reader(f):
+            if not row or row[0].strip().lower() in ("receiver_id", ""):
+                continue
+            table[int(row[0])] = row[1].strip()
+    return table
+
+
+class GRPCCommManager(BaseCommunicationManager):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        rank: int,
+        world_size: int,
+        ip_config: Optional[Dict[int, str]] = None,
+        ip_config_path: str = "",
+        base_port: int = CommunicationConstants.GRPC_BASE_PORT,
+    ):
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.base_port = int(base_port)
+        if ip_config is None and ip_config_path:
+            ip_config = load_ip_config(ip_config_path)
+        self.ip_config = ip_config or {i: "127.0.0.1" for i in range(world_size)}
+        self._observers: List[Observer] = []
+        self._queue: "queue.Queue[bytes]" = queue.Queue()
+        self._running = False
+        self._channels: Dict[int, grpc.Channel] = {}
+        self._stubs: Dict[int, grpc.UnaryUnaryMultiCallable] = {}
+        self._lock = threading.Lock()
+
+        def handle_send(request: bytes, context) -> bytes:
+            self._queue.put(request)
+            return b"ok"
+
+        handlers = grpc.method_handlers_generic_handler(
+            _SERVICE,
+            {
+                "Send": grpc.unary_unary_rpc_method_handler(
+                    handle_send,
+                    request_deserializer=None,  # raw bytes through
+                    response_serializer=None,
+                )
+            },
+        )
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8), options=_GRPC_OPTIONS
+        )
+        self._server.add_generic_rpc_handlers((handlers,))
+        bind = f"{host}:{port}"
+        self._server.add_insecure_port(bind)
+        self._server.start()
+        logger.info("grpc backend: rank %d serving at %s", rank, bind)
+
+    def _stub(self, receiver_id: int) -> grpc.UnaryUnaryMultiCallable:
+        with self._lock:
+            if receiver_id not in self._stubs:
+                target = (
+                    f"{self.ip_config[receiver_id]}:{self.base_port + receiver_id}"
+                )
+                ch = grpc.insecure_channel(target, options=_GRPC_OPTIONS)
+                self._channels[receiver_id] = ch
+                self._stubs[receiver_id] = ch.unary_unary(
+                    _METHOD, request_serializer=None, response_deserializer=None
+                )
+            return self._stubs[receiver_id]
+
+    def send_message(self, msg: Message) -> None:
+        self._stub(msg.get_receiver_id())(msg.serialize(), timeout=300)
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        if observer in self._observers:
+            self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        self._notify(
+            Message(CommunicationConstants.MSG_TYPE_CONNECTION_IS_READY,
+                    self.rank, self.rank)
+        )
+        while self._running:
+            try:
+                data = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            self._notify(Message.deserialize(data))
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self._server.stop(grace=0.5)
+        with self._lock:
+            for ch in self._channels.values():
+                ch.close()
+            self._channels.clear()
+            self._stubs.clear()
+
+    def _notify(self, msg: Message) -> None:
+        for obs in list(self._observers):
+            obs.receive_message(msg.get_type(), msg)

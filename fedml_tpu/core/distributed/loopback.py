@@ -1,0 +1,84 @@
+"""In-process loopback comm backend — the first-class test fixture the
+reference never had (SURVEY.md §4: "No fake/in-memory comm backend exists...
+the new framework should make an in-process loopback backend a first-class
+test fixture").
+
+All ranks of a named "world" share a broker of queues; each rank's
+``handle_receive_message`` drains its own queue on a thread-blocking get.
+Serialization is exercised for fidelity (messages cross rank boundaries as
+bytes, exactly like the network backends).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections import defaultdict
+from typing import Dict, List
+
+from .base_com_manager import BaseCommunicationManager, CommunicationConstants, Observer
+from .message import Message
+
+
+class _Broker:
+    """Shared mailbox set for one world (keyed by world name)."""
+
+    _worlds: Dict[str, "_Broker"] = {}
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self.queues: Dict[int, "queue.Queue[bytes]"] = defaultdict(queue.Queue)
+
+    @classmethod
+    def get(cls, world: str) -> "_Broker":
+        with cls._lock:
+            if world not in cls._worlds:
+                cls._worlds[world] = cls()
+            return cls._worlds[world]
+
+    @classmethod
+    def reset(cls, world: str) -> None:
+        with cls._lock:
+            cls._worlds.pop(world, None)
+
+
+class LoopbackCommManager(BaseCommunicationManager):
+    def __init__(self, rank: int, world_size: int, world: str = "default"):
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.world = world
+        self.broker = _Broker.get(world)
+        self._observers: List[Observer] = []
+        self._running = False
+
+    def send_message(self, msg: Message) -> None:
+        self.broker.queues[msg.get_receiver_id()].put(msg.serialize())
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        if observer in self._observers:
+            self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        # synthetic connection-ready event, like the MQTT/GRPC backends
+        self._notify(
+            Message(CommunicationConstants.MSG_TYPE_CONNECTION_IS_READY,
+                    self.rank, self.rank)
+        )
+        q = self.broker.queues[self.rank]
+        while self._running:
+            try:
+                data = q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            self._notify(Message.deserialize(data))
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+
+    def _notify(self, msg: Message) -> None:
+        for obs in list(self._observers):
+            obs.receive_message(msg.get_type(), msg)

@@ -1,0 +1,102 @@
+"""Typed message with array payloads.
+
+Reference: ``core/distributed/communication/message.py:5-82`` — a JSON dict of
+string params plus *pickled torch tensors* under MSG_ARG_KEY_MODEL_PARAMS.
+TPU re-design: payloads are flat numpy array lists (a pytree's canonical leaf
+order), serialized with ``np.savez`` + a JSON header — no pickle on the wire
+(untrusted peers can't execute code via payloads), no torch.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class Message:
+    # keys mirrored from the reference (message.py:12-34)
+    MSG_ARG_KEY_TYPE = "msg_type"
+    MSG_ARG_KEY_SENDER = "sender"
+    MSG_ARG_KEY_RECEIVER = "receiver"
+    MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+    MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
+    MSG_ARG_KEY_CLIENT_STATUS = "client_status"
+    MSG_ARG_KEY_ROUND_IDX = "round_idx"
+    MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
+
+    def __init__(self, type: str = "default", sender_id: int = 0, receiver_id: int = 0):
+        self.type = str(type)
+        self.sender_id = int(sender_id)
+        self.receiver_id = int(receiver_id)
+        self.msg_params: Dict[str, Any] = {
+            Message.MSG_ARG_KEY_TYPE: self.type,
+            Message.MSG_ARG_KEY_SENDER: self.sender_id,
+            Message.MSG_ARG_KEY_RECEIVER: self.receiver_id,
+        }
+        self.arrays: List[np.ndarray] = []  # canonical-order pytree leaves
+
+    # -- reference API (message.py:36-75) -----------------------------------
+    def init(self, msg_params: Dict[str, Any]) -> None:
+        self.msg_params = dict(msg_params)
+        self.type = str(msg_params.get(Message.MSG_ARG_KEY_TYPE, self.type))
+        self.sender_id = int(msg_params.get(Message.MSG_ARG_KEY_SENDER, 0))
+        self.receiver_id = int(msg_params.get(Message.MSG_ARG_KEY_RECEIVER, 0))
+
+    def get_sender_id(self) -> int:
+        return self.sender_id
+
+    def get_receiver_id(self) -> int:
+        return self.receiver_id
+
+    def add_params(self, key: str, value: Any) -> None:
+        self.msg_params[key] = value
+
+    def get_params(self) -> Dict[str, Any]:
+        return self.msg_params
+
+    def add(self, key: str, value: Any) -> None:
+        self.msg_params[key] = value
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.msg_params.get(key, default)
+
+    def get_type(self) -> str:
+        return str(self.msg_params[Message.MSG_ARG_KEY_TYPE])
+
+    # -- array payloads ------------------------------------------------------
+    def set_arrays(self, arrays: List[np.ndarray]) -> None:
+        self.arrays = [np.asarray(a) for a in arrays]
+
+    def get_arrays(self) -> List[np.ndarray]:
+        return self.arrays
+
+    # -- wire format ---------------------------------------------------------
+    def serialize(self) -> bytes:
+        header = json.dumps(self.msg_params).encode("utf-8")
+        buf = io.BytesIO()
+        np.savez(buf, *self.arrays)
+        body = buf.getvalue()
+        return (
+            len(header).to_bytes(4, "big") + header + body
+        )
+
+    @staticmethod
+    def deserialize(data: bytes) -> "Message":
+        hlen = int.from_bytes(data[:4], "big")
+        header = json.loads(data[4 : 4 + hlen].decode("utf-8"))
+        msg = Message()
+        msg.init(header)
+        body = data[4 + hlen :]
+        if body:
+            with np.load(io.BytesIO(body)) as z:
+                msg.arrays = [z[k] for k in z.files]
+        return msg
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"Message(type={self.type!r}, {self.sender_id}->{self.receiver_id}, "
+            f"{len(self.arrays)} arrays)"
+        )

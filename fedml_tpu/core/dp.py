@@ -24,6 +24,18 @@ def _tree_keys(key: jax.Array, tree: PyTree) -> PyTree:
     return jax.tree.unflatten(treedef, list(keys))
 
 
+def _add_tree_noise(tree: PyTree, key: jax.Array, sampler, scale: float) -> PyTree:
+    """One fused noise-add per leaf with per-leaf derived keys."""
+    keys = _tree_keys(key, tree)
+    return jax.tree.map(
+        lambda x, k: x
+        + sampler(k, x.shape, jnp.result_type(x, jnp.float32)).astype(x.dtype)
+        * scale,
+        tree,
+        keys,
+    )
+
+
 class LaplaceMechanism:
     """Laplace noise with scale sensitivity/epsilon (reference:
     differential_privacy/mechanisms/laplace.py)."""
@@ -34,13 +46,8 @@ class LaplaceMechanism:
         self.scale = sensitivity / epsilon
 
     def add_noise(self, tree: PyTree, key: jax.Array) -> PyTree:
-        keys = _tree_keys(key, tree)
-        return jax.tree.map(
-            lambda x, k: x
-            + jax.random.laplace(k, x.shape, dtype=jnp.result_type(x, jnp.float32))
-            .astype(x.dtype) * self.scale,
-            tree,
-            keys,
+        return _add_tree_noise(
+            tree, key, lambda k, s, d: jax.random.laplace(k, s, dtype=d), self.scale
         )
 
 
@@ -54,13 +61,8 @@ class GaussianMechanism:
         self.sigma = sensitivity * math.sqrt(2.0 * math.log(1.25 / delta)) / epsilon
 
     def add_noise(self, tree: PyTree, key: jax.Array) -> PyTree:
-        keys = _tree_keys(key, tree)
-        return jax.tree.map(
-            lambda x, k: x
-            + jax.random.normal(k, x.shape, dtype=jnp.result_type(x, jnp.float32))
-            .astype(x.dtype) * self.sigma,
-            tree,
-            keys,
+        return _add_tree_noise(
+            tree, key, lambda k, s, d: jax.random.normal(k, s, dtype=d), self.sigma
         )
 
 
@@ -78,8 +80,10 @@ class FedPrivacyMechanism:
 
     - ``dp_type="ldp"``: each client clips + noises its own update
       (:meth:`randomize`, vmap-able over the clients axis).
-    - ``dp_type="cdp"``: the server noises the aggregate
-      (:meth:`randomize_global`).
+    - ``dp_type="cdp"``: per-client contributions are clipped BEFORE
+      aggregation (:meth:`clip_client_updates` — this is what bounds the
+      sensitivity the noise is calibrated to), then the server noises the
+      aggregate (:meth:`randomize_global`, noise only, no clipping).
     """
 
     def __init__(
@@ -115,8 +119,26 @@ class FedPrivacyMechanism:
         )
 
     def randomize(self, tree: PyTree, key: jax.Array) -> PyTree:
+        """LDP: clip + noise one client's own update."""
         if self.clip_norm > 0:
             tree = clip_tree_by_global_norm(tree, self.clip_norm)
         return self.mechanism.add_noise(tree, key)
 
-    randomize_global = randomize
+    def clip_client_updates(self, stacked: PyTree, global_params: PyTree) -> PyTree:
+        """CDP sensitivity bound: clip each client's delta from the global
+        model to ``clip_norm`` (leading axis of ``stacked`` = clients)."""
+        if self.clip_norm <= 0:
+            return stacked
+
+        def one(client_tree):
+            delta = jax.tree.map(jnp.subtract, client_tree, global_params)
+            delta = clip_tree_by_global_norm(delta, self.clip_norm)
+            return jax.tree.map(jnp.add, global_params, delta)
+
+        return jax.vmap(one)(stacked)
+
+    def randomize_global(self, tree: PyTree, key: jax.Array) -> PyTree:
+        """CDP: noise the aggregate. No clipping here — clipping the aggregate
+        would not bound per-client sensitivity (it must happen per client via
+        :meth:`clip_client_updates`) and would distort the global update."""
+        return self.mechanism.add_noise(tree, key)

@@ -1,0 +1,5 @@
+"""``fedml_tpu.core.mpc`` — secure multi-party computation primitives."""
+
+from . import lightsecagg
+
+__all__ = ["lightsecagg"]

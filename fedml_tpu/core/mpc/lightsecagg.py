@@ -1,0 +1,163 @@
+"""LightSecAgg: Lagrange-Coded-Computing secure aggregation primitives.
+
+Re-founds the reference's ``core/mpc/lightsecagg.py:1-205`` (LCC mask
+encode/decode over a prime field, modular inverse, model quantize/dequantize)
+for the TPU stack. Design split (SURVEY.md §7 "Finite-field math on TPU"):
+
+- **Share encode/decode** (tiny [U×N] Lagrange matrices, needs exact mod-p
+  int arithmetic with modular inverses): host-side numpy int64 / object ints.
+  TPU int64 support is gated and the MXU does not do exact wide-int matmul,
+  so running these µs-scale matrices on device would buy nothing.
+- **Masking / unmasking / field sums** (O(model) elementwise): int32 jnp with
+  p = 2**15 - 19 < 2**15 so a+b and a·b never overflow int32 — these run
+  fused on device next to the models they protect.
+
+Protocol parameters follow the paper/reference: N clients, T privacy
+threshold, U target survivors, T < U ≤ N; masks are split into U−T chunks and
+coded with T random chunks so any U aggregate shares reconstruct the sum of
+surviving masks while ≤T colluders learn nothing.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+FIELD_P = 2**15 - 19  # same prime family as the reference (fits int32 products)
+
+
+# ---------------------------------------------------------------------------
+# Modular helpers (host-side, exact)
+# ---------------------------------------------------------------------------
+def mod_inverse(a: int, p: int = FIELD_P) -> int:
+    """Fermat inverse a^(p-2) mod p (reference: modular inverse via ext-gcd)."""
+    return pow(int(a) % p, p - 2, p)
+
+
+def lagrange_coeffs(
+    alpha_s: Sequence[int], beta_s: Sequence[int], p: int = FIELD_P
+) -> np.ndarray:
+    """U[i][j]: Lagrange basis l_j(alpha_i) mod p — evaluate the polynomial
+    interpolating values at points ``beta_s`` at points ``alpha_s``
+    (reference: ``gen_Lagrange_coeffs``)."""
+    num_alpha, num_beta = len(alpha_s), len(beta_s)
+    U = np.zeros((num_alpha, num_beta), dtype=np.int64)
+    for i, a in enumerate(alpha_s):
+        for j in range(num_beta):
+            num, den = 1, 1
+            for k in range(num_beta):
+                if k == j:
+                    continue
+                num = (num * (a - beta_s[k])) % p
+                den = (den * (beta_s[j] - beta_s[k])) % p
+            U[i, j] = (num * mod_inverse(den, p)) % p
+    return U
+
+
+def lcc_encode(X: np.ndarray, alpha_s, beta_s, p: int = FIELD_P) -> np.ndarray:
+    """Encode U chunks [U, m] → N shares [N, m]
+    (reference: ``LCC_encoding_with_points``)."""
+    W = lagrange_coeffs(alpha_s, beta_s, p)  # [N, U]
+    return (W % p) @ (X.astype(np.int64) % p) % p
+
+
+def lcc_decode(
+    shares: np.ndarray, eval_points, target_points, p: int = FIELD_P
+) -> np.ndarray:
+    """Decode U shares [U, m] at eval_points → values at target_points
+    (reference: ``LCC_decoding_with_points``)."""
+    W = lagrange_coeffs(target_points, eval_points, p)
+    return (W % p) @ (shares.astype(np.int64) % p) % p
+
+
+# ---------------------------------------------------------------------------
+# Quantization float ⇄ field (reference: transform_tensor_to_finite / back)
+# ---------------------------------------------------------------------------
+def quantize_to_field(
+    vec: np.ndarray, q_bits: int = 8, p: int = FIELD_P
+) -> np.ndarray:
+    """Fixed-point quantize: round(x·2^q) mod p; negatives wrap to upper half."""
+    scaled = np.round(np.asarray(vec, np.float64) * (1 << q_bits)).astype(np.int64)
+    return np.mod(scaled, p)
+
+
+def dequantize_from_field(
+    fvec: np.ndarray, q_bits: int = 8, p: int = FIELD_P
+) -> np.ndarray:
+    """Inverse: values > p/2 are negatives."""
+    x = np.asarray(fvec, np.int64) % p
+    x = np.where(x > p // 2, x - p, x)
+    return (x.astype(np.float64) / (1 << q_bits)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# Mask lifecycle
+# ---------------------------------------------------------------------------
+def pad_len(d: int, chunks: int) -> int:
+    return int(-(-d // chunks) * chunks)
+
+
+def mask_encoding(
+    d: int, N: int, U: int, T: int, rng: np.random.RandomState,
+    p: int = FIELD_P,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Generate a local mask z [d] and its N encoded shares [N, d_pad/(U-T)].
+
+    reference: ``mask_encoding`` — split z into U−T chunks, append T random
+    chunks, LCC-encode at points α_1..α_N from values at β_1..β_U.
+    """
+    chunks = U - T
+    dp = pad_len(d, chunks)
+    z = rng.randint(0, p, size=dp).astype(np.int64)
+    m = dp // chunks
+    sub = z.reshape(chunks, m)
+    noise = rng.randint(0, p, size=(T, m)).astype(np.int64)
+    X = np.concatenate([sub, noise], axis=0)  # [U, m]
+    alpha_s = list(range(1, N + 1))
+    beta_s = list(range(N + 1, N + 1 + U))
+    shares = lcc_encode(X, alpha_s, beta_s, p)  # [N, m]
+    return z[:d], shares
+
+
+def aggregate_shares(
+    received: List[np.ndarray], p: int = FIELD_P
+) -> np.ndarray:
+    """Client-side: sum of the shares received from surviving clients."""
+    out = np.zeros_like(received[0])
+    for s in received:
+        out = (out + s) % p
+    return out
+
+
+def decode_aggregate_mask(
+    agg_shares: List[np.ndarray], survivor_points: List[int],
+    d: int, N: int, U: int, T: int, p: int = FIELD_P,
+) -> np.ndarray:
+    """Server-side: U aggregate shares (evaluations at α_j for surviving j) →
+    Σ z_i over survivors [d] (reference: aggregate_models_in_finite +
+    LCC_decoding)."""
+    chunks = U - T
+    beta_s = list(range(N + 1, N + 1 + U))
+    shares = np.stack(agg_shares[:U]).astype(np.int64)  # [U, m]
+    vals = lcc_decode(shares, survivor_points[:U], beta_s[:chunks], p)  # [chunks, m]
+    return vals.reshape(-1)[:d]
+
+
+# ---------------------------------------------------------------------------
+# On-device field ops (int32-safe since p < 2**15)
+# ---------------------------------------------------------------------------
+def model_masking(quantized: jnp.ndarray, mask: jnp.ndarray, p: int = FIELD_P):
+    """(model + z) mod p — elementwise, runs on TPU next to the model."""
+    return jnp.mod(quantized.astype(jnp.int32) + mask.astype(jnp.int32), p)
+
+
+def model_unmasking(masked_sum: jnp.ndarray, mask_sum: jnp.ndarray, p: int = FIELD_P):
+    """(Σ masked − Σ z) mod p."""
+    return jnp.mod(masked_sum.astype(jnp.int32) - mask_sum.astype(jnp.int32), p)
+
+
+def field_sum(stack: jnp.ndarray, p: int = FIELD_P):
+    """Σ over clients axis mod p. int32 accumulation is safe for N < 2**16."""
+    return jnp.mod(jnp.sum(stack.astype(jnp.int64), axis=0), p).astype(jnp.int32)

@@ -60,7 +60,8 @@ class FedMLAttacker:
         mask = jnp.asarray(mask)
         if self.attack_type.startswith("byzantine_"):
             return attacks.byzantine_attack(
-                updates, mask, key, self.attack_type.split("_", 1)[1]
+                updates, mask, key, self.attack_type.split("_", 1)[1],
+                scale=float(getattr(self.args, "byzantine_scale", 1.0)),
             )
         boost = float(getattr(self.args, "attack_boost", float(n)))
         global_vec = jnp.average(updates, axis=0, weights=weights)

@@ -21,18 +21,19 @@ def byzantine_attack(
     byzantine_mask: jax.Array,
     key: jax.Array,
     attack_mode: str = "random",
+    scale: float = 1.0,
 ) -> jax.Array:
     """Corrupt masked clients' updates (reference: byzantine_attack.py).
 
-    - ``random``: replace with gaussian noise scaled to the honest norm
+    - ``random``: replace with gaussian noise at ``scale``× the honest norm
     - ``zero``: replace with zeros
     - ``flip``: negate (gradient sign flip)
     """
     m = byzantine_mask[:, None]
     if attack_mode == "random":
-        scale = jnp.linalg.norm(updates, axis=1).mean()
+        norm = jnp.linalg.norm(updates, axis=1).mean() * scale
         noise = jax.random.normal(key, updates.shape, updates.dtype) * (
-            scale / jnp.sqrt(updates.shape[1])
+            norm / jnp.sqrt(updates.shape[1])
         )
         return updates * (1 - m) + noise * m
     if attack_mode == "zero":

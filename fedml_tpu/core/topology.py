@@ -24,11 +24,11 @@ class BaseTopologyManager(ABC):
     @abstractmethod
     def generate_topology(self) -> None: ...
 
-    @abstractmethod
-    def get_in_neighbor_idx_list(self, index: int) -> List[int]: ...
+    def get_in_neighbor_idx_list(self, index: int) -> List[int]:
+        return [j for j in range(self.n) if self.topology[index, j] > 0 and j != index]
 
-    @abstractmethod
-    def get_out_neighbor_idx_list(self, index: int) -> List[int]: ...
+    def get_out_neighbor_idx_list(self, index: int) -> List[int]:
+        return [j for j in range(self.n) if self.topology[j, index] > 0 and j != index]
 
     def get_in_neighbor_weights(self, index: int) -> np.ndarray:
         return self.topology[index]
@@ -64,12 +64,6 @@ class SymmetricTopologyManager(BaseTopologyManager):
                 A[i, (i - off) % n] = 1.0
         self.topology = A / A.sum(axis=1, keepdims=True)
 
-    def get_in_neighbor_idx_list(self, index: int) -> List[int]:
-        return [j for j in range(self.n) if self.topology[index, j] > 0 and j != index]
-
-    def get_out_neighbor_idx_list(self, index: int) -> List[int]:
-        return [j for j in range(self.n) if self.topology[j, index] > 0 and j != index]
-
 
 class AsymmetricTopologyManager(BaseTopologyManager):
     """Directed ring + random extra out-edges (reference:
@@ -89,14 +83,7 @@ class AsymmetricTopologyManager(BaseTopologyManager):
             ring = (i + 1) % n
             A[i, ring] = 1.0  # directed ring
             pool = [j for j in range(n) if j != i and j != ring]
-            extra = rng.choice(
-                pool, min(self.out_neighbor_num - 1, len(pool)), replace=False
-            )
+            n_extra = max(min(self.out_neighbor_num - 1, len(pool)), 0)
+            extra = rng.choice(pool, n_extra, replace=False)
             A[i, extra] = 1.0
         self.topology = A / A.sum(axis=1, keepdims=True)
-
-    def get_in_neighbor_idx_list(self, index: int) -> List[int]:
-        return [j for j in range(self.n) if self.topology[index, j] > 0 and j != index]
-
-    def get_out_neighbor_idx_list(self, index: int) -> List[int]:
-        return [j for j in range(self.n) if self.topology[j, index] > 0 and j != index]

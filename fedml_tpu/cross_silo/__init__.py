@@ -1,0 +1,105 @@
+"""``fedml_tpu.cross_silo`` — the Octopus pillar (cross-org FL).
+
+Facades mirror the reference (``cross_silo/fedml_client.py:5-57``,
+``fedml_server.py:4-53``): optimizer dispatch "FedAvg" → managers; "LSA" →
+LightSecAgg flow (``lightsecagg/``).
+"""
+
+from __future__ import annotations
+
+from .. import constants
+from ..ml.aggregator import create_server_aggregator
+from ..ml.trainer import create_model_trainer
+
+
+class FedMLCrossSiloServer:
+    def __init__(self, args, device, dataset, model, server_aggregator=None):
+        from .server_manager import FedMLServerManager
+
+        self.args = args
+        aggregator = server_aggregator or create_server_aggregator(model, args)
+        aggregator.set_id(0)
+        opt = str(getattr(args, "federated_optimizer", "FedAvg"))
+        size = int(getattr(args, "client_num_in_total", 1)) + 1
+        if opt == constants.FEDML_FEDERATED_OPTIMIZER_LSA:
+            from .lightsecagg.lsa_server_manager import LightSecAggServerManager
+
+            self.manager = LightSecAggServerManager(
+                args, aggregator, rank=0, size=size,
+                backend=str(getattr(args, "backend", constants.COMM_BACKEND_LOOPBACK)),
+                dataset=dataset, model=model,
+            )
+        else:
+            self.manager = FedMLServerManager(
+                args, aggregator, rank=0, size=size,
+                backend=str(getattr(args, "backend", constants.COMM_BACKEND_LOOPBACK)),
+                dataset=dataset, model=model,
+            )
+
+    def run(self):
+        self.manager.run()
+        return self.manager.final_metrics
+
+
+class FedMLCrossSiloClient:
+    def __init__(self, args, device, dataset, model, client_trainer=None):
+        self.args = args
+        trainer = client_trainer or create_model_trainer(model, args)
+        rank = int(getattr(args, "rank", 1))
+        trainer.set_id(rank)
+        size = int(getattr(args, "client_num_in_total", 1)) + 1
+        opt = str(getattr(args, "federated_optimizer", "FedAvg"))
+        if opt == constants.FEDML_FEDERATED_OPTIMIZER_LSA:
+            from .lightsecagg.lsa_client_manager import LightSecAggClientManager
+
+            self.manager = LightSecAggClientManager(
+                args, trainer, rank=rank, size=size,
+                backend=str(getattr(args, "backend", constants.COMM_BACKEND_LOOPBACK)),
+                dataset=dataset,
+            )
+        else:
+            from .client_manager import ClientMasterManager
+
+            self.manager = ClientMasterManager(
+                args, trainer, rank=rank, size=size,
+                backend=str(getattr(args, "backend", constants.COMM_BACKEND_LOOPBACK)),
+                dataset=dataset,
+            )
+
+    def run(self):
+        self.manager.run()
+
+
+def run_server(**overrides):
+    """One-line server launcher (reference: launch_cross_silo_horizontal.py:7)."""
+    import fedml_tpu as fedml
+    from .. import data as data_mod
+    from .. import models as model_mod
+    from ..arguments import Arguments
+
+    args = fedml.init(
+        Arguments(training_type=constants.FEDML_TRAINING_PLATFORM_CROSS_SILO,
+                  overrides={**overrides, "role": "server"})
+    )
+    device = fedml.get_device(args)
+    dataset, output_dim = data_mod.load(args)
+    model = model_mod.create(args, output_dim)
+    server = FedMLCrossSiloServer(args, device, dataset, model)
+    return server.run()
+
+
+def run_client(**overrides):
+    import fedml_tpu as fedml
+    from .. import data as data_mod
+    from .. import models as model_mod
+    from ..arguments import Arguments
+
+    args = fedml.init(
+        Arguments(training_type=constants.FEDML_TRAINING_PLATFORM_CROSS_SILO,
+                  overrides={**overrides, "role": "client"})
+    )
+    device = fedml.get_device(args)
+    dataset, output_dim = data_mod.load(args)
+    model = model_mod.create(args, output_dim)
+    client = FedMLCrossSiloClient(args, device, dataset, model)
+    return client.run()

@@ -1,0 +1,114 @@
+"""Cross-silo FL client: trainer + message FSM.
+
+reference: ``cross_silo/client/fedml_client_master_manager.py:17-176`` — FSM:
+connection_ready → send ONLINE status → S2C_INIT → train → C2S model →
+S2C_SYNC … → S2C_FINISH. The "hierarchical" DDP path
+(``fedml_trainer_dist_adapter.py``, ``process_group_manager.py``) is replaced
+by JAX intra-host data parallelism: a silo with multiple local chips trains
+its local shard under one jit with a batch-sharded mesh — no process groups
+to manage.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import constants
+from ..core.distributed import FedMLCommManager, Message
+from ..core.dp import FedPrivacyMechanism
+from .message_define import MyMessage
+
+logger = logging.getLogger(__name__)
+
+
+class ClientMasterManager(FedMLCommManager):
+    def __init__(self, args, trainer, comm=None, rank=0, size=0,
+                 backend=constants.COMM_BACKEND_LOOPBACK, dataset=None):
+        super().__init__(args, comm, rank, size, backend)
+        self.trainer = trainer  # ClientTrainer
+        self.ds = dataset
+        self.client_index = rank - 1
+        self.round_idx = 0
+        self.done = threading.Event()
+        self.dp = (
+            FedPrivacyMechanism.from_args(args)
+            if bool(getattr(args, "enable_dp", False))
+            and str(getattr(args, "dp_type", "cdp")) == "ldp"
+            else None
+        )
+        self._treedef: Optional[object] = None
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_CONNECTION_IS_READY, self._on_connection_ready
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self._on_init
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self._on_sync
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_FINISH, self._on_finish
+        )
+
+    def _on_connection_ready(self, msg: Message) -> None:
+        status = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
+        status.add(MyMessage.MSG_ARG_KEY_CLIENT_STATUS,
+                   MyMessage.CLIENT_STATUS_ONLINE)
+        self.send_message(status)
+
+    def _install_params(self, msg: Message) -> None:
+        if self._treedef is None:
+            # initialize a skeleton to learn the treedef
+            skeleton = self.trainer.model.init(
+                jax.random.PRNGKey(int(getattr(self.args, "random_seed", 0)))
+            )
+            self._treedef = jax.tree.structure(skeleton)
+        leaves = [jnp.asarray(a) for a in msg.get_arrays()]
+        self.trainer.set_model_params(jax.tree.unflatten(self._treedef, leaves))
+
+    def _on_init(self, msg: Message) -> None:
+        self.client_index = int(
+            msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, self.client_index)
+        )
+        self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0))
+        self._install_params(msg)
+        self._train_and_send()
+
+    def _on_sync(self, msg: Message) -> None:
+        self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0))
+        self._install_params(msg)
+        self._train_and_send()
+
+    def _on_finish(self, msg: Message) -> None:
+        self._install_params(msg)
+        logger.info("client %d: finished", self.rank)
+        self.done.set()
+        self.finish()
+
+    def _train_and_send(self) -> None:
+        """reference: __train + send_model_to_server (:109-127,160)."""
+        self.args.round_idx = self.round_idx
+        x, y, n = self.ds.client_shard(self.client_index)
+        metrics = self.trainer.train((x, y, n), None, self.args)
+        params = self.trainer.get_model_params()
+        if self.dp is not None:
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(int(getattr(self.args, "random_seed", 0)) + self.rank),
+                self.round_idx,
+            )
+            params = self.dp.randomize(params, key)
+        msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
+        msg.add(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(n))
+        msg.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
+        msg.add(MyMessage.MSG_ARG_KEY_TRAIN_LOSS,
+                float(metrics.get("train_loss", 0.0)))
+        msg.set_arrays([np.asarray(l) for l in jax.tree.leaves(params)])
+        self.send_message(msg)

@@ -1,0 +1,6 @@
+"""LightSecAgg secure-aggregation flow (reference: ``cross_silo/lightsecagg/``)."""
+
+from .lsa_client_manager import LightSecAggClientManager
+from .lsa_server_manager import LightSecAggServerManager
+
+__all__ = ["LightSecAggClientManager", "LightSecAggServerManager"]

@@ -1,0 +1,166 @@
+"""LightSecAgg client FSM.
+
+reference: ``cross_silo/lightsecagg/`` client managers (~1,199 LoC across the
+flow). Per round: train → quantize model to the field → draw mask z, LCC-encode
+N shares, route them via the server → upload masked model → on the server's
+survivor announcement, reply with the sum of the survivors' shares.
+≤T colluding parties learn nothing about z; the server never sees an unmasked
+model (core/mpc/lightsecagg.py for the math).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import constants
+from ...core.distributed import FedMLCommManager, Message
+from ...core.mpc import lightsecagg as lsa
+from ...utils.tree import tree_flatten_to_vector, tree_unflatten_from_vector
+from .lsa_message_define import LSAMessage
+
+logger = logging.getLogger(__name__)
+
+
+class LightSecAggClientManager(FedMLCommManager):
+    def __init__(self, args, trainer, comm=None, rank=0, size=0,
+                 backend=constants.COMM_BACKEND_LOOPBACK, dataset=None):
+        super().__init__(args, comm, rank, size, backend)
+        self.trainer = trainer
+        self.ds = dataset
+        self.client_index = rank - 1
+        self.N = size - 1
+        self.T = int(getattr(args, "lsa_privacy_threshold", max(1, (self.N - 1) // 2)))
+        self.U = int(getattr(args, "lsa_target_survivors", self.T + 1 if self.T + 1 <= self.N else self.N))
+        self.q_bits = int(getattr(args, "lsa_quantize_bits", 8))
+        self.round_idx = 0
+        self.done = threading.Event()
+        self._treedef = None
+        self._shapes = None
+        self._dim: Optional[int] = None
+        self._local_mask: Optional[np.ndarray] = None
+        self._received_shares: Dict[int, np.ndarray] = {}
+        self._pending_survivors: Optional[list] = None
+        self._lock = threading.Lock()
+
+    # -- registration --------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        reg = self.register_message_receive_handler
+        reg(LSAMessage.MSG_TYPE_CONNECTION_IS_READY, self._on_ready)
+        reg(LSAMessage.MSG_TYPE_S2C_INIT_CONFIG, self._on_init_or_sync)
+        reg(LSAMessage.MSG_TYPE_S2C_SYNC_MODEL, self._on_init_or_sync)
+        reg(LSAMessage.MSG_TYPE_S2C_FORWARD_SHARE, self._on_forward_share)
+        reg(LSAMessage.MSG_TYPE_S2C_REQUEST_AGG_SHARES, self._on_request_agg)
+        reg(LSAMessage.MSG_TYPE_S2C_FINISH, self._on_finish)
+
+    def _on_ready(self, msg: Message) -> None:
+        status = Message(LSAMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
+        status.add(LSAMessage.ARG_CLIENT_STATUS, LSAMessage.STATUS_ONLINE)
+        self.send_message(status)
+
+    # -- round ---------------------------------------------------------------
+    def _on_init_or_sync(self, msg: Message) -> None:
+        self.round_idx = int(msg.get(LSAMessage.ARG_ROUND_IDX, 0))
+        leaves = [jnp.asarray(a) for a in msg.get_arrays()]
+        if self._treedef is None:
+            skeleton = self.trainer.model.init(
+                jax.random.PRNGKey(int(getattr(self.args, "random_seed", 0)))
+            )
+            vec, self._treedef, self._shapes = tree_flatten_to_vector(skeleton)
+            self._dim = int(vec.size)
+        params = jax.tree.unflatten(
+            jax.tree.structure(
+                tree_unflatten_from_vector(
+                    jnp.zeros(self._dim), self._treedef, self._shapes
+                )
+            ),
+            leaves,
+        )
+        self.trainer.set_model_params(params)
+        with self._lock:
+            self._pending_survivors = None
+
+        # 1. local training
+        self.args.round_idx = self.round_idx
+        x, y, n = self.ds.client_shard(self.client_index)
+        self.trainer.train((x, y, n), None, self.args)
+        vec, _, _ = tree_flatten_to_vector(self.trainer.get_model_params())
+        quantized = lsa.quantize_to_field(np.asarray(vec), self.q_bits)
+
+        # 2. mask + shares
+        rng = np.random.RandomState(
+            (int(getattr(self.args, "random_seed", 0)) * 7919 + self.round_idx)
+            * 104729 + self.client_index
+        )
+        z, shares = lsa.mask_encoding(self._dim, self.N, self.U, self.T, rng)
+        self._local_mask = z
+        share_msg = Message(LSAMessage.MSG_TYPE_C2S_MASK_SHARES, self.rank, 0)
+        share_msg.add(LSAMessage.ARG_ROUND_IDX, self.round_idx)
+        share_msg.set_arrays([shares])  # [N, m]; server routes row j → rank j+1
+        self.send_message(share_msg)
+
+        # 3. masked model upload
+        masked = np.asarray(
+            lsa.model_masking(
+                jnp.asarray(quantized, jnp.int32),
+                jnp.asarray(np.resize(z, self._dim), jnp.int32),
+            )
+        )
+        up = Message(LSAMessage.MSG_TYPE_C2S_MASKED_MODEL, self.rank, 0)
+        up.add(LSAMessage.ARG_ROUND_IDX, self.round_idx)
+        up.add(LSAMessage.ARG_NUM_SAMPLES, float(n))
+        up.set_arrays([masked])
+        self.send_message(up)
+
+    def _on_forward_share(self, msg: Message) -> None:
+        """Shares are buffered per (round, src): transports (gRPC) don't
+        guarantee cross-sender ordering, so a share for round r+1 may arrive
+        while this client is still finishing round r."""
+        src = int(msg.get(LSAMessage.ARG_SRC_CLIENT))
+        rnd = int(msg.get(LSAMessage.ARG_ROUND_IDX, 0))
+        with self._lock:
+            self._received_shares[(rnd, src)] = msg.get_arrays()[0]
+            pending = self._pending_survivors
+        if pending is not None:
+            self._try_send_agg(pending)
+
+    def _on_request_agg(self, msg: Message) -> None:
+        survivors = list(msg.get(LSAMessage.ARG_SURVIVORS))
+        with self._lock:
+            self._pending_survivors = survivors
+        self._try_send_agg(survivors)
+
+    def _try_send_agg(self, survivors) -> None:
+        with self._lock:
+            rnd = self.round_idx
+            if not all((rnd, s) in self._received_shares for s in survivors):
+                return  # wait for outstanding forwards
+            agg = lsa.aggregate_shares(
+                [self._received_shares[(rnd, s)] for s in survivors]
+            )
+            # prune older rounds
+            self._received_shares = {
+                k: v for k, v in self._received_shares.items() if k[0] >= rnd
+            }
+            self._pending_survivors = None
+        out = Message(LSAMessage.MSG_TYPE_C2S_AGG_SHARES, self.rank, 0)
+        out.add(LSAMessage.ARG_ROUND_IDX, self.round_idx)
+        out.set_arrays([agg])
+        self.send_message(out)
+
+    def _on_finish(self, msg: Message) -> None:
+        leaves = [jnp.asarray(a) for a in msg.get_arrays()]
+        if self._treedef is not None:
+            skeleton = tree_unflatten_from_vector(
+                jnp.zeros(self._dim), self._treedef, self._shapes
+            )
+            self.trainer.set_model_params(
+                jax.tree.unflatten(jax.tree.structure(skeleton), leaves)
+            )
+        self.done.set()
+        self.finish()

@@ -1,0 +1,28 @@
+"""LightSecAgg message protocol.
+
+reference: ``cross_silo/lightsecagg/lsa_message_define.py:2-13`` — the
+documented message order (init → mask shares → forward → masked models →
+aggregate-share request → reconstruction). Names kept close to the reference.
+"""
+
+
+class LSAMessage:
+    MSG_TYPE_CONNECTION_IS_READY = "connection_ready"
+    MSG_TYPE_C2S_CLIENT_STATUS = "c2s_client_status"
+
+    MSG_TYPE_S2C_INIT_CONFIG = "lsa_s2c_init_config"
+    MSG_TYPE_C2S_MASK_SHARES = "lsa_c2s_mask_shares"  # client → server (to fwd)
+    MSG_TYPE_S2C_FORWARD_SHARE = "lsa_s2c_forward_share"  # server fwd i→j
+    MSG_TYPE_C2S_MASKED_MODEL = "lsa_c2s_masked_model"
+    MSG_TYPE_S2C_REQUEST_AGG_SHARES = "lsa_s2c_request_agg_shares"
+    MSG_TYPE_C2S_AGG_SHARES = "lsa_c2s_agg_shares"
+    MSG_TYPE_S2C_SYNC_MODEL = "lsa_s2c_sync_model"
+    MSG_TYPE_S2C_FINISH = "lsa_s2c_finish"
+
+    ARG_ROUND_IDX = "round_idx"
+    ARG_CLIENT_INDEX = "client_idx"
+    ARG_NUM_SAMPLES = "num_samples"
+    ARG_SRC_CLIENT = "src_client"
+    ARG_SURVIVORS = "survivors"
+    ARG_CLIENT_STATUS = "client_status"
+    STATUS_ONLINE = "ONLINE"

@@ -1,0 +1,191 @@
+"""LightSecAgg server FSM.
+
+reference: ``cross_silo/lightsecagg/LightSecAggAggregator`` + server manager
+(337 + ~400 LoC). The server only ever sees masked models and coded shares:
+it routes clients' share rows, collects masked models, announces the survivor
+set, decodes Σz from U aggregate shares, and unmasks the sum — then
+dequantizes and averages. Dropout tolerance: any U of N clients suffice
+(the one fault-tolerance mechanism the reference framework has; SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import constants
+from ...core.distributed import FedMLCommManager, Message
+from ...core.mpc import lightsecagg as lsa
+from ...ml.evaluate import make_eval_fn
+from ...utils.tree import tree_flatten_to_vector, tree_unflatten_from_vector
+from .lsa_message_define import LSAMessage
+
+logger = logging.getLogger(__name__)
+
+
+class LightSecAggServerManager(FedMLCommManager):
+    def __init__(self, args, aggregator, comm=None, rank=0, size=0,
+                 backend=constants.COMM_BACKEND_LOOPBACK, dataset=None,
+                 model=None):
+        super().__init__(args, comm, rank, size, backend)
+        self.aggregator = aggregator
+        self.ds = dataset
+        self.bundle = model
+        self.round_num = int(args.comm_round)
+        self.round_idx = 0
+        self.N = size - 1
+        self.T = int(getattr(args, "lsa_privacy_threshold", max(1, (self.N - 1) // 2)))
+        self.U = int(getattr(args, "lsa_target_survivors",
+                             self.T + 1 if self.T + 1 <= self.N else self.N))
+        self.q_bits = int(getattr(args, "lsa_quantize_bits", 8))
+        self.global_params = (
+            aggregator.get_model_params()
+            if aggregator.get_model_params() is not None
+            else model.init(jax.random.PRNGKey(int(args.random_seed)))
+        )
+        vec, self._treedef, self._shapes = tree_flatten_to_vector(self.global_params)
+        self._dim = int(vec.size)
+        self._online = set()
+        self._init_sent = False
+        self._masked: Dict[int, np.ndarray] = {}
+        self._agg_shares: Dict[int, np.ndarray] = {}
+        self._survivors: Optional[list] = None
+        self._request_sent = False
+        self._lock = threading.Lock()
+        self.final_metrics: Optional[dict] = None
+        self.done = threading.Event()
+
+    def register_message_receive_handlers(self) -> None:
+        reg = self.register_message_receive_handler
+        reg(LSAMessage.MSG_TYPE_CONNECTION_IS_READY, lambda m: None)
+        reg(LSAMessage.MSG_TYPE_C2S_CLIENT_STATUS, self._on_status)
+        reg(LSAMessage.MSG_TYPE_C2S_MASK_SHARES, self._on_mask_shares)
+        reg(LSAMessage.MSG_TYPE_C2S_MASKED_MODEL, self._on_masked_model)
+        reg(LSAMessage.MSG_TYPE_C2S_AGG_SHARES, self._on_agg_shares)
+
+    # -- barrier → init ------------------------------------------------------
+    def _on_status(self, msg: Message) -> None:
+        with self._lock:
+            self._online.add(msg.get_sender_id())
+            ready = len(self._online) == self.N and not self._init_sent
+            if ready:
+                self._init_sent = True
+        if ready:
+            self._broadcast_model(LSAMessage.MSG_TYPE_S2C_INIT_CONFIG)
+
+    def _broadcast_model(self, msg_type: str) -> None:
+        leaves = [np.asarray(l) for l in jax.tree.leaves(self.global_params)]
+        for r in range(1, self.size):
+            m = Message(msg_type, self.rank, r)
+            m.add(LSAMessage.ARG_ROUND_IDX, self.round_idx)
+            m.add(LSAMessage.ARG_CLIENT_INDEX, r - 1)
+            m.set_arrays(leaves)
+            self.send_message(m)
+
+    def _is_stale(self, msg: Message) -> bool:
+        """Drop messages from a previous round (a slow client's duplicate
+        agg-share must not pollute the next round's state)."""
+        return int(msg.get(LSAMessage.ARG_ROUND_IDX, -1)) != self.round_idx
+
+    # -- share routing -------------------------------------------------------
+    def _on_mask_shares(self, msg: Message) -> None:
+        """Forward row j of client i's share matrix to client j."""
+        if self._is_stale(msg):
+            return
+        src = msg.get_sender_id() - 1  # 0-based client index
+        shares = msg.get_arrays()[0]  # [N, m]
+        for j in range(self.N):
+            fwd = Message(LSAMessage.MSG_TYPE_S2C_FORWARD_SHARE, self.rank, j + 1)
+            fwd.add(LSAMessage.ARG_SRC_CLIENT, src)
+            fwd.add(LSAMessage.ARG_ROUND_IDX, self.round_idx)
+            fwd.set_arrays([shares[j]])
+            self.send_message(fwd)
+
+    # -- masked model collection --------------------------------------------
+    def _on_masked_model(self, msg: Message) -> None:
+        if self._is_stale(msg):
+            return
+        with self._lock:
+            self._masked[msg.get_sender_id() - 1] = msg.get_arrays()[0]
+            # survivors = every client whose masked model arrived; round
+            # proceeds once all N (or at least U after a dropout) are in
+            ready = len(self._masked) >= self.N and not self._request_sent
+            if ready:
+                self._request_sent = True
+                self._survivors = sorted(self._masked.keys())
+        if ready:
+            self._request_agg_shares()
+
+    def _request_agg_shares(self) -> None:
+        for r in range(1, self.size):
+            m = Message(LSAMessage.MSG_TYPE_S2C_REQUEST_AGG_SHARES, self.rank, r)
+            m.add(LSAMessage.ARG_SURVIVORS, self._survivors)
+            m.add(LSAMessage.ARG_ROUND_IDX, self.round_idx)
+            self.send_message(m)
+
+    # -- reconstruction ------------------------------------------------------
+    def _on_agg_shares(self, msg: Message) -> None:
+        if self._is_stale(msg):
+            return
+        with self._lock:
+            self._agg_shares[msg.get_sender_id() - 1] = msg.get_arrays()[0]
+            ready = len(self._agg_shares) >= self.U
+            if ready and self._survivors is None:
+                ready = False
+        if ready:
+            self._reconstruct_and_advance()
+
+    def _reconstruct_and_advance(self) -> None:
+        with self._lock:
+            if self._survivors is None:
+                return
+            survivors = list(self._survivors)
+            responders = sorted(self._agg_shares.keys())[: self.U]
+            agg_shares = [self._agg_shares[r] for r in responders]
+            masked = [self._masked[s] for s in survivors]
+            self._survivors = None
+            self._masked = {}
+            self._agg_shares = {}
+            self._request_sent = False
+
+        # Σ masked models over survivors (field), Σ z via LCC decode, unmask
+        masked_sum = np.zeros(self._dim, np.int64)
+        for mvec in masked:
+            masked_sum = (masked_sum + mvec.astype(np.int64)) % lsa.FIELD_P
+        survivor_points = [s + 1 for s in responders]  # α_j = rank index
+        mask_sum = lsa.decode_aggregate_mask(
+            agg_shares, survivor_points, self._dim, self.N, self.U, self.T
+        )
+        clear = np.asarray(
+            lsa.model_unmasking(
+                jnp.asarray(masked_sum % lsa.FIELD_P, jnp.int32),
+                jnp.asarray(mask_sum % lsa.FIELD_P, jnp.int32),
+            )
+        )
+        avg = lsa.dequantize_from_field(clear, self.q_bits) / max(len(masked), 1)
+        self.global_params = tree_unflatten_from_vector(
+            jnp.asarray(avg), self._treedef, self._shapes
+        )
+        self.aggregator.set_model_params(self.global_params)
+
+        if self.ds is not None:
+            self.final_metrics = make_eval_fn(self.bundle)(
+                self.global_params, self.ds.test_x, self.ds.test_y
+            )
+            logger.info(
+                "lsa round %d: acc=%.4f", self.round_idx,
+                self.final_metrics["test_acc"],
+            )
+
+        self.round_idx += 1
+        if self.round_idx < self.round_num:
+            self._broadcast_model(LSAMessage.MSG_TYPE_S2C_SYNC_MODEL)
+        else:
+            self._broadcast_model(LSAMessage.MSG_TYPE_S2C_FINISH)
+            self.done.set()
+            self.finish()

@@ -1,0 +1,26 @@
+"""Cross-silo message protocol constants.
+
+reference: ``cross_silo/server/message_define.py`` / ``client/message_define.py``
+(S2C_INIT / S2C_SYNC / C2S_SEND / status messages) — FSM documented at
+SURVEY.md §3.4.
+"""
+
+
+class MyMessage:
+    MSG_TYPE_CONNECTION_IS_READY = "connection_ready"
+
+    MSG_TYPE_C2S_CLIENT_STATUS = "c2s_client_status"
+    MSG_TYPE_C2S_SEND_MODEL_TO_SERVER = "c2s_send_model_to_server"
+
+    MSG_TYPE_S2C_INIT_CONFIG = "s2c_init_config"
+    MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT = "s2c_sync_model_to_client"
+    MSG_TYPE_S2C_FINISH = "s2c_finish"
+
+    MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
+    MSG_ARG_KEY_ROUND_IDX = "round_idx"
+    MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
+    MSG_ARG_KEY_CLIENT_STATUS = "client_status"
+    MSG_ARG_KEY_TRAIN_LOSS = "train_loss"
+
+    CLIENT_STATUS_ONLINE = "ONLINE"
+    CLIENT_STATUS_OFFLINE = "OFFLINE"

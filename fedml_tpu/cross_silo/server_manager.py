@@ -1,0 +1,166 @@
+"""Cross-silo FL server: aggregator + message FSM.
+
+reference: ``cross_silo/server/fedml_server_manager.py`` (276 LoC) +
+``fedml_aggregator.py`` (248 LoC); FSM at SURVEY.md §3.4:
+CONNECTION_READY → wait for ONLINE from all selected clients → S2C_INIT with
+the global model → collect C2S models → aggregate (attack/defense/DP hook
+order preserved) → eval → S2C_SYNC … → S2C_FINISH.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import constants
+from ..core.aggregate import stack_trees, weighted_average
+from ..core.distributed import FedMLCommManager, Message
+from ..core.dp import FedPrivacyMechanism
+from ..core.security.defender import FedMLDefender
+from ..ml.evaluate import make_eval_fn
+from ..utils.tree import tree_flatten_to_vector, tree_unflatten_from_vector
+from .message_define import MyMessage
+
+logger = logging.getLogger(__name__)
+
+
+class FedMLServerManager(FedMLCommManager):
+    def __init__(self, args, aggregator, comm=None, rank=0, size=0,
+                 backend=constants.COMM_BACKEND_LOOPBACK, dataset=None,
+                 model=None):
+        super().__init__(args, comm, rank, size, backend)
+        self.aggregator = aggregator
+        self.ds = dataset
+        self.bundle = model
+        self.round_num = int(args.comm_round)
+        self.round_idx = 0
+        self.client_num = self.size - 1
+        self._online = set()
+        self._models: Dict[int, tuple] = {}
+        self._lock = threading.Lock()
+        self._init_sent = False
+        self.global_params = (
+            aggregator.get_model_params()
+            if aggregator.get_model_params() is not None
+            else model.init(jax.random.PRNGKey(int(args.random_seed)))
+        )
+        self.aggregator.set_model_params(self.global_params)
+        _, self._treedef, self._shapes = tree_flatten_to_vector(self.global_params)
+        self.defender = FedMLDefender.get_instance()
+        self.defender.init(args)
+        self.dp = (
+            FedPrivacyMechanism.from_args(args)
+            if bool(getattr(args, "enable_dp", False))
+            else None
+        )
+        self.final_metrics: Optional[dict] = None
+        self.done = threading.Event()
+
+    # -- FSM ----------------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_CONNECTION_IS_READY, self._on_connection_ready
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self._on_client_status
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self._on_model_received
+        )
+
+    def _on_connection_ready(self, msg: Message) -> None:
+        logger.info("server: connection ready")
+
+    def _on_client_status(self, msg: Message) -> None:
+        status = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS)
+        with self._lock:
+            if status == MyMessage.CLIENT_STATUS_ONLINE:
+                self._online.add(msg.get_sender_id())
+            ready = len(self._online) == self.client_num and not self._init_sent
+            if ready:
+                self._init_sent = True
+        if ready:
+            self._send_init_msg()
+
+    def _send_init_msg(self) -> None:
+        """reference: fedml_server_manager.py:93-118 (online barrier → init)."""
+        leaves = [np.asarray(l) for l in jax.tree.leaves(self.global_params)]
+        for client_rank in range(1, self.size):
+            msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, client_rank)
+            msg.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
+            msg.add(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, client_rank - 1)
+            msg.set_arrays(leaves)
+            self.send_message(msg)
+        logger.info("server: init sent to %d clients", self.client_num)
+
+    def _on_model_received(self, msg: Message) -> None:
+        sender = msg.get_sender_id()
+        leaves = [jnp.asarray(a) for a in msg.get_arrays()]
+        params = jax.tree.unflatten(
+            jax.tree.structure(self.global_params), leaves
+        )
+        n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0))
+        with self._lock:
+            self._models[sender] = (n, params)
+            have_all = len(self._models) == self.client_num
+        if have_all:
+            self._finish_round()
+
+    def _finish_round(self) -> None:
+        raw = [self._models[r] for r in sorted(self._models)]
+        self._models.clear()
+        raw = self.aggregator.on_before_aggregation(raw)
+        weights = jnp.asarray([n for n, _ in raw])
+        stacked = stack_trees([p for _, p in raw])
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(int(getattr(self.args, "random_seed", 0))),
+            self.round_idx,
+        )
+        if self.defender.is_defense_enabled():
+            gvec, treedef, shapes = tree_flatten_to_vector(self.global_params)
+            flat = jax.vmap(lambda t: tree_flatten_to_vector(t)[0])(stacked)
+            agg_vec = self.defender.defend(flat, weights, gvec, rng)
+            agg = tree_unflatten_from_vector(agg_vec, treedef, shapes)
+        else:
+            agg = weighted_average(stacked, weights)
+        if self.dp is not None and self.dp.dp_type == "cdp":
+            agg = self.dp.randomize_global(agg, jax.random.fold_in(rng, 7))
+        agg = self.aggregator.on_after_aggregation(agg)
+        self.global_params = agg
+        self.aggregator.set_model_params(agg)
+
+        if self.ds is not None:
+            freq = max(int(getattr(self.args, "frequency_of_the_test", 1)), 1)
+            if self.round_idx % freq == 0 or self.round_idx == self.round_num - 1:
+                self.final_metrics = make_eval_fn(self.bundle)(
+                    agg, self.ds.test_x, self.ds.test_y
+                )
+                logger.info(
+                    "server round %d: acc=%.4f", self.round_idx,
+                    self.final_metrics["test_acc"],
+                )
+
+        self.round_idx += 1
+        leaves = [np.asarray(l) for l in jax.tree.leaves(self.global_params)]
+        if self.round_idx < self.round_num:
+            for client_rank in range(1, self.size):
+                msg = Message(
+                    MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank,
+                    client_rank,
+                )
+                msg.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
+                msg.set_arrays(leaves)
+                self.send_message(msg)
+        else:
+            for client_rank in range(1, self.size):
+                msg = Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, client_rank)
+                msg.set_arrays(leaves)
+                self.send_message(msg)
+            logger.info("server: training finished after %d rounds", self.round_num)
+            self.done.set()
+            self.finish()

@@ -1,0 +1,264 @@
+"""Dataset registry: shapes, class counts, and sources.
+
+Mirrors the catalogue handled by the reference's dispatch
+(``python/fedml/data/data_loader.py:30-330``): MNIST, FEMNIST, shakespeare
+(LEAF + Google), fed_cifar100, stackoverflow lr/nwp, CIFAR-10/100, CINIC-10,
+ImageNet, Landmarks. Two sources per dataset:
+
+- **on-disk real data** in ``args.data_cache_dir`` (MNIST IDX files, CIFAR
+  python pickles) — used when present;
+- **deterministic synthetic fallback** with the real shapes/class counts —
+  class-conditional Gaussian images and Markov-chain token streams, so models
+  *learn* (convergence tests are meaningful) without any network egress.
+  The reference instead auto-downloads (``data/mnist/data_loader.py``
+  ``download_mnist``, S3 URL at ``data/constants.py:24``); a TPU pod build
+  cannot assume egress, so synthetic-by-default is a deliberate change.
+"""
+
+from __future__ import annotations
+
+import gzip
+import logging
+import os
+import pickle
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class DatasetSpec:
+    name: str
+    sample_shape: Tuple[int, ...]
+    class_num: int
+    task: str  # classification | nwp | tagpred
+    default_clients: int
+    train_per_client: int  # synthetic samples per client
+    test_total: int
+    vocab_size: int = 0  # text tasks
+    seq_len: int = 0
+
+
+REGISTRY = {
+    # vision
+    "synthetic": DatasetSpec("synthetic", (60,), 10, "classification", 30, 40, 400),
+    "mnist": DatasetSpec("mnist", (28, 28, 1), 10, "classification", 1000, 60, 2000),
+    "femnist": DatasetSpec("femnist", (28, 28, 1), 62, "classification", 200, 100, 4000),
+    "cifar10": DatasetSpec("cifar10", (32, 32, 3), 10, "classification", 100, 500, 2000),
+    "cifar100": DatasetSpec("cifar100", (32, 32, 3), 100, "classification", 100, 500, 2000),
+    "cinic10": DatasetSpec("cinic10", (32, 32, 3), 10, "classification", 100, 500, 2000),
+    "fed_cifar100": DatasetSpec(
+        "fed_cifar100", (32, 32, 3), 100, "classification", 500, 100, 2000
+    ),
+    "ILSVRC2012": DatasetSpec(
+        "ILSVRC2012", (224, 224, 3), 1000, "classification", 100, 16, 256
+    ),
+    "gld23k": DatasetSpec("gld23k", (224, 224, 3), 203, "classification", 233, 16, 256),
+    "gld160k": DatasetSpec("gld160k", (224, 224, 3), 2028, "classification", 100, 16, 256),
+    # text — char LM (LEAF shakespeare vocab: 80 printable chars + pad,
+    # reference model/nlp/rnn.py RNN_OriginalFedAvg embeds 90)
+    "shakespeare": DatasetSpec(
+        "shakespeare", (80,), 90, "nwp", 100, 50, 500, vocab_size=90, seq_len=80
+    ),
+    "fed_shakespeare": DatasetSpec(
+        "fed_shakespeare", (80,), 90, "nwp", 100, 50, 500, vocab_size=90, seq_len=80
+    ),
+    "stackoverflow_nwp": DatasetSpec(
+        "stackoverflow_nwp", (20,), 10004, "nwp", 200, 50, 500, vocab_size=10004, seq_len=20
+    ),
+    # multilabel bag-of-words tag prediction (10k vocab → 500 tags)
+    "stackoverflow_lr": DatasetSpec(
+        "stackoverflow_lr", (10000,), 500, "tagpred", 200, 30, 400
+    ),
+    # adversarial-FL fixture (reference: data/edge_case_examples) — plain
+    # CIFAR-10 shapes; poisoning is applied by the attack layer, not the data.
+    "edge_case_examples": DatasetSpec(
+        "edge_case_examples", (32, 32, 3), 10, "classification", 100, 200, 1000
+    ),
+}
+
+
+# ---------------------------------------------------------------------------
+# Real on-disk loaders (no downloads; used when files are already cached)
+# ---------------------------------------------------------------------------
+def _read_idx(path: str) -> Optional[np.ndarray]:
+    opener = gzip.open if path.endswith(".gz") else open
+    try:
+        with opener(path, "rb") as f:
+            magic = int.from_bytes(f.read(4), "big")
+            ndim = magic & 0xFF
+            dims = [int.from_bytes(f.read(4), "big") for _ in range(ndim)]
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+            return data.reshape(dims)
+    except (OSError, ValueError):
+        return None
+
+
+def try_load_mnist(cache_dir: str):
+    """MNIST from standard IDX files if present under ``cache_dir/MNIST`` or
+    ``cache_dir`` (reference auto-downloads these; we only read)."""
+    names = {
+        "train_x": ("train-images-idx3-ubyte", "train-images.idx3-ubyte"),
+        "train_y": ("train-labels-idx1-ubyte", "train-labels.idx1-ubyte"),
+        "test_x": ("t10k-images-idx3-ubyte", "t10k-images.idx3-ubyte"),
+        "test_y": ("t10k-labels-idx1-ubyte", "t10k-labels.idx1-ubyte"),
+    }
+    out = {}
+    for key, candidates in names.items():
+        arr = None
+        for base in candidates:
+            for sub in ("", "MNIST", "mnist"):
+                for ext in ("", ".gz"):
+                    p = os.path.join(cache_dir, sub, base + ext)
+                    if os.path.exists(p):
+                        arr = _read_idx(p)
+                        break
+                if arr is not None:
+                    break
+            if arr is not None:
+                break
+        if arr is None:
+            return None
+        out[key] = arr
+    tx = out["train_x"].astype(np.float32)[..., None] / 255.0
+    ex = out["test_x"].astype(np.float32)[..., None] / 255.0
+    return tx, out["train_y"].astype(np.int32), ex, out["test_y"].astype(np.int32)
+
+
+def try_load_cifar(cache_dir: str, name: str):
+    """CIFAR-10/100 from the standard python pickle batches if present."""
+    if name == "cifar10":
+        sub, train_files, test_file, label_key = (
+            "cifar-10-batches-py",
+            [f"data_batch_{i}" for i in range(1, 6)],
+            "test_batch",
+            b"labels",
+        )
+    else:
+        sub, train_files, test_file, label_key = (
+            "cifar-100-python",
+            ["train"],
+            "test",
+            b"fine_labels",
+        )
+    root = os.path.join(cache_dir, sub)
+    if not os.path.isdir(root):
+        return None
+    try:
+        xs, ys = [], []
+        for fn in train_files:
+            with open(os.path.join(root, fn), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(d[b"data"])
+            ys.extend(d[label_key])
+        with open(os.path.join(root, test_file), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        tx = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        ex = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return (
+            tx.astype(np.float32) / 255.0,
+            np.asarray(ys, dtype=np.int32),
+            ex.astype(np.float32) / 255.0,
+            np.asarray(d[label_key], dtype=np.int32),
+        )
+    except (OSError, KeyError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Synthetic generators (deterministic, learnable)
+# ---------------------------------------------------------------------------
+def synth_classification(spec: DatasetSpec, n_train: int, n_test: int, seed: int):
+    """Class-conditional Gaussian data: x = prototype[y] + noise.
+
+    Linearly separable enough that LR/CNN/ResNet reach high accuracy —
+    preserving the reference's "tiny-config real training" smoke pattern
+    (SURVEY.md §4) without downloads.
+    """
+    rng = np.random.RandomState(seed)
+    dim = int(np.prod(spec.sample_shape))
+    protos = rng.randn(spec.class_num, dim).astype(np.float32)
+
+    def make(n, rng):
+        y = rng.randint(0, spec.class_num, size=n).astype(np.int32)
+        x = protos[y] * 0.5 + rng.randn(n, dim).astype(np.float32) * 0.8
+        return x.reshape((n,) + spec.sample_shape), y
+
+    tx, ty = make(n_train, rng)
+    ex, ey = make(n_test, rng)
+    return tx, ty, ex, ey
+
+
+def synth_tagpred(spec: DatasetSpec, n_train: int, n_test: int, seed: int):
+    """Multilabel bag-of-words: sparse count vectors, tags linearly linked to
+    active vocabulary blocks (stackoverflow_lr analog)."""
+    rng = np.random.RandomState(seed)
+    dim = spec.sample_shape[0]
+    proj = rng.randn(dim, spec.class_num).astype(np.float32) * 0.3
+
+    def make(n, rng):
+        x = (rng.rand(n, dim) < (8.0 / dim)).astype(np.float32) * (
+            1.0 + rng.rand(n, dim).astype(np.float32)
+        )
+        logits = x @ proj
+        thresh = np.quantile(logits, 0.99, axis=1, keepdims=True)
+        y = (logits >= thresh).astype(np.float32)
+        return x, y
+
+    tx, ty = make(n_train, rng)
+    ex, ey = make(n_test, rng)
+    return tx, ty, ex, ey
+
+
+def synth_nwp(spec: DatasetSpec, n_train: int, n_test: int, seed: int):
+    """Token sequences from a peaked Markov chain over the real vocab size, so
+    next-word prediction is learnable well above chance."""
+    rng = np.random.RandomState(seed)
+    V, L = spec.vocab_size, spec.seq_len
+    # each token has a handful of likely successors
+    succ = rng.randint(0, V, size=(V, 4))
+
+    def make(n, rng):
+        seqs = np.zeros((n, L), dtype=np.int32)
+        tok = rng.randint(0, V, size=n)
+        for t in range(L):
+            seqs[:, t] = tok
+            choice = rng.randint(0, 4, size=n)
+            follow = succ[tok, choice]
+            rand = rng.randint(0, V, size=n)
+            use_rand = rng.rand(n) < 0.1
+            tok = np.where(use_rand, rand, follow)
+        return seqs
+
+    tx = make(n_train, rng)
+    ex = make(n_test, rng)
+    # y = x shifted left (predict next token); last target = 0 (masked pad id 0)
+    def shift(x):
+        y = np.zeros_like(x)
+        y[:, :-1] = x[:, 1:]
+        return y
+
+    return tx, shift(tx), ex, shift(ex)
+
+
+def load_raw(spec: DatasetSpec, cache_dir: str, n_train: int, n_test: int, seed: int):
+    """Real data if cached on disk, else synthetic with identical shapes."""
+    if spec.name == "mnist":
+        real = try_load_mnist(cache_dir)
+        if real is not None:
+            logger.info("mnist: using real IDX files from %s", cache_dir)
+            return real
+    if spec.name in ("cifar10", "cifar100"):
+        real = try_load_cifar(cache_dir, spec.name)
+        if real is not None:
+            logger.info("%s: using real pickle batches from %s", spec.name, cache_dir)
+            return real
+    logger.info("%s: synthetic fallback (%d train / %d test)", spec.name, n_train, n_test)
+    if spec.task == "classification":
+        return synth_classification(spec, n_train, n_test, seed)
+    if spec.task == "tagpred":
+        return synth_tagpred(spec, n_train, n_test, seed)
+    return synth_nwp(spec, n_train, n_test, seed)

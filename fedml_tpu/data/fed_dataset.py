@@ -1,0 +1,109 @@
+"""Federated dataset container with a TPU-native packed layout.
+
+The reference's ``fedml.data.load`` (``python/fedml/data/data_loader.py:30-330``)
+returns an 8-tuple of torch DataLoader dicts keyed by client index. A dict of
+ragged per-client loaders cannot live in HBM or under ``jit``; here the whole
+federation is three dense arrays —
+
+    train_x      [clients, cap, ...]   per-client samples, zero-padded
+    train_y      [clients, cap, ...]
+    train_counts [clients]             true sample counts (mask = iota < count)
+
+— so a round's cohort is a gather over the leading axis, local training is
+``vmap`` over it, and the same arrays shard directly over a ``clients`` mesh
+axis (SURVEY.md §7 "Heterogeneous per-client data residency").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class FedDataset:
+    """Packed federated dataset.
+
+    ``task`` ∈ {"classification", "nwp", "tagpred"} selects loss/metric
+    semantics downstream (reference analog: create_model_trainer dispatch,
+    ``ml/trainer/trainer_creator.py:6-13``).
+    """
+
+    train_x: np.ndarray
+    train_y: np.ndarray
+    train_counts: np.ndarray
+    test_x: np.ndarray
+    test_y: np.ndarray
+    class_num: int
+    task: str = "classification"
+    # Optional per-client test shards (reference keeps test_data_local_dict);
+    # global test set above is what the headline metrics use.
+    test_local_x: Optional[np.ndarray] = None
+    test_local_y: Optional[np.ndarray] = None
+    test_local_counts: Optional[np.ndarray] = None
+    # vocab etc. for text tasks
+    meta: Dict = field(default_factory=dict)
+
+    @property
+    def client_num(self) -> int:
+        return int(self.train_x.shape[0])
+
+    @property
+    def cap(self) -> int:
+        """Per-client sample capacity (padded length)."""
+        return int(self.train_x.shape[1])
+
+    @property
+    def train_data_num(self) -> int:
+        return int(self.train_counts.sum())
+
+    @property
+    def test_data_num(self) -> int:
+        return int(self.test_x.shape[0])
+
+    def client_shard(self, idx: int) -> Tuple[np.ndarray, np.ndarray, int]:
+        return self.train_x[idx], self.train_y[idx], int(self.train_counts[idx])
+
+    def as_reference_tuple(self):
+        """The reference's 8-tuple shape (data_loader.py:318-330), with arrays
+        in place of DataLoaders, for users migrating call sites."""
+        train_data_local_dict = {
+            i: (self.train_x[i], self.train_y[i]) for i in range(self.client_num)
+        }
+        train_data_local_num_dict = {
+            i: int(self.train_counts[i]) for i in range(self.client_num)
+        }
+        test_data_local_dict = (
+            {
+                i: (self.test_local_x[i], self.test_local_y[i])
+                for i in range(self.client_num)
+            }
+            if self.test_local_x is not None
+            else {}
+        )
+        return (
+            self.train_data_num,
+            self.test_data_num,
+            (self.train_x.reshape((-1,) + self.train_x.shape[2:]), None),
+            (self.test_x, self.test_y),
+            train_data_local_num_dict,
+            train_data_local_dict,
+            test_data_local_dict,
+            self.class_num,
+        )
+
+
+def pad_cap_to_batch_multiple(ds: FedDataset, batch_size: int) -> FedDataset:
+    """Grow the packed capacity to a multiple of ``batch_size`` so the training
+    loop's batch grid is exact (static shapes; masked tails)."""
+    cap = ds.cap
+    new_cap = int(-(-cap // batch_size) * batch_size)
+    if new_cap == cap:
+        return ds
+    pad = [(0, 0), (0, new_cap - cap)] + [(0, 0)] * (ds.train_x.ndim - 2)
+    ds.train_x = np.pad(ds.train_x, pad)
+    pad_y = [(0, 0), (0, new_cap - cap)] + [(0, 0)] * (ds.train_y.ndim - 2)
+    ds.train_y = np.pad(ds.train_y, pad_y)
+    return ds

@@ -1,0 +1,21 @@
+"""``fedml_tpu.ml`` — trainers, aggregators, losses, optimizers, eval."""
+
+from .aggregator import DefaultServerAggregator, create_server_aggregator
+from .evaluate import make_eval_fn
+from .local_train import make_grad_fn, make_local_train_fn
+from .losses import get_loss_fn
+from .optimizer import create_client_optimizer, create_server_optimizer
+from .trainer import ModelTrainer, create_model_trainer
+
+__all__ = [
+    "DefaultServerAggregator",
+    "create_server_aggregator",
+    "make_eval_fn",
+    "make_grad_fn",
+    "make_local_train_fn",
+    "get_loss_fn",
+    "create_client_optimizer",
+    "create_server_optimizer",
+    "ModelTrainer",
+    "create_model_trainer",
+]

@@ -1,0 +1,27 @@
+"""Concrete server aggregators + factory.
+
+reference: ``python/fedml/ml/aggregator/`` — DefaultServerAggregator and
+per-task variants (``my_server_aggregator_nwp.py`` etc.), factory at
+``aggregator_creator.py:6-14``. Aggregation itself is the jit'd kernel in
+``core/aggregate.py``; this class adds the test logic + hook points that the
+attack/defense layer intercepts.
+"""
+
+from __future__ import annotations
+
+from ..core.alg_frame import ServerAggregator
+from .evaluate import make_eval_fn
+
+
+class DefaultServerAggregator(ServerAggregator):
+    def __init__(self, model, args=None):
+        super().__init__(model, args)
+        self._eval = make_eval_fn(model)
+
+    def test(self, test_data, device, args):
+        x, y = test_data
+        return self._eval(self.model_params, x, y)
+
+
+def create_server_aggregator(model, args) -> DefaultServerAggregator:
+    return DefaultServerAggregator(model, args)

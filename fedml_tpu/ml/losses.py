@@ -1,0 +1,66 @@
+"""Per-task losses and metrics, all mask-aware.
+
+The reference splits these across per-task trainers
+(``python/fedml/ml/trainer/my_model_trainer_classification.py`` CE loss,
+``my_model_trainer_nwp.py`` next-word CE ignoring pad id 0,
+``my_model_trainer_tag_prediction.py`` multilabel BCE). Here they are pure
+functions over logits so one jit'd trainer serves every task; masks carry the
+padded-cohort semantics (SURVEY.md §7 "Dynamic shapes vs jit").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+import optax
+
+Metrics = Dict[str, jnp.ndarray]
+
+PAD_TOKEN = 0  # nwp pad id (reference masks token 0 in NWP accuracy)
+
+
+def classification_loss(logits, y, sample_mask) -> Tuple[jnp.ndarray, Metrics]:
+    """Masked softmax cross-entropy. logits [B, C], y [B], mask [B]."""
+    per = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+    denom = jnp.maximum(sample_mask.sum(), 1.0)
+    loss = (per * sample_mask).sum() / denom
+    correct = ((jnp.argmax(logits, -1) == y) * sample_mask).sum()
+    return loss, {"loss_sum": per * sample_mask, "correct": correct, "count": sample_mask.sum()}
+
+
+def nwp_loss(logits, y, sample_mask) -> Tuple[jnp.ndarray, Metrics]:
+    """Next-word CE. logits [B, L, V], y [B, L]; pad targets (id 0) ignored."""
+    tok_mask = (y != PAD_TOKEN).astype(jnp.float32) * sample_mask[:, None]
+    per = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+    denom = jnp.maximum(tok_mask.sum(), 1.0)
+    loss = (per * tok_mask).sum() / denom
+    correct = ((jnp.argmax(logits, -1) == y) * tok_mask).sum()
+    return loss, {"loss_sum": per * tok_mask, "correct": correct, "count": tok_mask.sum()}
+
+
+def tagpred_loss(logits, y, sample_mask) -> Tuple[jnp.ndarray, Metrics]:
+    """Multilabel sigmoid BCE. logits [B, C], y [B, C] in {0,1}."""
+    per = optax.sigmoid_binary_cross_entropy(logits, y).mean(-1)
+    denom = jnp.maximum(sample_mask.sum(), 1.0)
+    loss = (per * sample_mask).sum() / denom
+    pred = (logits > 0).astype(jnp.float32)
+    tp = (pred * y).sum(-1)
+    precision = tp / jnp.maximum(pred.sum(-1), 1.0)
+    recall = tp / jnp.maximum(y.sum(-1), 1.0)
+    correct = (2 * precision * recall / jnp.maximum(precision + recall, 1e-8)
+               * sample_mask).sum()  # summed F1, "correct" for uniform metrics
+    return loss, {"loss_sum": per * sample_mask, "correct": correct, "count": sample_mask.sum()}
+
+
+LOSSES = {
+    "classification": classification_loss,
+    "nwp": nwp_loss,
+    "tagpred": tagpred_loss,
+}
+
+
+def get_loss_fn(task: str):
+    if task not in LOSSES:
+        raise ValueError(f"unknown task {task!r}; known: {sorted(LOSSES)}")
+    return LOSSES[task]

@@ -1,0 +1,61 @@
+"""Optax optimizer factories for client and server sides.
+
+Client side replaces the reference's per-trainer torch.optim construction
+(``ml/trainer/my_model_trainer_classification.py:30-45``: SGD or Adam + weight
+decay). Server side replaces the reflection-based ``optrepo``
+(``simulation/sp/fedopt/optrepo.py``) with an explicit registry — the
+FedOpt-family server optimizer steps on the *pseudo-gradient*
+w_global − avg(w_clients) (SURVEY.md §7 "Optimizer-state semantics").
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def create_client_optimizer(args) -> optax.GradientTransformation:
+    name = str(getattr(args, "client_optimizer", "sgd")).lower()
+    lr = float(getattr(args, "learning_rate", 0.03))
+    wd = float(getattr(args, "weight_decay", 0.0))
+    momentum = float(getattr(args, "momentum", 0.0))
+    clip = float(getattr(args, "clip_grad", 0.0))
+
+    if name == "sgd":
+        tx = optax.sgd(lr, momentum=momentum if momentum > 0 else None)
+    elif name == "adam":
+        tx = optax.adam(lr)
+    elif name == "adamw":
+        tx = optax.adamw(lr, weight_decay=wd)
+        wd = 0.0
+    else:
+        raise ValueError(f"unknown client_optimizer {name!r}")
+
+    chain = []
+    if clip > 0:
+        chain.append(optax.clip_by_global_norm(clip))
+    if wd > 0 and name != "adamw":
+        chain.append(optax.add_decayed_weights(wd))
+    chain.append(tx)
+    return optax.chain(*chain) if len(chain) > 1 else tx
+
+
+SERVER_OPTIMIZERS = ("sgd", "adam", "adagrad", "yogi")
+
+
+def create_server_optimizer(args) -> optax.GradientTransformation:
+    """Server optimizer applied to the pseudo-gradient (FedOpt family,
+    Adaptive Federated Optimization: FedAdam / FedAdagrad / FedYogi)."""
+    name = str(getattr(args, "server_optimizer", "sgd")).lower()
+    lr = float(getattr(args, "server_lr", 1.0))
+    momentum = float(getattr(args, "server_momentum", 0.0))
+    if name == "sgd":
+        return optax.sgd(lr, momentum=momentum if momentum > 0 else None)
+    if name == "adam":
+        return optax.adam(lr, b1=0.9, b2=0.99, eps=1e-3)
+    if name == "adagrad":
+        return optax.adagrad(lr)
+    if name == "yogi":
+        return optax.yogi(lr)
+    raise ValueError(
+        f"unknown server_optimizer {name!r}; known: {SERVER_OPTIMIZERS}"
+    )

@@ -1,0 +1,61 @@
+"""Concrete model trainers + factory.
+
+reference: ``python/fedml/ml/trainer/`` — per-task trainers
+(my_model_trainer_classification.py, *_nwp.py, *_tag_prediction.py) and
+``trainer_creator.py:6-13``. One JAX trainer covers all tasks (the task enters
+through the loss fn); it exposes both the imperative ``train`` contract (for
+message-driven runtimes) and the pure ``local_train_fn`` (for SPMD runtimes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..core.alg_frame import ClientTrainer
+from .evaluate import make_eval_fn
+from .local_train import make_local_train_fn
+
+PyTree = Any
+
+
+class ModelTrainer(ClientTrainer):
+    """Default trainer: jit'd masked mini-batch SGD over the packed shard."""
+
+    def __init__(self, model, args=None):
+        super().__init__(model, args)
+        self._jitted = {}
+
+    def _get_fn(self, cap: int):
+        if cap not in self._jitted:
+            self._jitted[cap] = jax.jit(
+                make_local_train_fn(self.model, self.args, cap)
+            )
+        return self._jitted[cap]
+
+    def train(self, train_data, device, args) -> Dict[str, Any]:
+        """train_data = (x [cap, ...], y [cap, ...], n) for this client."""
+        x, y, n = train_data
+        cap = int(x.shape[0])
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(int(getattr(args, "random_seed", 0))),
+            int(getattr(args, "round_idx", 0)) * 100003 + self.id,
+        )
+        fn = self._get_fn(cap)
+        params, metrics = fn(
+            self.model_params, jnp.asarray(x), jnp.asarray(y), jnp.asarray(n), rng
+        )
+        self.model_params = params
+        return {k: float(v) for k, v in metrics.items()}
+
+    def test(self, test_data, device, args):
+        x, y = test_data
+        return make_eval_fn(self.model)(self.model_params, x, y)
+
+
+def create_model_trainer(model, args) -> ModelTrainer:
+    """reference: trainer_creator.py:6-13 — dispatch on dataset/task; the
+    single JAX trainer already routes by ``model.task``."""
+    return ModelTrainer(model, args)

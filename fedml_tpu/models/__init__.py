@@ -1,0 +1,120 @@
+"""``fedml_tpu.models`` — model zoo factory.
+
+Public surface mirrors the reference (``fedml.model.create``,
+``python/fedml/model/model_hub.py:20-83``): keyed on ``(args.model,
+args.dataset)``. Returns a :class:`ModelBundle` — the Flax module plus enough
+input metadata to initialise parameters without a dataset in hand.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..data.datasets import REGISTRY as DATA_REGISTRY
+from .layers import MLP
+from .nlp import RNNOriginalFedAvg, RNNStackOverflow
+from .vision import (
+    VGG,
+    VGG11_CFG,
+    VGG16_CFG,
+    CNNDropOut,
+    LogisticRegression,
+    MobileNetV1,
+    MobileNetV2,
+    resnet18_gn,
+    resnet20,
+    resnet56,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["create", "ModelBundle"]
+
+
+@dataclass
+class ModelBundle:
+    """A Flax module + input spec, the unit the trainers consume."""
+
+    module: nn.Module
+    name: str
+    input_shape: Tuple[int, ...]  # per-sample shape (no batch dim)
+    input_dtype: Any = jnp.float32
+    task: str = "classification"
+    meta: dict = field(default_factory=dict)
+
+    def dummy_input(self, batch_size: int = 2) -> jax.Array:
+        if jnp.issubdtype(self.input_dtype, jnp.integer):
+            return jnp.zeros((batch_size,) + self.input_shape, self.input_dtype)
+        return jnp.zeros((batch_size,) + self.input_shape, self.input_dtype)
+
+    def init(self, rng: jax.Array, batch_size: int = 2):
+        return self.module.init(
+            {"params": rng, "dropout": rng}, self.dummy_input(batch_size), train=False
+        )
+
+    def apply(self, params, x, train: bool = False, rngs=None):
+        return self.module.apply(params, x, train=train, rngs=rngs)
+
+    def param_count(self, params) -> int:
+        return sum(int(p.size) for p in jax.tree.leaves(params))
+
+
+def create(args, output_dim: int) -> ModelBundle:
+    """Build a model for ``(args.model, args.dataset)``.
+
+    Name registry follows the reference's dispatch (model_hub.py:20-83):
+    lr, cnn (CNN_DropOut), resnet18_gn, resnet20, resnet56, mobilenet,
+    mobilenet_v2, vgg11/vgg16, rnn (dataset-routed), mlp.
+    """
+    name = str(args.model).lower()
+    dataset = getattr(args, "dataset", "synthetic")
+    spec = DATA_REGISTRY.get(dataset)
+    sample_shape = spec.sample_shape if spec else (60,)
+    task = spec.task if spec else "classification"
+    int_input = task == "nwp"
+
+    if name in ("lr", "logistic_regression"):
+        module: nn.Module = LogisticRegression(output_dim)
+    elif name in ("cnn", "cnn_dropout", "cnn_web"):
+        module = CNNDropOut(output_dim)
+    elif name in ("resnet18_gn", "resnet18"):
+        module = resnet18_gn(output_dim)
+    elif name == "resnet20":
+        module = resnet20(output_dim)
+    elif name == "resnet56":
+        module = resnet56(output_dim)
+    elif name in ("mobilenet", "mobilenet_v1"):
+        module = MobileNetV1(output_dim)
+    elif name in ("mobilenet_v2",):
+        module = MobileNetV2(output_dim)
+    elif name == "vgg11":
+        module = VGG(VGG11_CFG, output_dim)
+    elif name in ("vgg16", "vgg"):
+        module = VGG(VGG16_CFG, output_dim)
+    elif name == "rnn":
+        # dataset-routed like the reference (model_hub.py rnn branches)
+        if dataset in ("stackoverflow_nwp",):
+            module = RNNStackOverflow(vocab_size=output_dim)
+        else:
+            module = RNNOriginalFedAvg(vocab_size=output_dim)
+    elif name == "mlp":
+        module = MLP((128, 64, output_dim))
+    else:
+        raise ValueError(f"unknown model {name!r}")
+
+    bundle = ModelBundle(
+        module=module,
+        name=name,
+        input_shape=tuple(sample_shape),
+        input_dtype=jnp.int32 if int_input else jnp.float32,
+        task=task,
+        meta={"dataset": dataset, "output_dim": output_dim},
+    )
+    logger.info("model: %s for %s (output_dim=%d)", name, dataset, output_dim)
+    return bundle

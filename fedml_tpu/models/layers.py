@@ -1,0 +1,43 @@
+"""Shared building blocks for the model zoo.
+
+Normalisation stance: GroupNorm everywhere. The reference mixes BatchNorm
+(resnet56, mobilenet — ``model/cv/resnet.py``, ``model/cv/mobilenet.py``) and
+GroupNorm (resnet18_gn per FedOpt/Adaptive-Federated-Optimization practice,
+``model/cv/resnet_gn.py``). On TPU, BatchNorm's mutable running stats break
+the pure-functional client training transform (``vmap`` over a client cohort)
+and are known-bad under non-IID FL anyway; GroupNorm keeps every model a pure
+``params -> logits`` function. Parity note recorded per-model.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def group_norm(channels: int) -> nn.GroupNorm:
+    # 32 groups unless the channel count is small / not divisible
+    groups = 32
+    while channels % groups != 0:
+        groups //= 2
+    return nn.GroupNorm(num_groups=max(groups, 1))
+
+
+class MLP(nn.Module):
+    features: Sequence[int]
+    activation: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f)(x)
+            if i < len(self.features) - 1:
+                x = self.activation(x)
+        return x
+
+
+def flatten_images(x: jnp.ndarray) -> jnp.ndarray:
+    return x.reshape((x.shape[0], -1))

@@ -1,0 +1,41 @@
+"""NLP models: char/word LSTMs for shakespeare & stackoverflow.
+
+reference: ``python/fedml/model/nlp/rnn.py:1-115`` — RNN_OriginalFedAvg
+(embed 8 → 2×LSTM 256 → dense vocab, char LM) and RNN_StackOverFlow
+(embed 96 → LSTM 670 → dense 96 → dense vocab). Flax ``nn.RNN`` over
+``nn.OptimizedLSTMCell`` — unrolled by XLA as a fused scan on TPU.
+"""
+
+from __future__ import annotations
+
+from flax import linen as nn
+
+
+class RNNOriginalFedAvg(nn.Module):
+    """Char-level LM (shakespeare). Logits for every position: [B, L, vocab]."""
+
+    vocab_size: int = 90
+    embedding_dim: int = 8
+    hidden: int = 256
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(h)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(h)
+        return nn.Dense(self.vocab_size)(h)
+
+
+class RNNStackOverflow(nn.Module):
+    """Next-word prediction LM (stackoverflow_nwp)."""
+
+    vocab_size: int = 10004
+    embedding_dim: int = 96
+    hidden: int = 670
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(h)
+        h = nn.Dense(self.embedding_dim)(h)
+        return nn.Dense(self.vocab_size)(h)

@@ -1,0 +1,228 @@
+"""Vision models: LR, CNNs, ResNets, MobileNet, VGG, EfficientNet-lite.
+
+Re-foundings of the reference zoo (``python/fedml/model/model_hub.py:20-83``
+and ``model/cv/*.py``) as Flax modules. Every module is a pure function of
+params with the uniform signature ``__call__(x, train: bool = False)`` so the
+trainer transforms (``vmap`` over cohorts, ``lax.scan`` over batches) apply to
+all of them. NHWC layout (TPU conv-native); GroupNorm (see layers.py).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .layers import group_norm
+
+
+class LogisticRegression(nn.Module):
+    """reference: ``model/linear/lr.py`` (one Linear over flattened input)."""
+
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes)(x)
+
+
+class CNNDropOut(nn.Module):
+    """FedAvg-paper FEMNIST CNN (reference: ``model/cv/cnn.py`` CNN_DropOut:
+    two 3x3 convs 32/64 + maxpool + dropout + dense 128 + dense classes)."""
+
+    num_classes: int
+    only_digits: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(32, (3, 3), padding="VALID")(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3), padding="VALID")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                    padding="SAME", use_bias=False)(x)
+        y = group_norm(self.filters)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False)(y)
+        y = group_norm(self.filters)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1),
+                               strides=(self.strides, self.strides),
+                               use_bias=False)(x)
+            residual = group_norm(self.filters)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """ResNet-18-GN (ImageNet-style stem) or CIFAR-style ResNet-20/56.
+
+    reference: ``model/cv/resnet_gn.py`` (resnet18, GroupNorm, used for
+    fed_cifar100 per Adaptive Federated Optimization) and ``model/cv/resnet.py``
+    (resnet20/56 for CIFAR, BatchNorm in the reference — GN here, see layers.py).
+    """
+
+    stage_sizes: Sequence[int]
+    stage_filters: Sequence[int]
+    num_classes: int
+    cifar_stem: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.cifar_stem:
+            x = nn.Conv(self.stage_filters[0], (3, 3), padding="SAME", use_bias=False)(x)
+        else:
+            x = nn.Conv(self.stage_filters[0], (7, 7), strides=(2, 2),
+                        padding="SAME", use_bias=False)(x)
+            x = group_norm(self.stage_filters[0])(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, (size, filters) in enumerate(zip(self.stage_sizes, self.stage_filters)):
+            for j in range(size):
+                strides = 2 if (i > 0 and j == 0) else 1
+                x = BasicBlock(filters, strides)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def resnet18_gn(num_classes: int) -> ResNet:
+    return ResNet([2, 2, 2, 2], [64, 128, 256, 512], num_classes, cifar_stem=False)
+
+
+def resnet20(num_classes: int) -> ResNet:
+    return ResNet([3, 3, 3], [16, 32, 64], num_classes, cifar_stem=True)
+
+
+def resnet56(num_classes: int) -> ResNet:
+    return ResNet([9, 9, 9], [16, 32, 64], num_classes, cifar_stem=True)
+
+
+class DepthwiseSeparable(nn.Module):
+    filters: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        x = nn.Conv(in_ch, (3, 3), strides=(self.strides, self.strides),
+                    padding="SAME", feature_group_count=in_ch, use_bias=False)(x)
+        x = group_norm(in_ch)(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
+        x = group_norm(self.filters)(x)
+        return nn.relu(x)
+
+
+class MobileNetV1(nn.Module):
+    """reference: ``model/cv/mobilenet.py`` (width-1.0 MobileNet)."""
+
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(32, (3, 3), strides=(2, 2), padding="SAME", use_bias=False)(x)
+        x = group_norm(32)(x)
+        x = nn.relu(x)
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+               (512, 1), (512, 1), (512, 1), (512, 1), (512, 1),
+               (1024, 2), (1024, 1)]
+        for filters, strides in cfg:
+            x = DepthwiseSeparable(filters, strides)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.2, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class InvertedResidual(nn.Module):
+    filters: int
+    strides: int
+    expand: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        hidden = in_ch * self.expand
+        y = x
+        if self.expand != 1:
+            y = nn.Conv(hidden, (1, 1), use_bias=False)(y)
+            y = group_norm(hidden)(y)
+            y = nn.relu6(y)
+        y = nn.Conv(hidden, (3, 3), strides=(self.strides, self.strides),
+                    padding="SAME", feature_group_count=hidden, use_bias=False)(y)
+        y = group_norm(hidden)(y)
+        y = nn.relu6(y)
+        y = nn.Conv(self.filters, (1, 1), use_bias=False)(y)
+        y = group_norm(self.filters)(y)
+        if self.strides == 1 and in_ch == self.filters:
+            y = y + x
+        return y
+
+
+class MobileNetV2(nn.Module):
+    """reference: ``model/cv/mobilenet_v2.py``."""
+
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(32, (3, 3), strides=(2, 2), padding="SAME", use_bias=False)(x)
+        x = group_norm(32)(x)
+        x = nn.relu6(x)
+        cfg = [  # (expand, filters, repeats, stride)
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+        ]
+        for expand, filters, repeats, stride in cfg:
+            for r in range(repeats):
+                x = InvertedResidual(filters, stride if r == 0 else 1, expand)(
+                    x, train=train
+                )
+        x = nn.Conv(1280, (1, 1), use_bias=False)(x)
+        x = group_norm(1280)(x)
+        x = nn.relu6(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+class VGG(nn.Module):
+    """reference: ``model/cv/vgg.py`` (vgg11/16/19 without BN)."""
+
+    cfg: Tuple
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(int(v), (3, 3), padding="SAME")(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        for f in (512, 512):
+            x = nn.Dense(f)(x)
+            x = nn.relu(x)
+            x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+VGG11_CFG = (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M")
+VGG16_CFG = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+             512, 512, 512, "M", 512, 512, 512, "M")

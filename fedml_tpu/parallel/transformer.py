@@ -1,0 +1,236 @@
+"""Cheetah flagship model: a Llama-architecture decoder-only transformer.
+
+The reference's "Cheetah" distributed-training pillar is an EMPTY STUB
+(``python/fedml/distributed/`` holds one empty ``__init__.py``; SURVEY.md
+intro) — this module is the new-capability work SURVEY.md §7 stage 6 calls
+for: a data/tensor/sequence-parallel LLM pretraining path designed for the
+MXU from the start.
+
+TPU-first choices:
+- bfloat16 activations/weights, fp32 RMSNorm accumulation and logits
+- fused QKV and gate+up projections (fewer, larger matmuls for the MXU)
+- rotary embeddings computed in fp32, GQA (n_kv_heads ≤ n_heads)
+- every weight created through ``nn.with_partitioning`` with *logical* axis
+  names; ``sharding.py`` maps logical → mesh axes (dp/fsdp/tensor/sequence),
+  so the same module runs 1-chip or pod-scale unchanged
+- no data-dependent Python control flow — the whole stack jits once
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+# Logical axis names (mapped to mesh axes by sharding.LOGICAL_RULES)
+EMBED = "embed"
+VOCAB = "vocab"
+HEADS = "heads"
+KV = "kv"
+MLP = "mlp"
+BATCH = "batch"
+LENGTH = "length"
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    d_ff: int = 11008
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+    remat: bool = True  # jax.checkpoint each block (HBM ⇄ FLOPs trade)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def llama2_7b() -> "TransformerConfig":
+        return TransformerConfig()
+
+    @staticmethod
+    def tiny(vocab_size: int = 256) -> "TransformerConfig":
+        return TransformerConfig(
+            vocab_size=vocab_size, d_model=128, n_layers=2, n_heads=4,
+            n_kv_heads=2, d_ff=384, max_seq_len=128, remat=False,
+        )
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * inv).astype(x.dtype) * weight
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param(
+            "weight",
+            nn.with_partitioning(nn.initializers.ones, (None,)),
+            (x.shape[-1],),
+            jnp.float32,
+        )
+        return rms_norm(x, w.astype(x.dtype), self.eps)
+
+
+def rotary_embedding(
+    positions: jax.Array, head_dim: int, theta: float
+) -> Tuple[jax.Array, jax.Array]:
+    """cos/sin tables for the given positions: [*, L, head_dim/2] fp32."""
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    angles = positions.astype(jnp.float32)[..., None] * freqs
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rotary(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [B, L, H, D]; cos/sin: [B, L, D/2] (or broadcastable)."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    c = cos[..., None, :]
+    s = sin[..., None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+def attention_scores(
+    q: jax.Array, k: jax.Array, v: jax.Array, mask: Optional[jax.Array]
+) -> jax.Array:
+    """Plain causal attention (single-device / tensor-parallel path).
+
+    q: [B, L, H, D], k/v: [B, L, Hkv, D] → out [B, L, H, D]. GQA via repeat.
+    The sequence-parallel path replaces this with ring attention
+    (``ring_attention.py``).
+    """
+    B, L, H, D = q.shape
+    Hkv = k.shape[2]
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
+    logits = jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32) * scale
+    causal = jnp.tril(jnp.ones((L, L), jnp.bool_))
+    logits = jnp.where(causal[None, None], logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhlm,bmhd->blhd", probs, v)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin, mask=None):
+        cfg = self.cfg
+        D, H, Hkv, hd = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        init = nn.initializers.normal(0.02)
+        # fused QKV: one [D, (H + 2*Hkv) * hd] matmul
+        wqkv = self.param(
+            "wqkv",
+            nn.with_partitioning(init, (EMBED, HEADS)),
+            (D, (H + 2 * Hkv) * hd),
+            cfg.param_dtype,
+        )
+        wo = self.param(
+            "wo",
+            nn.with_partitioning(init, (HEADS, EMBED)),
+            (H * hd, D),
+            cfg.param_dtype,
+        )
+        B, L, _ = x.shape
+        qkv = jnp.einsum("bld,de->ble", x, wqkv.astype(cfg.dtype))
+        q, k, v = jnp.split(qkv, [H * hd, (H + Hkv) * hd], axis=-1)
+        q = q.reshape(B, L, H, hd)
+        k = k.reshape(B, L, Hkv, hd)
+        v = v.reshape(B, L, Hkv, hd)
+        q = apply_rotary(q, cos, sin)
+        k = apply_rotary(k, cos, sin)
+        out = attention_scores(q, k, v, mask)
+        out = out.reshape(B, L, H * hd)
+        return jnp.einsum("ble,ed->bld", out, wo.astype(cfg.dtype))
+
+
+class FeedForward(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        init = nn.initializers.normal(0.02)
+        # fused gate+up: one [D, 2*F] matmul
+        w_gate_up = self.param(
+            "w_gate_up",
+            nn.with_partitioning(init, (EMBED, MLP)),
+            (cfg.d_model, 2 * cfg.d_ff),
+            cfg.param_dtype,
+        )
+        w_down = self.param(
+            "w_down",
+            nn.with_partitioning(init, (MLP, EMBED)),
+            (cfg.d_ff, cfg.d_model),
+            cfg.param_dtype,
+        )
+        gu = jnp.einsum("bld,df->blf", x, w_gate_up.astype(cfg.dtype))
+        gate, up = jnp.split(gu, 2, axis=-1)
+        h = nn.silu(gate) * up
+        return jnp.einsum("blf,fd->bld", h, w_down.astype(cfg.dtype))
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin, mask=None):
+        x = x + Attention(self.cfg)(RMSNorm(self.cfg.norm_eps)(x), cos, sin, mask)
+        x = x + FeedForward(self.cfg)(RMSNorm(self.cfg.norm_eps)(x))
+        return x
+
+
+class Transformer(nn.Module):
+    """Decoder-only LM. tokens [B, L] int32 → logits [B, L, vocab] fp32."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, mask=None, positions=None):
+        cfg = self.cfg
+        embed = self.param(
+            "embed",
+            nn.with_partitioning(nn.initializers.normal(0.02), (VOCAB, EMBED)),
+            (cfg.vocab_size, cfg.d_model),
+            cfg.param_dtype,
+        )
+        x = jnp.take(embed, tokens, axis=0).astype(cfg.dtype)
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])[None, :]
+        cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+
+        block_cls = nn.remat(Block) if cfg.remat else Block
+        for _ in range(cfg.n_layers):
+            x = block_cls(cfg)(x, cos, sin, mask)
+
+        x = RMSNorm(cfg.norm_eps)(x)
+        # tied-untied choice: separate output head (Llama unties)
+        w_out = self.param(
+            "w_lm_head",
+            nn.with_partitioning(nn.initializers.normal(0.02), (EMBED, VOCAB)),
+            (cfg.d_model, cfg.vocab_size),
+            cfg.param_dtype,
+        )
+        return jnp.einsum("bld,dv->blv", x, w_out.astype(cfg.dtype)).astype(
+            jnp.float32
+        )

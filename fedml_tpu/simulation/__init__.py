@@ -1,0 +1,5 @@
+"""``fedml_tpu.simulation`` — the Parrot pillar (FL simulation)."""
+
+from .simulator import SimulatorMesh, SimulatorSingleProcess
+
+__all__ = ["SimulatorMesh", "SimulatorSingleProcess"]

@@ -1,0 +1,78 @@
+"""Simulator facades (reference: ``python/fedml/simulation/simulator.py``).
+
+``SimulatorSingleProcess`` (reference :25-56) and ``SimulatorMesh`` — the
+TPU-native replacement for both SimulatorMPI (:59-174) and SimulatorNCCL
+(:177-189); process-per-worker becomes shard-per-worker (see mesh_api.py).
+Per-optimizer dispatch mirrors the reference's ``args.federated_optimizer``
+branching.
+"""
+
+from __future__ import annotations
+
+from .. import constants
+from .mesh_api import MeshFedAvgAPI
+from .sp_api import FedAvgAPI
+
+_FEDAVG_FAMILY = (
+    constants.FEDML_FEDERATED_OPTIMIZER_FEDAVG,
+    constants.FEDML_FEDERATED_OPTIMIZER_FEDAVG_SEQ,
+    constants.FEDML_FEDERATED_OPTIMIZER_FEDPROX,
+    constants.FEDML_FEDERATED_OPTIMIZER_FEDOPT,
+    constants.FEDML_FEDERATED_OPTIMIZER_FEDNOVA,
+    constants.FEDML_FEDERATED_OPTIMIZER_FEDSGD,
+    constants.FEDML_FEDERATED_OPTIMIZER_SCAFFOLD,
+)
+
+
+class SimulatorSingleProcess:
+    def __init__(self, args, device, dataset, model, client_trainer=None,
+                 server_aggregator=None):
+        opt = args.federated_optimizer
+        if opt in _FEDAVG_FAMILY:
+            self.fl_trainer = FedAvgAPI(
+                args, device, dataset, model, client_trainer, server_aggregator
+            )
+        elif opt == constants.FEDML_FEDERATED_OPTIMIZER_HIERARCHICAL_FL:
+            from .hierarchical_api import HierarchicalFLAPI
+
+            self.fl_trainer = HierarchicalFLAPI(
+                args, device, dataset, model, client_trainer, server_aggregator
+            )
+        elif opt == constants.FEDML_FEDERATED_OPTIMIZER_DECENTRALIZED_FL:
+            from .decentralized_api import DecentralizedFLAPI
+
+            self.fl_trainer = DecentralizedFLAPI(args, device, dataset, model)
+        elif opt == constants.FEDML_FEDERATED_OPTIMIZER_VFL:
+            from .vfl_api import VerticalFLAPI
+
+            self.fl_trainer = VerticalFLAPI(args, device, dataset, model)
+        elif opt == constants.FEDML_FEDERATED_OPTIMIZER_SPLIT_NN:
+            from .split_nn_api import SplitNNAPI
+
+            self.fl_trainer = SplitNNAPI(args, device, dataset, model)
+        elif opt == constants.FEDML_FEDERATED_OPTIMIZER_TURBOAGGREGATE:
+            from .turboaggregate_api import TurboAggregateAPI
+
+            self.fl_trainer = TurboAggregateAPI(args, device, dataset, model)
+        elif opt == constants.FEDML_FEDERATED_OPTIMIZER_FEDGKT:
+            from .fedgkt_api import FedGKTAPI
+
+            self.fl_trainer = FedGKTAPI(args, device, dataset, model)
+        else:
+            raise ValueError(f"unsupported federated_optimizer {opt!r}")
+
+    def run(self):
+        return self.fl_trainer.train()
+
+
+class SimulatorMesh:
+    """Cohort sharded over the ``clients`` mesh axis (replaces MPI + NCCL)."""
+
+    def __init__(self, args, device, dataset, model, client_trainer=None,
+                 server_aggregator=None):
+        self.fl_trainer = MeshFedAvgAPI(
+            args, device, dataset, model, client_trainer, server_aggregator
+        )
+
+    def run(self):
+        return self.fl_trainer.train()

@@ -1,0 +1,273 @@
+"""Single-process Parrot simulation — the canonical FL loop, TPU-first.
+
+Replaces the reference's ``simulation/sp/fedavg/fedavg_api.py:65-232`` (Python
+loop: per-client deepcopy → torch train → dict-average) and its per-optimizer
+clones (``sp/fedopt``, ``sp/fedprox``, ``sp/fednova``, ``sp/fedsgd``) with ONE
+engine:
+
+- the round's cohort trains as ``vmap(local_train)`` over a stacked
+  ``[cohort, cap, ...]`` gather of the packed dataset — one fused XLA program
+- aggregation is the stacked weighted-average kernel (core/aggregate.py)
+- the federated optimizer enters as (a) a flag inside the local loss
+  (FedProx), (b) a server-side optax transform on the pseudo-gradient
+  (FedOpt/FedAdam/FedYogi/FedAdagrad), (c) normalized averaging (FedNova),
+  (d) gradient-level averaging (FedSGD), or (e) control variates (SCAFFOLD)
+- hook order preserved from the reference: attack → on_before_aggregation →
+  defend → aggregate → DP → on_after_aggregation
+
+Client sampling stays host-side and round-seeded exactly like the reference
+(``fedavg_api.py:125-140``: ``np.random.seed(round_idx)`` + choice).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import constants
+from ..core.aggregate import pseudo_gradient, weighted_average
+from ..core.dp import FedPrivacyMechanism
+from ..core.security.attacker import FedMLAttacker
+from ..core.security.defender import FedMLDefender
+from ..ml.evaluate import make_eval_fn
+from ..ml.local_train import make_grad_fn, make_local_train_fn
+from ..ml.optimizer import create_server_optimizer
+from ..utils.tree import (
+    tree_flatten_to_vector,
+    tree_scale,
+    tree_sub,
+    tree_unflatten_from_vector,
+    tree_zeros_like,
+)
+
+logger = logging.getLogger(__name__)
+
+PyTree = Any
+
+SERVER_OPT_FAMILY = (
+    constants.FEDML_FEDERATED_OPTIMIZER_FEDOPT,
+    constants.FEDML_FEDERATED_OPTIMIZER_FEDSGD,
+)
+
+
+class FedAvgAPI:
+    """One engine for the sp FedAvg-family optimizers.
+
+    ``federated_optimizer`` ∈ {FedAvg, FedAvg_seq, FedProx, FedOpt, FedNova,
+    FedSGD, SCAFFOLD}. (FedAvg_seq is identical to FedAvg here: "sequential
+    multi-client per device" is an artifact of the reference's MPI process
+    model — under vmap the whole cohort is already one device program.)
+    """
+
+    def __init__(self, args, device, dataset, model, client_trainer=None,
+                 server_aggregator=None):
+        self.args = args
+        self.device = device
+        self.ds = dataset
+        self.bundle = model
+        self.opt_name = str(args.federated_optimizer)
+        self.custom_trainer = client_trainer
+        self.custom_aggregator = server_aggregator
+
+        seed = int(getattr(args, "random_seed", 0))
+        self.root_rng = jax.random.PRNGKey(seed)
+        self.global_params = model.init(self.root_rng)
+
+        self.scaffold = self.opt_name == constants.FEDML_FEDERATED_OPTIMIZER_SCAFFOLD
+        self.fedsgd = self.opt_name == constants.FEDML_FEDERATED_OPTIMIZER_FEDSGD
+        self.fednova = self.opt_name == constants.FEDML_FEDERATED_OPTIMIZER_FEDNOVA
+
+        cap = self.ds.cap
+        if self.fedsgd:
+            fn = make_grad_fn(model, args, cap)
+            self.cohort_fn = jax.jit(jax.vmap(fn, in_axes=(None, 0, 0, 0, 0)))
+        else:
+            fn = make_local_train_fn(model, args, cap, scaffold=self.scaffold)
+            axes = (None, 0, 0, 0, 0) + ((None, 0) if self.scaffold else ())
+            self.cohort_fn = jax.jit(jax.vmap(fn, in_axes=axes))
+
+        # server optimizer over pseudo-gradients (FedOpt family + FedSGD)
+        self.server_opt = None
+        self.server_opt_state = None
+        if self.opt_name in SERVER_OPT_FAMILY:
+            self.server_opt = create_server_optimizer(args)
+            self.server_opt_state = self.server_opt.init(self.global_params)
+
+        if self.scaffold:
+            self.c_global = tree_zeros_like(self.global_params)
+            # per-client control variates, stacked [clients, ...]
+            self.c_locals = jax.tree.map(
+                lambda x: jnp.zeros((self.ds.client_num,) + x.shape, x.dtype),
+                self.global_params,
+            )
+
+        self.evaluate = make_eval_fn(model)
+        self.attacker = FedMLAttacker.get_instance()
+        self.attacker.init(args)
+        self.defender = FedMLDefender.get_instance()
+        self.defender.init(args)
+        self.dp = (
+            FedPrivacyMechanism.from_args(args)
+            if bool(getattr(args, "enable_dp", False))
+            else None
+        )
+        self.history: List[Dict[str, float]] = []
+
+    # -- sampling (reference: fedavg_api.py:125-140) ------------------------
+    def _client_sampling(self, round_idx: int) -> np.ndarray:
+        total = self.ds.client_num
+        per_round = min(int(self.args.client_num_per_round), total)
+        if total == per_round:
+            return np.arange(total)
+        rs = np.random.RandomState(round_idx)
+        return rs.choice(total, per_round, replace=False)
+
+    # -- one round ----------------------------------------------------------
+    def _train_round(self, round_idx: int) -> Dict[str, float]:
+        cohort = self._client_sampling(round_idx)
+        cx = jnp.asarray(self.ds.train_x[cohort])
+        cy = jnp.asarray(self.ds.train_y[cohort])
+        cn = jnp.asarray(self.ds.train_counts[cohort])
+        if self.attacker.is_data_attack():
+            cy = self.attacker.attack_data(cy)
+
+        round_rng = jax.random.fold_in(self.root_rng, round_idx)
+        rngs = jax.random.split(round_rng, len(cohort))
+
+        if self.fedsgd:
+            grads, metrics = self.cohort_fn(self.global_params, cx, cy, cn, rngs)
+            agg_grad = self._aggregate(grads, metrics["num_samples"], round_rng)
+            updates, self.server_opt_state = self.server_opt.update(
+                agg_grad, self.server_opt_state, self.global_params
+            )
+            import optax
+
+            self.global_params = optax.apply_updates(self.global_params, updates)
+            return {"train_loss": float("nan")}
+
+        if self.scaffold:
+            c_cohort = jax.tree.map(lambda x: x[cohort], self.c_locals)
+            stacked, metrics, new_c = self.cohort_fn(
+                self.global_params, cx, cy, cn, rngs, self.c_global, c_cohort
+            )
+            # scatter back new control variates; update c_global by the mean
+            # delta scaled by cohort/total (SCAFFOLD option II)
+            delta_c = jax.tree.map(lambda n, o: (n - o).mean(0), new_c, c_cohort)
+            scale = len(cohort) / self.ds.client_num
+            self.c_global = jax.tree.map(
+                lambda cg, d: cg + scale * d, self.c_global, delta_c
+            )
+            self.c_locals = jax.tree.map(
+                lambda all_c, nc: all_c.at[cohort].set(nc), self.c_locals, new_c
+            )
+        else:
+            stacked, metrics = self.cohort_fn(self.global_params, cx, cy, cn, rngs)
+
+        weights = metrics["num_samples"]
+
+        if self.fednova:
+            # w_new = w_g - tau_eff * Σ p_i (w_g - w_i)/tau_i
+            tau = metrics["tau"]
+            p = weights / jnp.maximum(weights.sum(), 1e-12)
+            tau_eff = (p * tau).sum()
+            norm_dir = _fednova_normalized_direction(self.global_params, stacked, tau)
+            d = weighted_average(norm_dir, weights)
+            self.global_params = jax.tree.map(
+                lambda g, dd: g - tau_eff * dd, self.global_params, d
+            )
+        else:
+            w_agg = self._aggregate(stacked, weights, round_rng)
+            if self.opt_name == constants.FEDML_FEDERATED_OPTIMIZER_FEDOPT:
+                import optax
+
+                pg = pseudo_gradient(self.global_params, w_agg)
+                updates, self.server_opt_state = self.server_opt.update(
+                    pg, self.server_opt_state, self.global_params
+                )
+                self.global_params = optax.apply_updates(self.global_params, updates)
+            else:
+                self.global_params = w_agg
+
+        if self.dp is not None and self.dp.dp_type == "cdp":
+            self.global_params = self.dp.randomize_global(
+                self.global_params, jax.random.fold_in(round_rng, 7)
+            )
+        return {"train_loss": float(jnp.mean(metrics.get("train_loss", jnp.nan)))}
+
+    # -- aggregation with trust hooks ---------------------------------------
+    def _aggregate(self, stacked: PyTree, weights: jax.Array, rng) -> PyTree:
+        """attack → defend → weighted-average → (local/central DP applied by
+        caller), all on the stacked [cohort, ...] arrays."""
+        if self.dp is not None and self.dp.dp_type == "ldp":
+            keys = jax.random.split(jax.random.fold_in(rng, 3), weights.shape[0])
+            stacked = jax.vmap(self.dp.randomize)(stacked, keys)
+        elif self.dp is not None and self.dp.dp_type == "cdp":
+            # bound per-client sensitivity before averaging; the noise is
+            # added to the aggregate by the caller (randomize_global)
+            stacked = self.dp.clip_client_updates(stacked, self.global_params)
+
+        needs_flat = self.attacker.is_model_attack() or self.defender.is_defense_enabled()
+        if not needs_flat:
+            if self.custom_aggregator is not None:
+                raw = [
+                    (float(weights[i]), jax.tree.map(lambda x: x[i], stacked))
+                    for i in range(weights.shape[0])
+                ]
+                raw = self.custom_aggregator.on_before_aggregation(raw)
+                agg = self.custom_aggregator.aggregate(raw)
+                return self.custom_aggregator.on_after_aggregation(agg)
+            return weighted_average(stacked, weights)
+
+        # flatten to [n, dim] once for the attack/defense kernels
+        _, treedef, shapes = tree_flatten_to_vector(self.global_params)
+        flat = jax.vmap(lambda t: tree_flatten_to_vector(t)[0])(stacked)
+        gvec, _, _ = tree_flatten_to_vector(self.global_params)
+        if self.attacker.is_model_attack():
+            flat = self.attacker.attack_model(
+                flat, weights, jax.random.fold_in(rng, 1)
+            )
+        if self.defender.is_defense_enabled():
+            agg_vec = self.defender.defend(
+                flat, weights, gvec, jax.random.fold_in(rng, 2)
+            )
+        else:
+            w = weights / jnp.maximum(weights.sum(), 1e-12)
+            agg_vec = (w[:, None] * flat).sum(0)
+        return tree_unflatten_from_vector(agg_vec, treedef, shapes)
+
+    # -- the training loop (reference: fedavg_api.py:65-123) ----------------
+    def train(self) -> Dict[str, float]:
+        rounds = int(self.args.comm_round)
+        freq = max(int(getattr(self.args, "frequency_of_the_test", 5)), 1)
+        last_eval: Dict[str, float] = {}
+        for round_idx in range(rounds):
+            self.args.round_idx = round_idx
+            t0 = time.perf_counter()
+            train_metrics = self._train_round(round_idx)
+            dt = time.perf_counter() - t0
+            entry = {"round": round_idx, "round_time_s": dt, **train_metrics}
+            if round_idx % freq == 0 or round_idx == rounds - 1:
+                last_eval = self.evaluate(
+                    self.global_params, self.ds.test_x, self.ds.test_y
+                )
+                entry.update(last_eval)
+                logger.info(
+                    "round %d: loss=%.4f acc=%.4f (%.3fs)",
+                    round_idx, last_eval["test_loss"], last_eval["test_acc"], dt,
+                )
+            self.history.append(entry)
+        return last_eval
+
+
+def _fednova_normalized_direction(global_params, stacked, tau):
+    """Per-client normalized direction (w_g - w_i)/tau_i, leaf-wise."""
+    return jax.tree.map(
+        lambda g, s: (g[None] - s) / tau.reshape((-1,) + (1,) * (s.ndim - 1)),
+        global_params,
+        stacked,
+    )

@@ -1,0 +1,123 @@
+"""Data layer + model zoo tests (reference test strategy: SURVEY.md §4 —
+unit pyramid over pure functions, tiny-config shapes)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu import data as data_mod
+from fedml_tpu import models as model_mod
+from fedml_tpu.arguments import Arguments
+
+
+def make_args(**kw):
+    base = dict(
+        dataset="synthetic", model="lr", client_num_in_total=8,
+        client_num_per_round=4, comm_round=2, epochs=1, batch_size=8,
+    )
+    base.update(kw)
+    return Arguments(overrides=base)
+
+
+class TestData:
+    def test_packed_layout(self):
+        args = make_args(dataset="mnist", client_num_in_total=12)
+        ds, class_num = data_mod.load(args)
+        assert class_num == 10
+        assert ds.train_x.shape[0] == 12
+        assert ds.train_x.shape[2:] == (28, 28, 1)
+        assert ds.cap % args.batch_size == 0
+        assert ds.train_counts.sum() > 0
+        assert (ds.train_counts <= ds.cap).all()
+
+    def test_hetero_partition_skew(self):
+        args = make_args(dataset="cifar10", partition_method="hetero",
+                         partition_alpha=0.1, client_num_in_total=10)
+        ds, _ = data_mod.load(args)
+        # low alpha → clients' class histograms differ
+        hists = []
+        for i in range(ds.client_num):
+            n = ds.train_counts[i]
+            hists.append(np.bincount(ds.train_y[i][:n], minlength=10))
+        hists = np.stack(hists).astype(float)
+        hists /= np.maximum(hists.sum(1, keepdims=True), 1)
+        assert np.std(hists, axis=0).mean() > 0.05
+
+    def test_homo_partition_even(self):
+        args = make_args(partition_method="homo")
+        ds, _ = data_mod.load(args)
+        assert ds.train_counts.max() - ds.train_counts.min() <= 1
+
+    def test_nwp_dataset(self):
+        args = make_args(dataset="shakespeare", client_num_in_total=4)
+        ds, class_num = data_mod.load(args)
+        assert class_num == 90
+        assert ds.task == "nwp"
+        assert ds.train_x.dtype == np.int32
+        # targets are inputs shifted left
+        n = ds.train_counts[0]
+        assert (ds.train_y[0, :n, :-1] == ds.train_x[0, :n, 1:]).all()
+
+    def test_tagpred_dataset(self):
+        args = make_args(dataset="stackoverflow_lr", client_num_in_total=4)
+        ds, class_num = data_mod.load(args)
+        assert class_num == 500
+        assert ds.train_y.shape[-1] == 500
+
+    def test_unknown_dataset_raises(self):
+        with pytest.raises(ValueError, match="unknown dataset"):
+            data_mod.load(make_args(dataset="nope"))
+
+    def test_reference_tuple_shape(self):
+        ds, _ = data_mod.load(make_args())
+        tup = ds.as_reference_tuple()
+        assert len(tup) == 8
+        assert tup[0] == ds.train_data_num
+
+
+class TestModels:
+    @pytest.mark.parametrize(
+        "model,dataset",
+        [
+            ("lr", "mnist"),
+            ("cnn", "femnist"),
+            ("resnet20", "cifar10"),
+            ("mlp", "synthetic"),
+        ],
+    )
+    def test_forward_shapes(self, model, dataset):
+        args = make_args(model=model, dataset=dataset)
+        ds_spec = data_mod.REGISTRY[dataset]
+        bundle = model_mod.create(args, ds_spec.class_num)
+        params = bundle.init(jax.random.PRNGKey(0))
+        x = bundle.dummy_input(3)
+        out = bundle.apply(params, x)
+        assert out.shape == (3, ds_spec.class_num)
+
+    def test_rnn_shapes(self):
+        args = make_args(model="rnn", dataset="shakespeare")
+        bundle = model_mod.create(args, 90)
+        params = bundle.init(jax.random.PRNGKey(0))
+        x = jnp.zeros((2, 80), jnp.int32)
+        out = bundle.apply(params, x)
+        assert out.shape == (2, 80, 90)
+
+    def test_resnet18_gn_deep(self):
+        args = make_args(model="resnet18_gn", dataset="cifar10")
+        bundle = model_mod.create(args, 10)
+        params = bundle.init(jax.random.PRNGKey(0))
+        assert bundle.param_count(params) > 10_000_000  # ~11M like torch resnet18
+
+    def test_dropout_determinism(self):
+        args = make_args(model="cnn", dataset="femnist")
+        bundle = model_mod.create(args, 62)
+        params = bundle.init(jax.random.PRNGKey(0))
+        x = jnp.ones((2, 28, 28, 1))
+        a = bundle.apply(params, x, train=False)
+        b = bundle.apply(params, x, train=False)
+        assert jnp.allclose(a, b)
+
+    def test_unknown_model_raises(self):
+        with pytest.raises(ValueError, match="unknown model"):
+            model_mod.create(make_args(model="nope"), 10)

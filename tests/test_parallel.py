@@ -1,0 +1,139 @@
+"""Cheetah parallel-layer tests: transformer math, sharding rules, full
+sharded train step on the 8-device virtual mesh, and the driver entry points.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.parallel.sharding import make_mesh, param_shardings, unbox
+from fedml_tpu.parallel.train_step import CheetahTrainer, lm_loss, make_optimizer
+from fedml_tpu.parallel.transformer import (
+    Transformer,
+    TransformerConfig,
+    apply_rotary,
+    rotary_embedding,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return TransformerConfig.tiny()
+
+
+class TestTransformer:
+    def test_forward_shape_and_dtype(self, tiny_cfg):
+        model = Transformer(tiny_cfg)
+        toks = jnp.zeros((2, 16), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), toks)
+        logits = model.apply(variables, toks)
+        assert logits.shape == (2, 16, tiny_cfg.vocab_size)
+        assert logits.dtype == jnp.float32
+
+    def test_causality(self, tiny_cfg):
+        """Changing a future token must not change past logits."""
+        model = Transformer(tiny_cfg)
+        toks = jnp.ones((1, 16), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), toks)
+        a = model.apply(variables, toks)
+        toks2 = toks.at[0, 10].set(5)
+        b = model.apply(variables, toks2)
+        np.testing.assert_allclose(a[0, :10], b[0, :10], atol=2e-2)
+        assert not np.allclose(a[0, 10:], b[0, 10:], atol=1e-3)
+
+    def test_rotary_preserves_norm(self):
+        pos = jnp.arange(8)[None]
+        cos, sin = rotary_embedding(pos, 16, 10000.0)
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 8, 2, 16))
+        y = apply_rotary(x, cos, sin)
+        np.testing.assert_allclose(
+            jnp.linalg.norm(x, axis=-1), jnp.linalg.norm(y, axis=-1), rtol=1e-4
+        )
+
+    def test_gqa_fewer_kv_heads(self):
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=64, n_layers=1, n_heads=8, n_kv_heads=2,
+            d_ff=128, max_seq_len=32, remat=False,
+        )
+        model = Transformer(cfg)
+        toks = jnp.zeros((1, 8), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), toks)
+        wqkv = variables["params"]["Block_0"]["Attention_0"]["wqkv"]
+        expected = (cfg.n_heads + 2 * cfg.n_kv_heads) * cfg.head_dim
+        assert unbox(wqkv).shape == (cfg.d_model, expected)
+
+    def test_lm_loss_masking(self):
+        logits = jnp.zeros((1, 4, 8), jnp.float32)
+        tokens = jnp.zeros((1, 4), jnp.int32)
+        full = lm_loss(logits, tokens, jnp.ones((1, 4)))
+        none = lm_loss(logits, tokens, jnp.zeros((1, 4)))
+        assert float(full) == pytest.approx(np.log(8), rel=1e-4)
+        assert float(none) == 0.0
+
+
+class TestShardedTraining:
+    def test_param_shardings_follow_rules(self, tiny_cfg):
+        mesh = make_mesh({"fsdp": 4, "tensor": 2})
+        model = Transformer(tiny_cfg)
+        boxed = jax.eval_shape(
+            lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32)),
+            jax.random.PRNGKey(0),
+        )
+        sh = param_shardings(mesh, boxed["params"])
+        wqkv_sh = sh["Block_0"]["Attention_0"]["wqkv"]
+        assert wqkv_sh.spec == jax.sharding.PartitionSpec("fsdp", "tensor")
+        embed_sh = sh["embed"]
+        assert embed_sh.spec == jax.sharding.PartitionSpec("tensor", "fsdp")
+
+    def test_train_step_runs_sharded(self, tiny_cfg):
+        mesh = make_mesh({"data": 2, "fsdp": 2, "tensor": 2})
+        tr = CheetahTrainer(tiny_cfg, mesh,
+                            optimizer=make_optimizer(learning_rate=1e-2,
+                                                     warmup_steps=1))
+        state = tr.init_state(jax.random.PRNGKey(0))
+        rng = np.random.RandomState(0)
+        toks = jnp.asarray(rng.randint(0, 255, (8, 32)), jnp.int32)
+        mask = jnp.ones((8, 32), jnp.int32)
+        losses = []
+        for _ in range(4):
+            state, m = tr.train_step(state, toks, mask)
+            losses.append(float(m["loss"]))
+        assert int(state.step) == 4
+        assert losses[-1] < losses[0]  # memorizes the fixed batch
+        # flagship invariant: params actually sharded over the mesh
+        wqkv = state.params["Block_0"]["Attention_0"]["wqkv"]
+        assert wqkv.sharding.spec == jax.sharding.PartitionSpec("fsdp", "tensor")
+
+    def test_grad_accumulation_matches_large_batch(self, tiny_cfg):
+        mesh = make_mesh({"fsdp": 8})
+        opt = make_optimizer(learning_rate=1e-2, warmup_steps=1)
+        rng = np.random.RandomState(0)
+        toks = jnp.asarray(rng.randint(0, 255, (8, 32)), jnp.int32)
+        mask = jnp.ones((8, 32), jnp.int32)
+
+        tr1 = CheetahTrainer(tiny_cfg, mesh, optimizer=opt, accum_steps=1)
+        s1 = tr1.init_state(jax.random.PRNGKey(0))
+        s1, m1 = tr1.train_step(s1, toks, mask)
+
+        toks2 = jnp.concatenate([toks, toks]).reshape(2, 8, 32)
+        mask2 = jnp.concatenate([mask, mask]).reshape(2, 8, 32)
+        tr2 = CheetahTrainer(tiny_cfg, mesh, optimizer=opt, accum_steps=2)
+        s2 = tr2.init_state(jax.random.PRNGKey(0))
+        s2, m2 = tr2.train_step(s2, toks2, mask2)
+        assert float(m1["loss"]) == pytest.approx(float(m2["loss"]), rel=1e-3)
+
+
+class TestGraftEntry:
+    def test_entry_compiles(self):
+        import __graft_entry__ as g
+
+        fn, ex = g.entry()
+        out = jax.jit(fn)(*ex)
+        assert out.shape[-1] == 2048
+
+    def test_dryrun_multichip(self, capsys):
+        import __graft_entry__ as g
+
+        g.dryrun_multichip(8)
+        assert "dryrun_multichip ok" in capsys.readouterr().out
