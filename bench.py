@@ -33,6 +33,7 @@ def main() -> None:
         client_num_per_round=10, comm_round=12, epochs=1, batch_size=32,
         learning_rate=0.1, frequency_of_the_test=1000,
     ))
+    args.train_dtype = "bf16"  # MXU-native compute, fp32 master weights
     args = fedml.init(args, should_init_logs=False)
     ds, output_dim = data_mod.load(args)
     bundle = model_mod.create(args, output_dim)

@@ -40,6 +40,9 @@ def init(args: Optional[Arguments] = None, should_init_logs: bool = True) -> Arg
         args = load_arguments()
     args.rng = seed_everything(int(args.random_seed))
     _update_client_id_list(args)
+    from .core import mlops
+
+    mlops.init(args)
     _global_args = args
     logging.getLogger(__name__).info(
         "init: platform=%s backend=%s optimizer=%s",

@@ -1,0 +1,148 @@
+"""``fedml`` CLI.
+
+reference: ``python/fedml/cli/cli.py:29-685`` (click app: version / status /
+logs / login / logout / build / register / env). TPU re-grounding: argparse
+(no extra deps); the MLOps-platform commands (login/register against
+open.fedml.ai) are out of scope as platform glue (SURVEY.md §7 stage 8) —
+``build`` packages a training dir into a deployable zip, ``env`` collects the
+environment report (reference: cli/env/collect_env.py:6-68), ``logs`` tails a
+run's JSONL event log.
+
+Run as ``python -m fedml_tpu.cli <command>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import sys
+import zipfile
+
+
+def cmd_version(_args) -> int:
+    from . import __version__
+
+    print(f"fedml_tpu version: {__version__}")
+    return 0
+
+
+def cmd_env(_args) -> int:
+    """reference: collect_env — fedml/OS/python/torch/device info."""
+    from . import __version__
+
+    print(f"fedml_tpu: {__version__}")
+    print(f"python: {sys.version.split()[0]}")
+    print(f"os: {platform.platform()}")
+    try:
+        import jax
+
+        print(f"jax: {jax.__version__}")
+        devs = jax.devices()
+        print(f"devices: {[str(d) for d in devs]}")
+        print(f"default backend: {jax.default_backend()}")
+    except Exception as e:  # pragma: no cover - env-specific
+        print(f"jax: unavailable ({e})")
+    for mod in ("flax", "optax", "orbax.checkpoint", "numpy"):
+        try:
+            import importlib
+
+            m = importlib.import_module(mod)
+            print(f"{mod}: {getattr(m, '__version__', '?')}")
+        except ImportError:
+            print(f"{mod}: not installed")
+    return 0
+
+
+def cmd_status(_args) -> int:
+    runs_dir = ".fedml_tpu_runs"
+    if not os.path.isdir(runs_dir):
+        print("no runs directory; nothing tracked")
+        return 0
+    for fn in sorted(os.listdir(runs_dir)):
+        path = os.path.join(runs_dir, fn)
+        with open(path) as f:
+            lines = f.readlines()
+        last = json.loads(lines[-1]) if lines else {}
+        print(f"{fn}: {len(lines)} events, last={last.get('kind', '?')}")
+    return 0
+
+
+def cmd_logs(args) -> int:
+    """Tail a run's event log (reference: fedml logs)."""
+    path = args.file or ""
+    if not path:
+        runs_dir = ".fedml_tpu_runs"
+        files = sorted(os.listdir(runs_dir)) if os.path.isdir(runs_dir) else []
+        if not files:
+            print("no logs found")
+            return 1
+        path = os.path.join(runs_dir, files[-1])
+    with open(path) as f:
+        lines = f.readlines()
+    for line in lines[-args.n:]:
+        print(line.rstrip())
+    return 0
+
+
+def cmd_build(args) -> int:
+    """Package a training directory into a deployable zip
+    (reference: cli.py ``build`` — client/server MLOps packages)."""
+    src = os.path.abspath(args.source_folder)
+    if not os.path.isdir(src):
+        print(f"error: {src} is not a directory")
+        return 1
+    out = os.path.abspath(args.output or f"{os.path.basename(src)}_package.zip")
+    entry = args.entry_point
+    if entry and not os.path.exists(os.path.join(src, entry)):
+        print(f"error: entry point {entry!r} not found in {src}")
+        return 1
+    with zipfile.ZipFile(out, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, _dirs, files in os.walk(src):
+            for fn in files:
+                if fn.endswith((".pyc", ".pyo")) or "__pycache__" in root:
+                    continue
+                full = os.path.join(root, fn)
+                z.write(full, os.path.relpath(full, src))
+        manifest = {"type": args.type, "entry_point": entry or "main.py"}
+        z.writestr("fedml_package.json", json.dumps(manifest, indent=2))
+    print(f"built {args.type} package: {out}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="fedml_tpu", description=__doc__)
+    sub = parser.add_subparsers(dest="command")
+
+    sub.add_parser("version", help="print version")
+    sub.add_parser("env", help="environment report")
+    sub.add_parser("status", help="tracked run status")
+
+    p_logs = sub.add_parser("logs", help="show run event logs")
+    p_logs.add_argument("--file", default="", help="specific event file")
+    p_logs.add_argument("-n", type=int, default=20, help="tail lines")
+
+    p_build = sub.add_parser("build", help="package a training dir")
+    p_build.add_argument("--type", "-t", choices=("client", "server"),
+                         default="client")
+    p_build.add_argument("--source_folder", "-sf", required=True)
+    p_build.add_argument("--entry_point", "-ep", default="")
+    p_build.add_argument("--output", "-o", default="")
+
+    args = parser.parse_args(argv)
+    handlers = {
+        "version": cmd_version,
+        "env": cmd_env,
+        "status": cmd_status,
+        "logs": cmd_logs,
+        "build": cmd_build,
+    }
+    if args.command is None:
+        parser.print_help()
+        return 1
+    return handlers[args.command](args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

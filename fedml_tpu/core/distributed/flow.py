@@ -1,0 +1,212 @@
+"""FedMLAlgorithmFlow — declarative algorithm-flow DSL.
+
+reference: ``core/distributed/flow/fedml_flow.py:20-295`` (FedMLAlgorithmFlow:
+a declarative sequence of (flow_name, executor_task) pairs compiled into
+message handlers; neighbor liveness handshake before start; ONCE/FINISH tags)
+and ``fedml_executor.py`` (FedMLExecutor holds params/ids).
+
+Semantics preserved: every node declares the SAME flow sequence; ``build()``
+compiles it into handlers on the node's comm manager; a step runs on the
+nodes whose role matches, consuming the previous step's ``Params`` and
+shipping its returned ``Params`` to the next step's nodes. ``ONCE`` steps run
+only in the first pass; the flow loops until a ``FINISH``-tagged step
+completes. The liveness handshake (all nodes ONLINE before the first step)
+mirrors fedml_flow.py's neighbor handshake.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ... import constants
+from ..alg_frame import Params
+from .comm_manager import FedMLCommManager
+from .message import Message
+
+logger = logging.getLogger(__name__)
+
+FLOW_TAG_ONCE = "ONCE"
+FLOW_TAG_REPEAT = "REPEAT"
+FLOW_TAG_FINISH = "FINISH"
+
+ROLE_SERVER = "server"  # rank 0
+ROLE_CLIENT = "client"  # ranks 1..N
+
+
+class FedMLExecutor:
+    """Task host bound to one node (reference: fedml_executor.py)."""
+
+    def __init__(self, id: int = 0, neighbor_id_list: Optional[List[int]] = None):
+        self.id = id
+        self.neighbor_id_list = neighbor_id_list or []
+        self.params: Optional[Params] = None
+
+    def get_params(self) -> Optional[Params]:
+        return self.params
+
+    def set_params(self, params: Optional[Params]) -> None:
+        self.params = params
+
+
+class _FlowStep:
+    def __init__(self, name: str, method: Callable, role: str, tag: str):
+        self.name = name
+        self.method = method
+        self.role = role
+        self.tag = tag
+
+
+class FedMLAlgorithmFlow(FedMLCommManager):
+    MSG_TYPE_FLOW = "flow_step"
+    MSG_TYPE_READY = "flow_node_ready"
+    ARG_STEP = "step_idx"
+    ARG_PASS = "pass_idx"
+
+    def __init__(self, args, executor: FedMLExecutor, rank: int = 0,
+                 size: int = 0, backend: str = constants.COMM_BACKEND_LOOPBACK):
+        super().__init__(args, None, rank, size, backend)
+        self.executor = executor
+        self.flows: List[_FlowStep] = []
+        self._ready = set()
+        self._built = False
+        self.pass_idx = 0
+        self.done = threading.Event()
+        self._lock = threading.Lock()
+
+    # -- DSL -----------------------------------------------------------------
+    def add_flow(self, name: str, executor_task: Callable, role: str,
+                 flow_tag: str = FLOW_TAG_REPEAT) -> "FedMLAlgorithmFlow":
+        """reference: fedml_flow.py ``add_flow(flow_name, executor_task)``;
+        role says which nodes run the step (server=rank0, client=ranks>0)."""
+        if self._built:
+            raise RuntimeError("add_flow after build()")
+        self.flows.append(_FlowStep(name, executor_task, role, flow_tag))
+        return self
+
+    def build(self) -> "FedMLAlgorithmFlow":
+        if not self.flows:
+            raise ValueError("empty flow")
+        self._built = True
+        return self
+
+    # -- handlers ------------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            "connection_ready", self._on_connection_ready
+        )
+        self.register_message_receive_handler(self.MSG_TYPE_READY, self._on_ready)
+        self.register_message_receive_handler(self.MSG_TYPE_FLOW, self._on_flow)
+
+    def _on_connection_ready(self, msg: Message) -> None:
+        # liveness handshake: everyone announces to rank 0
+        ready = Message(self.MSG_TYPE_READY, self.rank, 0)
+        self.send_message(ready)
+
+    def _on_ready(self, msg: Message) -> None:
+        if self.rank != 0:
+            return
+        start = False
+        with self._lock:
+            self._ready.add(msg.get_sender_id())
+            if len(self._ready) == self.size:
+                start = True
+        if start:
+            logger.info("flow: all %d nodes ready, starting", self.size)
+            self._dispatch_step(0, Params(), 0)
+
+    def _targets(self, step: _FlowStep) -> List[int]:
+        return [0] if step.role == ROLE_SERVER else list(range(1, self.size))
+
+    def _dispatch_step(self, step_idx: int, params: Params, pass_idx: int,
+                       targets: Optional[List[int]] = None) -> None:
+        step = self.flows[step_idx]
+        payload = _params_to_message_fields(params)
+        for target in (targets if targets is not None else self._targets(step)):
+            m = Message(self.MSG_TYPE_FLOW, self.rank, target)
+            m.add(self.ARG_STEP, step_idx)
+            m.add(self.ARG_PASS, pass_idx)
+            m.add("header", payload[0])
+            m.set_arrays(payload[1])
+            self.send_message(m)
+
+    def _on_flow(self, msg: Message) -> None:
+        if msg.get("final"):
+            self.executor.set_params(
+                _params_from_message_fields(msg.get("header"), msg.get_arrays())
+            )
+            self.done.set()
+            self.finish()
+            return
+        step_idx = int(msg.get(self.ARG_STEP))
+        pass_idx = int(msg.get(self.ARG_PASS))
+        step = self.flows[step_idx]
+        if self.rank not in self._targets(step):
+            return
+        params = _params_from_message_fields(msg.get("header"), msg.get_arrays())
+        self.executor.set_params(params)
+        out = step.method(self.executor)
+        out = out if out is not None else Params()
+
+        if step.tag == FLOW_TAG_FINISH:
+            logger.info("flow: FINISH at %r (rank %d)", step.name, self.rank)
+            if self.rank == 0:
+                # propagate final params to everyone, then stop
+                header, arrays = _params_to_message_fields(out)
+                for r in range(1, self.size):
+                    m = Message(self.MSG_TYPE_FLOW, self.rank, r)
+                    m.add("final", True)
+                    m.add("header", header)
+                    m.set_arrays(arrays)
+                    self.send_message(m)
+            self.done.set()
+            self.finish()
+            return
+
+        # advance: each node ships its own result to the next step's nodes;
+        # the next step's handler runs once per arriving message (reference
+        # behavior: flows chain handler→handler, the receiving executor
+        # accumulates across senders).
+        next_idx = step_idx + 1
+        next_pass = pass_idx
+        if next_idx >= len(self.flows):
+            # wrap: skip ONCE steps after the first pass
+            next_pass += 1
+            next_idx = 0
+            while self.flows[next_idx].tag == FLOW_TAG_ONCE:
+                next_idx += 1
+        next_role = self.flows[next_idx].role
+        if step.role == ROLE_SERVER:
+            self._dispatch_step(next_idx, out, next_pass)  # fan out
+        elif next_role == ROLE_SERVER:
+            self._dispatch_step(next_idx, out, next_pass, targets=[0])
+        else:
+            # client → client: each node continues with its own params
+            self._dispatch_step(next_idx, out, next_pass, targets=[self.rank])
+
+
+def _params_to_message_fields(params: Params):
+    """Params → (json-able header, array list). Arrays are extracted."""
+    header: Dict = {}
+    arrays: List[np.ndarray] = []
+    for k in list(params.keys()):
+        v = getattr(params, k)
+        if isinstance(v, (np.ndarray,)) or hasattr(v, "__array__"):
+            header[k] = {"__array__": len(arrays)}
+            arrays.append(np.asarray(v))
+        else:
+            header[k] = v
+    return header, arrays
+
+
+def _params_from_message_fields(header, arrays) -> Params:
+    p = Params()
+    for k, v in (header or {}).items():
+        if isinstance(v, dict) and "__array__" in v:
+            p.add(k, arrays[v["__array__"]])
+        else:
+            p.add(k, v)
+    return p

@@ -1,0 +1,156 @@
+"""MLOps-lite: event tracing, metrics, and system stats.
+
+reference: ``core/mlops/`` (2,217 LoC) — MLOpsProfilerEvent emitting
+{run_id, edge_id, event_name, started/ended_time} to MQTT + wandb
+(mlops_profiler_event.py:9-126), MLOpsMetrics status/metrics topics
+(mlops_metrics.py:18-303), SysStats (system_stats.py:8-165), and the
+``mlops.event/log/log_round_info`` facade (core/mlops/__init__.py:71-385).
+
+TPU re-design: the platform plane (open.fedml.ai MQTT/HTTP agents) is
+replaced by pluggable local sinks — python logging, a JSONL event file, and
+wandb when importable — plus ``jax.profiler`` trace capture for device-level
+profiling. Event names used by the runtimes are kept from the reference
+(train / agg / comm_c2s / server.wait) so dashboards translate 1:1.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("fedml_tpu.mlops")
+
+
+class MLOpsStore:
+    """Process-wide sink registry (reference: MLOpsStore at __init__.py:46)."""
+
+    enabled: bool = False
+    run_id: str = "0"
+    edge_id: int = 0
+    jsonl_path: Optional[str] = None
+    _jsonl_file = None
+    use_wandb: bool = False
+    _wandb = None
+
+
+def init(args) -> None:
+    """reference: mlops.init(args) — binds run/edge ids, opens sinks."""
+    MLOpsStore.enabled = bool(getattr(args, "enable_tracking", False))
+    MLOpsStore.run_id = str(getattr(args, "run_id", "0"))
+    MLOpsStore.edge_id = int(getattr(args, "rank", 0))
+    if not MLOpsStore.enabled:
+        return
+    out_dir = str(getattr(args, "tracking_dir", "") or ".fedml_tpu_runs")
+    os.makedirs(out_dir, exist_ok=True)
+    MLOpsStore.jsonl_path = os.path.join(
+        out_dir, f"run_{MLOpsStore.run_id}_edge_{MLOpsStore.edge_id}.jsonl"
+    )
+    MLOpsStore._jsonl_file = open(MLOpsStore.jsonl_path, "a")
+    if bool(getattr(args, "enable_wandb", False)):
+        try:
+            import wandb
+
+            MLOpsStore._wandb = wandb
+            MLOpsStore.use_wandb = True
+        except ImportError:
+            logger.warning("wandb requested but not importable; skipping")
+
+
+def _emit(record: Dict[str, Any]) -> None:
+    if not MLOpsStore.enabled:
+        return
+    record.setdefault("run_id", MLOpsStore.run_id)
+    record.setdefault("edge_id", MLOpsStore.edge_id)
+    record.setdefault("time", time.time())
+    if MLOpsStore._jsonl_file is not None:
+        MLOpsStore._jsonl_file.write(json.dumps(record) + "\n")
+        MLOpsStore._jsonl_file.flush()
+    logger.debug("mlops: %s", record)
+
+
+def event(event_name: str, event_started: bool = True,
+          event_value: Optional[str] = None) -> None:
+    """reference: mlops.event(...) → MLOpsProfilerEvent.log_event_started/
+    ended; scenario code wraps train/agg/comm_c2s/server.wait phases."""
+    _emit({
+        "kind": "event",
+        "event_name": event_name,
+        "phase": "started" if event_started else "ended",
+        "event_value": event_value,
+    })
+
+
+def log(metrics: Dict[str, Any], step: Optional[int] = None) -> None:
+    """reference: mlops.log — scalar metrics (also to wandb when enabled)."""
+    _emit({"kind": "metrics", "step": step, **metrics})
+    if MLOpsStore.use_wandb:
+        MLOpsStore._wandb.log(metrics, step=step)
+
+
+def log_round_info(round_index: int, total_rounds: int) -> None:
+    """reference: mlops.log_round_info (core/mlops/__init__.py:354-384)."""
+    _emit({"kind": "round_info", "round_index": round_index,
+           "total_rounds": total_rounds})
+
+
+def log_training_status(status: str) -> None:
+    _emit({"kind": "client_status", "status": status})
+
+
+def log_aggregation_status(status: str) -> None:
+    _emit({"kind": "server_status", "status": status})
+
+
+def log_sys_perf() -> None:
+    """reference: SysStats via psutil/nvidia (system_stats.py:8-165) —
+    CPU/mem here; device-side utilization comes from jax.profiler traces."""
+    try:
+        import psutil
+
+        p = psutil.Process()
+        _emit({
+            "kind": "sys_perf",
+            "cpu_percent": psutil.cpu_percent(interval=None),
+            "mem_rss_mb": p.memory_info().rss / 1e6,
+            "mem_percent": psutil.virtual_memory().percent,
+        })
+    except ImportError:
+        pass
+
+
+class MLOpsProfilerEvent:
+    """Span helper (reference: mlops_profiler_event.py) + context manager."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.t0 = 0.0
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        event(self.name, event_started=True)
+        return self
+
+    def __exit__(self, *exc):
+        event(self.name, event_started=False,
+              event_value=f"{time.perf_counter() - self.t0:.6f}s")
+        return False
+
+
+def profile_trace(log_dir: str):
+    """Device-level profiling: jax.profiler trace context (the TPU-native
+    replacement for the reference's wandb latency spans)."""
+    import jax
+
+    return jax.profiler.trace(log_dir)
+
+
+def read_events(path: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Load a run's JSONL event log (test/debug helper)."""
+    p = path or MLOpsStore.jsonl_path
+    if p is None or not os.path.exists(p):
+        return []
+    with open(p) as f:
+        return [json.loads(line) for line in f if line.strip()]

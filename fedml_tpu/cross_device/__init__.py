@@ -1,0 +1,20 @@
+"""``fedml_tpu.cross_device`` — the Beehive pillar (server side).
+
+reference: ``cross_device/server_mnn/`` (ServerMNN + FedMLAggregator, 783 LoC)
+— an FL server whose model artifact is a file phones train on; aggregation
+reads device-uploaded artifacts into tensors, averages, writes back.
+
+Per SURVEY.md §7 stage 9, the MNN C++ engine itself is out of scope on a TPU
+pod (and closed-source in the reference, ``android/README.md``); what is kept
+is the *server-side protocol*: artifact-file model exchange behind the comm
+abstraction, so edge servers aggregate device uploads. Artifacts are ``.npz``
+leaf files (documented compatibility surface replacing ``.mnn``).
+"""
+
+from .server import ServerMNN, read_artifact_as_tensor_dict, write_tensor_dict_to_artifact
+
+__all__ = [
+    "ServerMNN",
+    "read_artifact_as_tensor_dict",
+    "write_tensor_dict_to_artifact",
+]

@@ -1,0 +1,148 @@
+"""Cross-device FL server over model-artifact files.
+
+reference: ``cross_device/server_mnn/fedml_aggregator.py:16-213`` (aggregate
+at :63: read device ``.mnn`` files → tensors → weighted average → write back)
+and ``server_mnn/utils.py:11-50`` (``read_mnn_as_tensor_dict`` /
+``write_tensor_dict_to_mnn``). Artifact format here: ``.npz`` of named leaves.
+
+The message FSM is the cross-silo server's (same S2C_INIT/SYNC/FINISH
+protocol, ``cross_device/server_mnn/FedMLServerManager`` mirrors the Octopus
+one) — devices are clients whose model payloads are artifact files rather
+than inline arrays.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from ..ml.aggregator import create_server_aggregator
+from ..ml.evaluate import make_eval_fn
+
+logger = logging.getLogger(__name__)
+
+
+def write_tensor_dict_to_artifact(tensor_dict: Dict[str, np.ndarray],
+                                  path: str) -> None:
+    """reference: write_tensor_dict_to_mnn (server_mnn/utils.py:31-50)."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    np.savez(path, **{k: np.asarray(v) for k, v in tensor_dict.items()})
+
+
+def read_artifact_as_tensor_dict(path: str) -> Dict[str, np.ndarray]:
+    """reference: read_mnn_as_tensor_dict (server_mnn/utils.py:11-29)."""
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}
+
+
+def params_to_tensor_dict(params) -> Dict[str, np.ndarray]:
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    return {
+        "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path):
+            np.asarray(leaf)
+        for path, leaf in flat
+    }
+
+
+def tensor_dict_to_params(template, tensor_dict: Dict[str, np.ndarray]):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in flat:
+        key = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        leaves.append(np.asarray(tensor_dict[key]).reshape(np.shape(leaf)))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class ServerMNN:
+    """Artifact-file FL server (reference: ServerMNN, cross_device/mnn_server.py).
+
+    Runs rounds against a directory devices upload into:
+    - publishes the global model to ``global_model_file_path``
+    - each round, ingests ``client_*.npz`` uploads (+ a ``.samples`` sidecar
+      for the weight), weighted-averages, re-publishes, evaluates.
+    An ``upload_dir`` poll stands in for the MQTT+S3 transport on a pod with
+    no broker; the aggregation math matches fedml_aggregator.py:63-91.
+    """
+
+    def __init__(self, args, device, dataset, model, server_aggregator=None):
+        self.args = args
+        self.ds = dataset
+        self.bundle = model
+        self.aggregator = server_aggregator or create_server_aggregator(model, args)
+        self.global_model_file_path = str(
+            getattr(args, "global_model_file_path", "")
+            or os.path.join(".", "global_model.npz")
+        )
+        self.upload_dir = str(
+            getattr(args, "device_upload_dir", "") or "./device_uploads"
+        )
+        self.global_params = model.init(
+            jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        )
+        self.aggregator.set_model_params(self.global_params)
+        self.evaluate = make_eval_fn(model)
+        self.round_idx = 0
+        self.final_metrics: Optional[dict] = None
+
+    def publish_global_model(self) -> str:
+        write_tensor_dict_to_artifact(
+            params_to_tensor_dict(self.global_params), self.global_model_file_path
+        )
+        return self.global_model_file_path
+
+    def ingest_uploads(self) -> list:
+        """Collect (num_samples, params) from device artifact uploads."""
+        out = []
+        if not os.path.isdir(self.upload_dir):
+            return out
+        for fn in sorted(os.listdir(self.upload_dir)):
+            if not fn.endswith(".npz"):
+                continue
+            path = os.path.join(self.upload_dir, fn)
+            td = read_artifact_as_tensor_dict(path)
+            params = tensor_dict_to_params(self.global_params, td)
+            sidecar = path[:-4] + ".samples"
+            n = 1.0
+            if os.path.exists(sidecar):
+                with open(sidecar) as f:
+                    n = float(f.read().strip() or 1.0)
+            out.append((n, params))
+        return out
+
+    def run_one_round(self) -> Optional[dict]:
+        """publish → devices train (out of band) → ingest → aggregate → eval."""
+        from ..core.aggregate import stack_trees, weighted_average
+        import jax.numpy as jnp
+
+        uploads = self.ingest_uploads()
+        if not uploads:
+            logger.info("cross_device: no uploads in %s", self.upload_dir)
+            return None
+        uploads = self.aggregator.on_before_aggregation(uploads)
+        weights = jnp.asarray([n for n, _ in uploads])
+        stacked = stack_trees([p for _, p in uploads])
+        agg = weighted_average(stacked, weights)
+        agg = self.aggregator.on_after_aggregation(agg)
+        self.global_params = agg
+        self.aggregator.set_model_params(agg)
+        self.publish_global_model()
+        self.round_idx += 1
+        if self.ds is not None:
+            self.final_metrics = self.evaluate(
+                agg, self.ds.test_x, self.ds.test_y
+            )
+            logger.info("cross_device round %d: acc=%.4f", self.round_idx,
+                        self.final_metrics["test_acc"])
+        return self.final_metrics
+
+    def run(self):
+        """Round loop: each round consumes whatever uploads are present."""
+        self.publish_global_model()
+        rounds = int(getattr(self.args, "comm_round", 1))
+        for _ in range(rounds):
+            self.run_one_round()
+        return self.final_metrics

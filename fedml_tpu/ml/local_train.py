@@ -55,9 +55,24 @@ def make_local_train_fn(
         if str(getattr(args, "federated_optimizer", "")).lower() == "fedprox"
         else 0.0
     )
+    # bf16 compute (MXU-native) with fp32 master weights: forward/backward in
+    # bfloat16, gradients cast back for the fp32 optimizer update. Default
+    # fp32 keeps exactness for the parity tests; bench configs turn this on.
+    bf16 = str(getattr(args, "train_dtype", "fp32")).lower() in (
+        "bf16", "bfloat16"
+    )
 
     def loss_fn(params, bx, by, bmask, rng, global_params):
+        if bf16:
+            params = jax.tree.map(
+                lambda p: p.astype(jnp.bfloat16)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                params,
+            )
+            if jnp.issubdtype(bx.dtype, jnp.floating):
+                bx = bx.astype(jnp.bfloat16)
         logits = bundle.apply(params, bx, train=True, rngs={"dropout": rng})
+        logits = logits.astype(jnp.float32)
         loss, metrics = loss_fn_raw(logits, by, bmask)
         if fedprox_mu > 0.0:
             sq = sum(

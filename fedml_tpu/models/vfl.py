@@ -1,0 +1,36 @@
+"""Vertical-FL party models.
+
+reference: ``model/finance/vfl_models_standalone.py`` (DenseModel guest/host
+pairs used by ``simulation/sp/classical_vertical_fl``). Each party owns a
+feature encoder; the guest additionally owns the interactive head that
+combines both parties' intermediate representations.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from flax import linen as nn
+
+
+class PartyEncoder(nn.Module):
+    """Per-party feature encoder → k-dim intermediate representation."""
+
+    features: Sequence[int] = (32,)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = x.reshape((x.shape[0], -1))
+        for f in self.features[:-1]:
+            h = nn.relu(nn.Dense(f)(h))
+        return nn.Dense(self.features[-1])(h)
+
+
+class InteractiveHead(nn.Module):
+    """Guest-side head over summed party representations → logits."""
+
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, combined, train: bool = False):
+        return nn.Dense(self.num_classes)(nn.relu(combined))

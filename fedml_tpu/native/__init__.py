@@ -1,0 +1,173 @@
+"""``fedml_tpu.native`` — C++ host-runtime components via ctypes.
+
+The compute path is JAX/XLA; the host runtime around it (data pipeline) is
+native, mirroring how the reference leans on torch's C++ DataLoader workers
+(SURVEY.md §1 L0). ``host_pipeline.cpp`` is compiled with g++ on first use
+(no pybind11 in the image — C ABI + ctypes per environment constraints);
+everything degrades to numpy when no toolchain is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(__file__), "host_pipeline.cpp")
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build_lib() -> Optional[str]:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_dir = os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "fedml_tpu",
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    so_path = os.path.join(cache_dir, f"host_pipeline_{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", so_path + ".tmp"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(so_path + ".tmp", so_path)
+        logger.info("native: built %s", so_path)
+        return so_path
+    except (subprocess.CalledProcessError, FileNotFoundError,
+            subprocess.TimeoutExpired) as e:
+        logger.warning("native: build failed (%s); using numpy fallback", e)
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    so = _build_lib()
+    if so is None:
+        return None
+    lib = ctypes.CDLL(so)
+    lib.gather_rows_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int,
+    ]
+    lib.gather_rows_i32.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int,
+    ]
+    lib.prefetcher_create.restype = ctypes.c_void_p
+    lib.prefetcher_create.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.prefetcher_next.restype = ctypes.c_int64
+    lib.prefetcher_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.prefetcher_destroy.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return _LIB
+
+
+def have_native() -> bool:
+    return get_lib() is not None
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _iptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray, threads: int = 4) -> np.ndarray:
+    """Gather src[idx] along axis 0 (float32/int32 fast path)."""
+    lib = get_lib()
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    if lib is None:
+        return src[idx]
+    k = idx.shape[0]
+    row = int(np.prod(src.shape[1:], dtype=np.int64))
+    out = np.empty((k,) + src.shape[1:], src.dtype)
+    iptr = idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    if src.dtype == np.float32:
+        lib.gather_rows_f32(_fptr(src), iptr, k, row, _fptr(out), threads)
+    elif src.dtype == np.int32:
+        lib.gather_rows_i32(_iptr(src), iptr, k, row, _iptr(out), threads)
+    else:
+        return src[idx]
+    return out
+
+
+class BatchPrefetcher:
+    """Background shuffled-batch producer over (x [N, ...] f32, y [N, ...] i32).
+
+    Keeps ``depth`` batches materialized ahead of the consumer; ``next()``
+    returns (x_batch, y_batch, epoch). Pure-numpy fallback shuffles inline.
+    """
+
+    def __init__(self, x: np.ndarray, y: np.ndarray, batch_size: int,
+                 seed: int = 0, threads: int = 4, depth: int = 3):
+        self.x = np.ascontiguousarray(x, dtype=np.float32)
+        self.y = np.ascontiguousarray(y, dtype=np.int32)
+        self.batch = int(batch_size)
+        self._lib = get_lib()
+        self._handle = None
+        self._row = int(np.prod(self.x.shape[1:], dtype=np.int64))
+        self._yrow = int(np.prod(self.y.shape[1:], dtype=np.int64)) or 1
+        if self._lib is not None:
+            self._handle = self._lib.prefetcher_create(
+                _fptr(self.x), _iptr(self.y), self.x.shape[0], self._row,
+                self._yrow, self.batch, int(seed) & (2**64 - 1), threads, depth,
+            )
+        else:
+            self._rng = np.random.RandomState(seed)
+            self._perm = self._rng.permutation(self.x.shape[0])
+            self._cursor = 0
+            self._epoch = 0
+
+    def next(self) -> Tuple[np.ndarray, np.ndarray, int]:
+        bx = np.empty((self.batch,) + self.x.shape[1:], np.float32)
+        by = np.empty((self.batch,) + self.y.shape[1:], np.int32)
+        if self._handle is not None:
+            epoch = self._lib.prefetcher_next(self._handle, _fptr(bx), _iptr(by))
+            return bx, by, int(epoch)
+        idx = []
+        for _ in range(self.batch):
+            if self._cursor >= len(self._perm):
+                self._epoch += 1
+                self._perm = self._rng.permutation(self.x.shape[0])
+                self._cursor = 0
+            idx.append(self._perm[self._cursor])
+            self._cursor += 1
+        idx = np.asarray(idx)
+        return self.x[idx], self.y[idx], self._epoch
+
+    def close(self) -> None:
+        if self._handle is not None and self._lib is not None:
+            self._lib.prefetcher_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self.close()
+        except Exception:
+            pass

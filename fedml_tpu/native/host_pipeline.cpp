@@ -1,0 +1,184 @@
+// Host-side data pipeline: threaded batch gather + prefetch ring buffer.
+//
+// Role in the framework: the reference's input pipeline rides torch
+// DataLoader's native worker pool (SURVEY.md §1 L0 — "torch (C++/CUDA)" is a
+// pip-dep native backend). This TPU build feeds jit'd steps from numpy
+// arrays; the Python-side gather of a cohort/batch is GIL-bound and can
+// starve the device between steps. This translation unit provides:
+//
+//   gather_rows_f32 / gather_rows_i32 — multi-threaded row gather
+//     (memcpy per row, rows split across a small thread pool)
+//   prefetcher_*                      — a background ring buffer that keeps
+//     the next `depth` shuffled batches materialized while the device
+//     computes (per-epoch mt19937_64 Fisher–Yates shuffle, epoch-tagged)
+//
+// Exposed as a C ABI for ctypes (no pybind11 in the image); the Python
+// wrapper (fedml_tpu/native/__init__.py) compiles this file on first use and
+// falls back to numpy when a toolchain is unavailable.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+namespace {
+
+void gather_rows_impl(const char* src, const int64_t* idx, int64_t k,
+                      int64_t row_bytes, char* dst, int threads) {
+  if (threads < 1) threads = 1;
+  if (threads == 1 || k < 4 * threads) {
+    for (int64_t i = 0; i < k; ++i) {
+      std::memcpy(dst + i * row_bytes, src + idx[i] * row_bytes, row_bytes);
+    }
+    return;
+  }
+  std::vector<std::thread> pool;
+  int64_t chunk = (k + threads - 1) / threads;
+  for (int t = 0; t < threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = std::min(lo + chunk, k);
+    if (lo >= hi) break;
+    pool.emplace_back([=] {
+      for (int64_t i = lo; i < hi; ++i) {
+        std::memcpy(dst + i * row_bytes, src + idx[i] * row_bytes, row_bytes);
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+struct Batch {
+  std::vector<float> x;
+  std::vector<int32_t> y;
+  int64_t epoch;
+};
+
+struct Prefetcher {
+  const float* x;
+  const int32_t* y;
+  int64_t n, row_elems, y_elems, batch;
+  int gather_threads;
+  size_t depth;
+  std::mt19937_64 rng;
+
+  std::deque<Batch> ring;
+  std::mutex mu;
+  std::condition_variable cv_put, cv_get;
+  std::atomic<bool> stop{false};
+  std::thread worker;
+
+  std::vector<int64_t> perm;
+  int64_t cursor = 0;
+  int64_t epoch = 0;
+
+  void reshuffle() {
+    perm.resize(n);
+    for (int64_t i = 0; i < n; ++i) perm[i] = i;
+    for (int64_t i = n - 1; i > 0; --i) {
+      std::uniform_int_distribution<int64_t> d(0, i);
+      std::swap(perm[i], perm[d(rng)]);
+    }
+    cursor = 0;
+  }
+
+  void fill_loop() {
+    while (!stop.load()) {
+      Batch b;
+      b.x.resize(batch * row_elems);
+      b.y.resize(batch * y_elems);
+      {
+        // assemble indices for the next batch (wrap => new epoch/shuffle)
+        std::vector<int64_t> idx(batch);
+        for (int64_t i = 0; i < batch; ++i) {
+          if (cursor >= n) {
+            ++epoch;
+            reshuffle();
+          }
+          idx[i] = perm[cursor++];
+        }
+        gather_rows_impl(reinterpret_cast<const char*>(x), idx.data(), batch,
+                         row_elems * sizeof(float),
+                         reinterpret_cast<char*>(b.x.data()), gather_threads);
+        gather_rows_impl(reinterpret_cast<const char*>(y), idx.data(), batch,
+                         y_elems * sizeof(int32_t),
+                         reinterpret_cast<char*>(b.y.data()), gather_threads);
+        b.epoch = epoch;
+      }
+      std::unique_lock<std::mutex> lk(mu);
+      cv_put.wait(lk, [&] { return ring.size() < depth || stop.load(); });
+      if (stop.load()) return;
+      ring.push_back(std::move(b));
+      cv_get.notify_one();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void gather_rows_f32(const float* src, const int64_t* idx, int64_t k,
+                     int64_t row_elems, float* dst, int threads) {
+  gather_rows_impl(reinterpret_cast<const char*>(src), idx, k,
+                   row_elems * static_cast<int64_t>(sizeof(float)),
+                   reinterpret_cast<char*>(dst), threads);
+}
+
+void gather_rows_i32(const int32_t* src, const int64_t* idx, int64_t k,
+                     int64_t row_elems, int32_t* dst, int threads) {
+  gather_rows_impl(reinterpret_cast<const char*>(src), idx, k,
+                   row_elems * static_cast<int64_t>(sizeof(int32_t)),
+                   reinterpret_cast<char*>(dst), threads);
+}
+
+void* prefetcher_create(const float* x, const int32_t* y, int64_t n,
+                        int64_t row_elems, int64_t y_elems, int64_t batch,
+                        uint64_t seed, int gather_threads, int depth) {
+  auto* p = new Prefetcher();
+  p->x = x;
+  p->y = y;
+  p->n = n;
+  p->row_elems = row_elems;
+  p->y_elems = y_elems;
+  p->batch = batch;
+  p->gather_threads = gather_threads;
+  p->depth = depth > 0 ? static_cast<size_t>(depth) : 2;
+  p->rng.seed(seed);
+  p->reshuffle();
+  p->worker = std::thread([p] { p->fill_loop(); });
+  return p;
+}
+
+// Blocks until a batch is ready; copies into out_x/out_y; returns the epoch
+// index the batch belongs to, or -1 after destroy.
+int64_t prefetcher_next(void* vp, float* out_x, int32_t* out_y) {
+  auto* p = static_cast<Prefetcher*>(vp);
+  Batch b;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->cv_get.wait(lk, [&] { return !p->ring.empty() || p->stop.load(); });
+    if (p->ring.empty()) return -1;
+    b = std::move(p->ring.front());
+    p->ring.pop_front();
+    p->cv_put.notify_one();
+  }
+  std::memcpy(out_x, b.x.data(), b.x.size() * sizeof(float));
+  std::memcpy(out_y, b.y.data(), b.y.size() * sizeof(int32_t));
+  return b.epoch;
+}
+
+void prefetcher_destroy(void* vp) {
+  auto* p = static_cast<Prefetcher*>(vp);
+  p->stop.store(true);
+  p->cv_put.notify_all();
+  p->cv_get.notify_all();
+  if (p->worker.joinable()) p->worker.join();
+  delete p;
+}
+
+}  // extern "C"

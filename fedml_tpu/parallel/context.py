@@ -1,0 +1,45 @@
+"""Sequence-parallel activation context.
+
+The transformer module needs to know, at trace time, whether activations are
+sharded over the ``sequence`` mesh axis (→ use ring attention via shard_map)
+— but Flax modules can't take a Mesh as a call argument without threading it
+through every layer. A context manager scopes it instead; CheetahTrainer
+enters it around jit tracing when ``seq_sharded=True``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass
+from typing import Optional
+
+from jax.sharding import Mesh
+
+from .. import constants
+
+
+@dataclass(frozen=True)
+class SeqParallelCtx:
+    mesh: Mesh
+    axis_name: str
+    size: int
+
+
+_ACTIVE: Optional[SeqParallelCtx] = None
+
+
+@contextlib.contextmanager
+def sequence_parallelism(mesh: Mesh, axis_name: str = constants.MESH_AXIS_SEQUENCE):
+    """Activate sequence parallelism for model traces inside the block."""
+    global _ACTIVE
+    size = int(mesh.shape[axis_name]) if axis_name in mesh.axis_names else 1
+    prev = _ACTIVE
+    _ACTIVE = SeqParallelCtx(mesh, axis_name, size) if size > 1 else None
+    try:
+        yield _ACTIVE
+    finally:
+        _ACTIVE = prev
+
+
+def get_seq_context() -> Optional[SeqParallelCtx]:
+    return _ACTIVE

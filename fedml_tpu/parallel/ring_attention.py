@@ -1,0 +1,84 @@
+"""Ring attention: exact causal attention with the sequence sharded over a
+mesh axis.
+
+New capability with NO reference analog (SURVEY.md §5 "Long-context /
+sequence parallelism": absent in any form — the framework predates
+long-context work). The design follows the public Ring Attention recipe
+(blockwise attention with online softmax + K/V rotation over the ring):
+
+- each of the S devices on the ``sequence`` axis holds one block of Q, K, V
+- S steps: attend the local Q block against the currently-held K/V block
+  (flash-style running (m, l, o) accumulators), then ``lax.ppermute`` K/V one
+  hop around the ring — compute and ICI transfer overlap, peak memory is
+  O(L/S) per device, and the result is EXACT attention over the full length
+- causal masking by global block offsets: past blocks attend fully, the
+  diagonal block uses the in-block triangle, future blocks are skipped
+
+Call from inside ``shard_map`` with the sequence axis named; q/k/v carry the
+per-device local blocks ``[B, L/S, H, D]``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_offset, kv_offset, causal, scale):
+    """One Q-block × K/V-block partial attention.
+
+    Returns (scores_max [B,H,Lq], exp_scores [B,H,Lq,Lk], pv [B,H,Lq,D]).
+    """
+    logits = jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32) * scale
+    if causal:
+        Lq, Lk = q.shape[1], k.shape[1]
+        qpos = q_offset + jnp.arange(Lq)
+        kpos = kv_offset + jnp.arange(Lk)
+        mask = qpos[:, None] >= kpos[None, :]
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    return logits
+
+
+def make_ring_attention(static_ring_size: int, axis_name: str, causal: bool = True):
+    """Build a ring-attention fn for a statically-known ring size (the mesh
+    axis size is always known at trace time)."""
+    S = int(static_ring_size)
+    rot_pairs = [(i, (i + 1) % S) for i in range(S)]
+
+    def fn(q, k, v):
+        B, Lb, H, Dh = q.shape
+        scale = 1.0 / jnp.sqrt(jnp.asarray(Dh, jnp.float32))
+        my = jax.lax.axis_index(axis_name)
+        q_offset = my * Lb
+
+        def step(carry, s):
+            o, m, l, k_cur, v_cur = carry
+            kv_idx = (my - s) % S
+            kv_offset = kv_idx * Lb
+            logits = _block_attend(q, k_cur, v_cur, q_offset, kv_offset,
+                                   causal, scale)  # [B,H,Lq,Lk]
+            m_blk = jnp.max(logits, axis=-1)  # [B,H,Lq]
+            m_new = jnp.maximum(m, m_blk)
+            corr = jnp.exp(m - m_new)
+            p = jnp.exp(logits - m_new[..., None])  # [B,H,Lq,Lk]
+            l_new = l * corr + p.sum(-1)
+            pv = jnp.einsum("bhlm,bmhd->bhld", p, v_cur.astype(jnp.float32))
+            o_new = o * corr[..., None] + pv
+            k_next = jax.lax.ppermute(k_cur, axis_name, rot_pairs)
+            v_next = jax.lax.ppermute(v_cur, axis_name, rot_pairs)
+            return (o_new, m_new, l_new, k_next, v_next), None
+
+        o0 = jnp.zeros((B, H, Lb, Dh), jnp.float32)
+        m0 = jnp.full((B, H, Lb), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((B, H, Lb), jnp.float32)
+        (o, m, l, _, _), _ = jax.lax.scan(
+            step, (o0, m0, l0, k, v), jnp.arange(S)
+        )
+        out = o / jnp.maximum(l[..., None], 1e-30)
+        return jnp.einsum("bhld->blhd", out).astype(q.dtype)
+
+    return fn

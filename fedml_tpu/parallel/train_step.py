@@ -177,5 +177,10 @@ class CheetahTrainer:
 
     def train_step(self, state: TrainState, tokens, mask) -> Tuple[TrainState, dict]:
         tokens, mask = self.shard_batch(tokens, mask)
+        if self.seq_sharded:
+            from .context import sequence_parallelism
+
+            with self.mesh, sequence_parallelism(self.mesh):
+                return self._step_jit(state, tokens, mask)
         with self.mesh:
             return self._step_jit(state, tokens, mask)

@@ -159,7 +159,36 @@ class Attention(nn.Module):
         v = v.reshape(B, L, Hkv, hd)
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
-        out = attention_scores(q, k, v, mask)
+
+        from .context import get_seq_context
+
+        seq_ctx = get_seq_context()
+        if seq_ctx is not None:
+            # sequence parallelism: exact attention over the ring (L stays
+            # sharded; K/V rotate over ICI — ring_attention.py)
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            from .. import constants as _c
+            from .ring_attention import make_ring_attention
+
+            if Hkv != H:  # repeat K/V heads before sharding (GQA)
+                rep = H // Hkv
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            spec = P(
+                (_c.MESH_AXIS_DATA, _c.MESH_AXIS_FSDP),
+                seq_ctx.axis_name,
+                _c.MESH_AXIS_TENSOR,
+                None,
+            )
+            ring = make_ring_attention(seq_ctx.size, seq_ctx.axis_name)
+            out = shard_map(
+                ring, mesh=seq_ctx.mesh, in_specs=(spec, spec, spec),
+                out_specs=spec, check_rep=False,
+            )(q, k, v)
+        else:
+            out = attention_scores(q, k, v, mask)
         out = out.reshape(B, L, H * hd)
         return jnp.einsum("ble,ed->bld", out, wo.astype(cfg.dtype))
 

