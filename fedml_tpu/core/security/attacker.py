@@ -38,10 +38,14 @@ class FedMLAttacker:
             "byzantine_zero",
             "byzantine_flip",
             "model_replacement",
+            "alie",
         )
 
     def is_data_attack(self) -> bool:
-        return self.is_enabled and self.attack_type == "label_flipping"
+        return self.is_enabled and self.attack_type in (
+            "label_flipping",
+            "backdoor_pattern",
+        )
 
     def attack_model(
         self, updates: jax.Array, weights: jax.Array, key: jax.Array, round_idx: int = 0
@@ -63,16 +67,48 @@ class FedMLAttacker:
                 updates, mask, key, self.attack_type.split("_", 1)[1],
                 scale=float(getattr(self.args, "byzantine_scale", 1.0)),
             )
+        if self.attack_type == "alie":
+            return attacks.alie_attack(
+                updates, mask, float(getattr(self.args, "num_std", 1.5))
+            )
         boost = float(getattr(self.args, "attack_boost", float(n)))
         global_vec = jnp.average(updates, axis=0, weights=weights)
         boosted = attacks.model_replacement_scale(updates, global_vec, boost)
         return updates * (1 - mask[:, None]) + boosted * mask[:, None]
 
-    def attack_data(self, labels: jax.Array) -> jax.Array:
+    def attack_data(self, x: jax.Array, labels: jax.Array):
+        """Poison the cohort's training data → (x, labels).
+
+        label_flipping leaves x alone; backdoor_pattern stamps the trigger
+        patch on a fraction of the malicious clients' samples AND relabels
+        them to the target class.
+        """
         if not self.is_data_attack():
-            return labels
-        return attacks.label_flipping(
-            labels,
-            int(getattr(self.args, "original_class", 0)),
-            int(getattr(self.args, "target_class", 1)),
+            return x, labels
+        if self.attack_type == "label_flipping":
+            return x, attacks.label_flipping(
+                labels,
+                int(getattr(self.args, "original_class", 0)),
+                int(getattr(self.args, "target_class", 1)),
+            )
+        # backdoor_pattern: malicious clients poison poison_frac of samples
+        n = labels.shape[0]
+        frac = float(getattr(self.args, "byzantine_client_frac", 0.2))
+        num_bad = int(round(n * frac))
+        rng = np.random.RandomState(int(getattr(self.args, "random_seed", 0)))
+        client_mask = np.zeros((n,), np.float32)
+        if num_bad:
+            client_mask[rng.choice(n, num_bad, replace=False)] = 1.0
+        poison_frac = float(getattr(self.args, "poison_frac", 0.5))
+        sample_mask = (
+            rng.random_sample(labels.shape) < poison_frac
+        ).astype(np.float32)
+        mask = jnp.asarray(
+            sample_mask * client_mask.reshape((-1,) + (1,) * (labels.ndim - 1))
+        )
+        return attacks.pattern_backdoor_poison(
+            x, labels, mask,
+            int(getattr(self.args, "target_class", 0)),
+            float(getattr(self.args, "pattern_value", 2.8)),
+            int(getattr(self.args, "pattern_size", 5)),
         )

@@ -59,6 +59,128 @@ def model_replacement_scale(
     return global_vec + boost * (update - global_vec)
 
 
+def alie_attack(
+    updates: jax.Array,
+    byzantine_mask: jax.Array,
+    num_std: float = 1.5,
+) -> jax.Array:
+    """"A Little Is Enough" backdoor/poisoning attack (reference:
+    ``backdoor_attack.py``, Baruch et al. NeurIPS'19).
+
+    Malicious clients move every coordinate to ``mean + num_std * std`` of the
+    honest population — inside the plausible range, so norm-based defenses
+    pass it through, yet the aggregate is steadily dragged. One fused op on
+    the stacked matrix: the reference's per-client numpy loop
+    (``backdoor_attack.py:63-85``) becomes two masked moment reductions.
+    """
+    m = byzantine_mask[:, None]
+    honest = 1.0 - m
+    cnt = jnp.maximum(honest.sum(), 1.0)
+    mean = (updates * honest).sum(0, keepdims=True) / cnt
+    var = (((updates - mean) ** 2) * honest).sum(0, keepdims=True) / cnt
+    mal = mean + num_std * jnp.sqrt(var)
+    return updates * (1 - m) + mal * m
+
+
+def pattern_backdoor_poison(
+    x: jax.Array,
+    y: jax.Array,
+    poison_mask: jax.Array,
+    target_class: int,
+    pattern_value: float = 2.8,
+    pattern_size: int = 5,
+) -> Tuple[jax.Array, jax.Array]:
+    """Stamp a trigger patch onto selected samples and relabel them
+    (reference: ``backdoor_attack.py:89-93`` ``add_pattern``:
+    ``img[:, :5, :5] = 2.8``).
+
+    ``x``: [..., H, W, C] images (NHWC — TPU-native layout) or [..., d] flat
+    features; ``poison_mask``: broadcastable 0/1 over the sample axes. The
+    trigger is written with a static slice so the op stays jit-compatible.
+    """
+    p = pattern_size
+    if x.ndim >= 3:  # images [..., H, W, C]
+        patch = jnp.zeros_like(x).at[..., :p, :p, :].set(1.0)
+    else:  # flat features [..., d]
+        patch = jnp.zeros_like(x).at[..., :p].set(1.0)
+    pm = poison_mask.reshape(poison_mask.shape + (1,) * (x.ndim - poison_mask.ndim))
+    x_poisoned = jnp.where(patch * pm > 0, pattern_value, x)
+    y_poisoned = jnp.where(poison_mask > 0, target_class, y).astype(y.dtype)
+    return x_poisoned, y_poisoned
+
+
+def reveal_labels_from_gradients(last_layer_weight_grad: jax.Array) -> jax.Array:
+    """iDLG label revelation (reference:
+    ``revealing_labels_from_gradients_attack.py``, Zhao et al.).
+
+    With cross-entropy loss, the last-layer weight-gradient row of a present
+    class has negative projection (softmax(p) - 1 < 0 for the true class).
+    Returns per-class scores; ``argmin`` gives the single-sample label
+    exactly, and for batches classes with the most-negative scores are the
+    labels present.
+
+    ``last_layer_weight_grad``: [d_in, num_classes] or [num_classes, d_in]
+    — reduced over the feature axis, keeping the class axis last.
+    """
+    g = last_layer_weight_grad
+    if g.ndim != 2:
+        raise ValueError(f"expected 2-D last-layer grad, got {g.shape}")
+    # class axis = the one whose per-index sums are mostly tiny/negative —
+    # conventionally flax Dense kernels are [d_in, num_classes]
+    return jnp.sum(g, axis=0)
+
+
+def invert_gradient_attack(
+    grad_fn: Callable[[jax.Array, jax.Array], Tuple[jax.Array, ...]],
+    true_grads: Tuple[jax.Array, ...],
+    dummy_x: jax.Array,
+    labels: jax.Array,
+    lr: float = 0.1,
+    iters: int = 200,
+    tv_weight: float = 1e-2,
+) -> jax.Array:
+    """Geiping-style gradient inversion ("Inverting Gradients", reference:
+    ``invert_gradient_attack.py``, 723 LoC of torch): reconstruct inputs by
+    maximising cosine similarity between dummy and observed gradients with a
+    total-variation prior, signed-gradient Adam steps.
+
+    Unlike :func:`dlg_attack` (L2 matching, joint label optimisation) this
+    takes labels as known — recover them first with
+    :func:`reveal_labels_from_gradients` — and optimises images only. The
+    whole loop is one jitted ``lax.scan`` on device.
+    """
+    import optax
+
+    def cos_loss(dx):
+        g = grad_fn(dx, labels)
+        dot = sum(jnp.sum(a * b) for a, b in zip(g, true_grads))
+        # eps inside the sqrts keeps the gradient finite at g == 0
+        na = jnp.sqrt(sum(jnp.sum(a * a) for a in g) + 1e-12)
+        nb = jnp.sqrt(sum(jnp.sum(b * b) for b in true_grads) + 1e-12)
+        rec = 1.0 - dot / (na * nb)
+        if dummy_x.ndim >= 3:  # total variation over the two spatial axes
+            h_ax, w_ax = dummy_x.ndim - 3, dummy_x.ndim - 2
+            tv = jnp.mean(jnp.abs(jnp.diff(dx, axis=h_ax))) + jnp.mean(
+                jnp.abs(jnp.diff(dx, axis=w_ax))
+            )
+        else:
+            tv = jnp.mean(jnp.abs(jnp.diff(dx, axis=-1)))
+        return rec + tv_weight * tv
+
+    opt = optax.adam(lr)
+    opt_state = opt.init(dummy_x)
+
+    def step(carry, _):
+        dx, opt_state = carry
+        g = jax.grad(cos_loss)(dx)
+        g = jnp.sign(g)  # signed gradients (Geiping et al. §4)
+        updates, opt_state = opt.update(g, opt_state)
+        return (optax.apply_updates(dx, updates), opt_state), None
+
+    (dx, _), _ = jax.lax.scan(step, (dummy_x, opt_state), None, length=iters)
+    return dx
+
+
 def dlg_attack(
     grad_fn: Callable[[jax.Array, jax.Array], Tuple[jax.Array, ...]],
     true_grads: Tuple[jax.Array, ...],

@@ -26,6 +26,7 @@ DEFENSE_TYPES = (
     "cclip",
     "robust_learning_rate",
     "weak_dp",
+    "wbc",
 )
 
 
@@ -36,6 +37,7 @@ class FedMLDefender:
         self.is_enabled = False
         self.defense_type = ""
         self.args = None
+        self._wbc_old = None  # previous round's pseudo-gradients (FL-WBC)
 
     @classmethod
     def get_instance(cls) -> "FedMLDefender":
@@ -47,6 +49,7 @@ class FedMLDefender:
         self.is_enabled = bool(getattr(args, "enable_defense", False))
         self.defense_type = (getattr(args, "defense_type", "") or "").strip().lower()
         self.args = args
+        self._wbc_old = None
         if self.is_enabled and self.defense_type not in DEFENSE_TYPES:
             raise ValueError(
                 f"unknown defense_type {self.defense_type!r}; known: {DEFENSE_TYPES}"
@@ -105,4 +108,23 @@ class FedMLDefender:
             return defenses.weak_dp(
                 agg, key, float(getattr(a, "stddev", 0.002))
             )
+        if t == "wbc":
+            # FL-WBC applied round-wise: per-client pseudo-gradient vs the
+            # previous round's (manager state) identifies the stagnant
+            # subspace where poisoning persists; Laplace noise perturbs it.
+            grads = updates - global_vec[None, :]
+            old = self._wbc_old if self._wbc_old is not None else jnp.zeros_like(grads)
+            if old.shape != grads.shape:
+                old = jnp.zeros_like(grads)
+            keys = jax.random.split(key, updates.shape[0])
+            perturbed = jax.vmap(
+                lambda u, g, o, k: defenses.wbc_perturb(
+                    u, g, o, k,
+                    float(getattr(a, "pert_strength", 1.0)),
+                    float(getattr(a, "wbc_lr", 0.1)),
+                )
+            )(updates, grads, old, keys)
+            self._wbc_old = grads
+            w = weights / jnp.sum(weights)
+            return (w[:, None] * perturbed).sum(0)
         raise ValueError(f"unknown defense_type {t!r}")

@@ -157,6 +157,67 @@ def weak_dp(
     return aggregate + stddev * jax.random.normal(key, aggregate.shape, aggregate.dtype)
 
 
+def soteria_mask(
+    feature_fn, x: jax.Array, prune_percentile: float = 1.0
+) -> jax.Array:
+    """Soteria representation-pruning mask (reference: ``soteria_defense.py``,
+    Sun et al. CVPR'21 "Provable defense against privacy leakage").
+
+    For each feature ``r_f`` of the defended representation layer, compute the
+    leakage ratio ``||dr_f/dx|| / |r_f|`` and zero out the features in the
+    lowest ``prune_percentile`` percent — those are the ones a gradient-
+    inversion attacker relies on most cheaply.
+
+    The reference builds the Jacobian with a Python loop of per-feature
+    ``backward()`` calls (``soteria_defense.py:54-63``); here it's ONE
+    ``jax.jacrev`` — the full [d_r, x_dim] Jacobian in a single fused program.
+
+    ``feature_fn``: x → representation [d_r]. Returns a 0/1 mask [d_r] to be
+    multiplied into the defended layer's gradient before sharing.
+    """
+    r = feature_fn(x)
+    jac = jax.jacrev(feature_fn)(x)  # [d_r, *x.shape]
+    jac = jac.reshape(r.shape[0], -1)
+    ratio = jnp.linalg.norm(jac, axis=1) / jnp.maximum(jnp.abs(r), 1e-12)
+    thresh = jnp.percentile(ratio, prune_percentile)
+    return (ratio >= thresh).astype(jnp.float32)
+
+
+def apply_soteria(defended_layer_grad: jax.Array, mask: jax.Array) -> jax.Array:
+    """Apply the Soteria mask to the defended (fc) layer's gradient
+    (reference: ``soteria_defense.py:78``). Grad shape [d_r, ...] or [d_r]."""
+    return defended_layer_grad * mask.reshape(
+        (mask.shape[0],) + (1,) * (defended_layer_grad.ndim - 1)
+    )
+
+
+def wbc_perturb(
+    param_vec: jax.Array,
+    grad: jax.Array,
+    old_grad: jax.Array,
+    key: jax.Array,
+    pert_strength: float = 1.0,
+    learning_rate: float = 0.1,
+) -> jax.Array:
+    """FL-WBC "White Blood Cell" client-side perturbation (reference:
+    ``wbc_defense.py``, Sun et al. NeurIPS'21).
+
+    The attack effect on parameters persists in the subspace where the
+    gradient barely changes between batches; WBC injects Laplace noise into
+    exactly the coordinates where ``|grad - old_grad|`` is smaller than the
+    sampled noise — perturbing the attack-carrying subspace while leaving
+    well-learned coordinates alone (``wbc_defense.py:59-70``).
+    """
+    grad_diff = jnp.abs(grad - old_grad)
+    # Laplace(0, b) via inverse-CDF of uniform
+    u = jax.random.uniform(
+        key, param_vec.shape, minval=-0.499999, maxval=0.499999
+    )
+    noise = -pert_strength * jnp.sign(u) * jnp.log1p(-2.0 * jnp.abs(u))
+    noise = jnp.where(grad_diff > jnp.abs(noise), 0.0, noise)
+    return param_vec + learning_rate * noise
+
+
 def multikrum_weighted(
     updates: jax.Array, weights: jax.Array, byzantine_count: int, m: int
 ) -> jax.Array:

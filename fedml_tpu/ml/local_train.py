@@ -150,11 +150,14 @@ def make_grad_fn(bundle, args, cap: int):
         loss, _ = loss_fn_raw(logits, y, mask)
         return loss
 
-    grad = jax.grad(loss_fn)
+    grad = jax.value_and_grad(loss_fn)
 
     def client_grad(global_params, x, y, n, rng):
         mask = (jnp.arange(cap) < n).astype(jnp.float32)
-        g = grad(global_params, x, y, mask, rng)
-        return g, {"num_samples": n.astype(jnp.float32)}
+        loss, g = grad(global_params, x, y, mask, rng)
+        return g, {
+            "train_loss": loss,
+            "num_samples": n.astype(jnp.float32),
+        }
 
     return client_grad

@@ -63,6 +63,47 @@ def lm_loss(logits: jax.Array, tokens: jax.Array, mask: jax.Array) -> jax.Array:
     return (per * m).sum() / jnp.maximum(m.sum(), 1.0)
 
 
+def lm_loss_chunked(
+    hidden: jax.Array,
+    w_head: jax.Array,
+    tokens: jax.Array,
+    mask: jax.Array,
+    chunk: int = 256,
+) -> jax.Array:
+    """Fused head-matmul + next-token CE, chunked over the sequence.
+
+    ``hidden``: [B, L, D] (bf16), ``w_head``: [D, V]. The full [B, L, V]
+    fp32 logits tensor (≈1 GB at B=4, L=2k, V=32k) is never materialised:
+    each lax.scan step computes one [B, chunk, V] slice, reduces it to CE
+    sums, and discards it — HBM-bandwidth-bound CE becomes MXU-bound.
+    """
+    B, L, D = hidden.shape
+    h = hidden[:, :-1]
+    targets = tokens[:, 1:]
+    m = mask[:, 1:].astype(jnp.float32)
+    n = L - 1
+    chunk = min(chunk, n)
+    pad = (-n) % chunk
+    if pad:
+        h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        m = jnp.pad(m, ((0, 0), (0, pad)))
+    steps = (n + pad) // chunk
+    h = h.reshape(B, steps, chunk, D).swapaxes(0, 1)
+    targets = targets.reshape(B, steps, chunk).swapaxes(0, 1)
+    m = m.reshape(B, steps, chunk).swapaxes(0, 1)
+    w = w_head.astype(hidden.dtype)
+
+    def body(acc, xs):
+        hc, tc, mc = xs
+        logits = (hc @ w).astype(jnp.float32)
+        per = optax.softmax_cross_entropy_with_integer_labels(logits, tc)
+        return acc + (per * mc).sum(), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros(()), (h, targets, m))
+    return total / jnp.maximum(m.sum(), 1.0)
+
+
 class CheetahTrainer:
     """Builds and owns the sharded init + train step for one config/mesh."""
 
@@ -73,6 +114,7 @@ class CheetahTrainer:
         optimizer: Optional[optax.GradientTransformation] = None,
         accum_steps: int = 1,
         seq_sharded: bool = False,
+        loss_chunk: int = 256,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -80,6 +122,9 @@ class CheetahTrainer:
         self.opt = optimizer or make_optimizer()
         self.accum_steps = int(accum_steps)
         self.seq_sharded = seq_sharded
+        # chunked CE needs whole-L hidden states per shard; under sequence
+        # sharding L is split across devices, so fall back to full logits
+        self.loss_chunk = 0 if seq_sharded else int(loss_chunk)
         self._batch_shard = batch_sharding(mesh, seq_sharded)
         self._repl = replicated(mesh)
 
@@ -131,6 +176,13 @@ class CheetahTrainer:
 
     # -- train step ---------------------------------------------------------
     def _loss_fn(self, params, tokens, mask):
+        if self.loss_chunk > 0:
+            hidden = self.model.apply(
+                {"params": params}, tokens, mask=None, return_hidden=True
+            )
+            return lm_loss_chunked(
+                hidden, params["w_lm_head"], tokens, mask, self.loss_chunk
+            )
         logits = self.model.apply({"params": params}, tokens, mask=None)
         return lm_loss(logits, tokens, mask)
 

@@ -49,6 +49,11 @@ class TransformerConfig:
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
     remat: bool = True  # jax.checkpoint each block (HBM ⇄ FLOPs trade)
+    # "auto": Pallas flash attention on TPU, XLA attention elsewhere;
+    # "flash" / "xla" force one. Flash keeps the [L, L] score matrix in VMEM
+    # tiles (never materialised in HBM) — the decisive single-chip win at
+    # long sequence.
+    attn_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -103,6 +108,39 @@ def apply_rotary(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     c = cos[..., None, :]
     s = sin[..., None, :]
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+def _use_flash(impl: str) -> bool:
+    if impl == "flash":
+        return True
+    if impl == "auto":
+        import jax as _jax
+
+        try:
+            return _jax.devices()[0].platform == "tpu"
+        except Exception:
+            return False
+    return False
+
+
+def flash_attention_tpu(
+    q: jax.Array, k: jax.Array, v: jax.Array
+) -> jax.Array:
+    """Causal flash attention via the Pallas TPU kernel.
+
+    q/k/v: [B, L, H, D] (Hkv already expanded for GQA) → out [B, L, H, D].
+    The kernel wants [B, H, L, D]; blocks stream through VMEM so the [L, L]
+    score matrix never hits HBM — replaces the XLA path's fp32
+    ``bhlm`` logits tensor (the single biggest HBM consumer at long L).
+    """
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as _flash,
+    )
+
+    D = q.shape[-1]
+    qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
+    out = _flash(qt, kt, vt, causal=True, sm_scale=float(1.0 / D ** 0.5))
+    return out.swapaxes(1, 2)
 
 
 def attention_scores(
@@ -187,6 +225,12 @@ class Attention(nn.Module):
                 ring, mesh=seq_ctx.mesh, in_specs=(spec, spec, spec),
                 out_specs=spec, check_rep=False,
             )(q, k, v)
+        elif mask is None and L >= 128 and L % 128 == 0 and _use_flash(cfg.attn_impl):
+            if Hkv != H:
+                rep = H // Hkv
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            out = flash_attention_tpu(q, k, v)
         else:
             out = attention_scores(q, k, v, mask)
         out = out.reshape(B, L, H * hd)
@@ -235,7 +279,7 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, mask=None, positions=None):
+    def __call__(self, tokens, mask=None, positions=None, return_hidden=False):
         cfg = self.cfg
         embed = self.param(
             "embed",
@@ -260,6 +304,11 @@ class Transformer(nn.Module):
             (cfg.d_model, cfg.vocab_size),
             cfg.param_dtype,
         )
+        if return_hidden:
+            # the caller fuses the head matmul into a chunked loss
+            # (train_step.lm_loss_chunked) so [B, L, vocab] fp32 logits are
+            # never materialised in HBM
+            return x
         return jnp.einsum("bld,dv->blv", x, w_out.astype(cfg.dtype)).astype(
             jnp.float32
         )

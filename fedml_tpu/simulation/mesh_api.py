@@ -7,21 +7,25 @@ aggregators doing torch.distributed broadcast/reduce; see SURVEY.md §3.2/§3.3)
 
 - the cohort's packed arrays are sharded over a 1-D ``clients`` mesh axis
   (`jax.sharding.NamedSharding`); global params are replicated
-- ONE jit'd round program does: vmap(local_train) over the sharded cohort →
-  weighted average. XLA lowers the average across shards to a reduce over ICI
-  — the explicit `dist.reduce(SUM)` + 2-rank gather groups of the reference
+- the round runs the SAME engine as the sp backend (`FedAvgAPI._train_round`):
+  vmap(local_train) over the sharded cohort → attack → defend → weighted
+  average → DP. XLA propagates the input shardings through the jit'd cohort
+  program and lowers the cross-shard reduction to collectives over ICI — the
+  explicit `dist.reduce(SUM)` + 2-rank gather groups of the reference
   (``params.py:98-127``) become compiler-inserted collectives
 - cohort padding (to a multiple of the axis size, zero weight) replaces the
   reference's padded schedule tensors (``Server.py:124-128``)
 
 There are no messages, no pickling, no per-worker processes: a round is one
-device program launch.
+device program launch. Because the whole FedAvg-family engine is inherited,
+every federated optimizer (FedProx/FedOpt/FedNova/FedSGD/SCAFFOLD) and the
+full trust pipeline (attack → defend → aggregate → DP, ``sp_api.py``) work
+identically on the multi-chip path.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -29,14 +33,10 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import constants
-from ..core.aggregate import weighted_average
 from ..device import build_mesh
-from ..ml.local_train import make_local_train_fn
 from .sp_api import FedAvgAPI
 
 logger = logging.getLogger(__name__)
-
-PyTree = Any
 
 
 class MeshFedAvgAPI(FedAvgAPI):
@@ -56,63 +56,37 @@ class MeshFedAvgAPI(FedAvgAPI):
         self.axis_size = self.mesh.shape[constants.MESH_AXIS_CLIENTS]
         self._shard = NamedSharding(self.mesh, P(constants.MESH_AXIS_CLIENTS))
         self._repl = NamedSharding(self.mesh, P())
-
-        local_train = make_local_train_fn(model, args, self.ds.cap)
-
-        def round_fn(global_params, cx, cy, cn, rngs, wmask):
-            stacked, metrics = jax.vmap(
-                local_train, in_axes=(None, 0, 0, 0, 0)
-            )(global_params, cx, cy, cn, rngs)
-            weights = metrics["num_samples"] * wmask
-            w_agg = weighted_average(stacked, weights)
-            loss = (metrics["train_loss"] * wmask).sum() / jnp.maximum(
-                wmask.sum(), 1.0
-            )
-            return w_agg, loss
-
-        self._round_fn = jax.jit(
-            round_fn,
-            in_shardings=(
-                self._repl, self._shard, self._shard, self._shard,
-                self._shard, self._shard,
-            ),
-            out_shardings=(self._repl, self._repl),
-        )
+        # the packed dataset stays host-side; cohorts are gathered on host and
+        # placed sharded (the HBM-resident fast path assumes one device)
+        self.hbm_resident = False
         logger.info(
             "mesh simulator: %d-way client sharding over %s",
             self.axis_size, self.mesh,
         )
 
-    def _train_round(self, round_idx: int):
-        cohort = self._client_sampling(round_idx)
+    # -- FedAvgAPI placement hooks ------------------------------------------
+    def _pad_cohort(self, cohort: np.ndarray):
         pad = (-len(cohort)) % self.axis_size
         wmask = np.ones(len(cohort) + pad, np.float32)
         if pad:
             wmask[len(cohort):] = 0.0
             cohort = np.concatenate([cohort, np.zeros(pad, cohort.dtype)])
+        return cohort, wmask
 
+    def _gather_cohort(self, cohort: np.ndarray):
         cx = jax.device_put(self.ds.train_x[cohort], self._shard)
         cy = jax.device_put(self.ds.train_y[cohort], self._shard)
-        cn = jax.device_put(self.ds.train_counts[cohort], self._shard)
-        round_rng = jax.random.fold_in(self.root_rng, round_idx)
-        rngs = jax.device_put(
-            jax.device_get(jax.random.split(round_rng, len(cohort))), self._shard
+        cn = jax.device_put(
+            self.ds.train_counts[cohort].astype(np.int32), self._shard
         )
-        wmask_d = jax.device_put(wmask, self._shard)
+        return cx, cy, cn
 
-        w_agg, loss = self._round_fn(
-            self.global_params, cx, cy, cn, rngs, wmask_d
-        )
-        if self.opt_name == constants.FEDML_FEDERATED_OPTIMIZER_FEDOPT:
-            import optax
+    def _place(self, arr):
+        return jax.device_put(jax.device_get(arr), self._shard)
 
-            from ..core.aggregate import pseudo_gradient
-
-            pg = pseudo_gradient(self.global_params, w_agg)
-            updates, self.server_opt_state = self.server_opt.update(
-                pg, self.server_opt_state, self.global_params
-            )
-            self.global_params = optax.apply_updates(self.global_params, updates)
-        else:
-            self.global_params = w_agg
-        return {"train_loss": float(loss)}
+    def _train_round(self, round_idx: int):
+        # keep global params replicated across the mesh so the cohort program
+        # reads them without broadcast inside the hot loop
+        self.global_params = jax.device_put(self.global_params, self._repl)
+        metrics = super()._train_round(round_idx)
+        return metrics

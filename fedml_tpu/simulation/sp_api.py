@@ -142,9 +142,19 @@ class FedAvgAPI:
         rs = np.random.RandomState(round_idx)
         return rs.choice(total, per_round, replace=False)
 
-    # -- one round ----------------------------------------------------------
-    def _train_round(self, round_idx: int) -> Dict[str, float]:
-        cohort = self._client_sampling(round_idx)
+    # -- cohort placement hooks (overridden by the mesh backend) ------------
+    def _pad_cohort(self, cohort: np.ndarray):
+        """Return (cohort, wmask): pad the cohort for even device sharding.
+
+        wmask is None (no padding) on the single-device path; the mesh backend
+        pads to a multiple of the ``clients`` axis size and returns a 0/1 mask
+        (1 for real clients, 0 for padding) — the reference's padded schedule
+        tensors (``Server.py:124-128``) reborn as a weight mask.
+        """
+        return cohort, None
+
+    def _gather_cohort(self, cohort: np.ndarray):
+        """Gather the cohort's packed shards → (cx, cy, cn) on device."""
         if self.hbm_resident:
             idx = jnp.asarray(cohort)
             cx = jnp.take(self._dev_x, idx, axis=0)
@@ -161,22 +171,35 @@ class FedAvgAPI:
                 else self.ds.train_y[cohort]
             )
             cn = jnp.asarray(self.ds.train_counts[cohort])
+        return cx, cy, cn
+
+    def _place(self, arr):
+        """Place a per-client array (leading cohort dim); mesh shards it."""
+        return arr
+
+    # -- one round ----------------------------------------------------------
+    def _train_round(self, round_idx: int) -> Dict[str, float]:
+        cohort, wmask = self._pad_cohort(self._client_sampling(round_idx))
+        n_valid = len(cohort) if wmask is None else int(wmask.sum())
+        cx, cy, cn = self._gather_cohort(cohort)
         if self.attacker.is_data_attack():
-            cy = self.attacker.attack_data(cy)
+            cx, cy = self.attacker.attack_data(cx, cy)
 
         round_rng = jax.random.fold_in(self.root_rng, round_idx)
-        rngs = jax.random.split(round_rng, len(cohort))
+        rngs = self._place(jax.random.split(round_rng, len(cohort)))
+        wm = None if wmask is None else self._place(jnp.asarray(wmask))
 
         if self.fedsgd:
             grads, metrics = self.cohort_fn(self.global_params, cx, cy, cn, rngs)
-            agg_grad = self._aggregate(grads, metrics["num_samples"], round_rng)
+            weights = metrics["num_samples"] if wm is None else metrics["num_samples"] * wm
+            agg_grad = self._aggregate(grads, weights, round_rng, n_valid)
             updates, self.server_opt_state = self.server_opt.update(
                 agg_grad, self.server_opt_state, self.global_params
             )
             import optax
 
             self.global_params = optax.apply_updates(self.global_params, updates)
-            return {"train_loss": float("nan")}
+            return {"train_loss": _masked_mean(metrics["train_loss"], wm)}
 
         if self.scaffold:
             c_cohort = jax.tree.map(lambda x: x[cohort], self.c_locals)
@@ -184,19 +207,25 @@ class FedAvgAPI:
                 self.global_params, cx, cy, cn, rngs, self.c_global, c_cohort
             )
             # scatter back new control variates; update c_global by the mean
-            # delta scaled by cohort/total (SCAFFOLD option II)
-            delta_c = jax.tree.map(lambda n, o: (n - o).mean(0), new_c, c_cohort)
-            scale = len(cohort) / self.ds.client_num
+            # delta scaled by cohort/total (SCAFFOLD option II). Only the
+            # n_valid real clients participate — padded rows are dropped.
+            real = cohort[:n_valid]
+            new_c_r = jax.tree.map(lambda x: x[:n_valid], new_c)
+            c_cohort_r = jax.tree.map(lambda x: x[:n_valid], c_cohort)
+            delta_c = jax.tree.map(
+                lambda n, o: (n - o).mean(0), new_c_r, c_cohort_r
+            )
+            scale = n_valid / self.ds.client_num
             self.c_global = jax.tree.map(
                 lambda cg, d: cg + scale * d, self.c_global, delta_c
             )
             self.c_locals = jax.tree.map(
-                lambda all_c, nc: all_c.at[cohort].set(nc), self.c_locals, new_c
+                lambda all_c, nc: all_c.at[real].set(nc), self.c_locals, new_c_r
             )
         else:
             stacked, metrics = self.cohort_fn(self.global_params, cx, cy, cn, rngs)
 
-        weights = metrics["num_samples"]
+        weights = metrics["num_samples"] if wm is None else metrics["num_samples"] * wm
 
         if self.fednova:
             # w_new = w_g - tau_eff * Σ p_i (w_g - w_i)/tau_i
@@ -209,7 +238,7 @@ class FedAvgAPI:
                 lambda g, dd: g - tau_eff * dd, self.global_params, d
             )
         else:
-            w_agg = self._aggregate(stacked, weights, round_rng)
+            w_agg = self._aggregate(stacked, weights, round_rng, n_valid)
             if self.opt_name == constants.FEDML_FEDERATED_OPTIMIZER_FEDOPT:
                 import optax
 
@@ -225,12 +254,20 @@ class FedAvgAPI:
             self.global_params = self.dp.randomize_global(
                 self.global_params, jax.random.fold_in(round_rng, 7)
             )
-        return {"train_loss": float(jnp.mean(metrics.get("train_loss", jnp.nan)))}
+        return {"train_loss": _masked_mean(metrics.get("train_loss"), wm)}
 
     # -- aggregation with trust hooks ---------------------------------------
-    def _aggregate(self, stacked: PyTree, weights: jax.Array, rng) -> PyTree:
+    def _aggregate(
+        self, stacked: PyTree, weights: jax.Array, rng, n_valid: int = None
+    ) -> PyTree:
         """attack → defend → weighted-average → (local/central DP applied by
-        caller), all on the stacked [cohort, ...] arrays."""
+        caller), all on the stacked [cohort, ...] arrays.
+
+        ``n_valid``: number of real (non-padding) leading rows. Zero-weight
+        padding is harmless to the weighted average, but rank-based defenses
+        (Krum, median, ...) and the attack kernels see every row — so the
+        trust paths slice to the real cohort first.
+        """
         if self.dp is not None and self.dp.dp_type == "ldp":
             keys = jax.random.split(jax.random.fold_in(rng, 3), weights.shape[0])
             stacked = jax.vmap(self.dp.randomize)(stacked, keys)
@@ -239,19 +276,25 @@ class FedAvgAPI:
             # added to the aggregate by the caller (randomize_global)
             stacked = self.dp.clip_client_updates(stacked, self.global_params)
 
+        n = int(weights.shape[0]) if n_valid is None else int(n_valid)
+
         needs_flat = self.attacker.is_model_attack() or self.defender.is_defense_enabled()
         if not needs_flat:
             if self.custom_aggregator is not None:
                 raw = [
                     (float(weights[i]), jax.tree.map(lambda x: x[i], stacked))
-                    for i in range(weights.shape[0])
+                    for i in range(n)
                 ]
                 raw = self.custom_aggregator.on_before_aggregation(raw)
                 agg = self.custom_aggregator.aggregate(raw)
                 return self.custom_aggregator.on_after_aggregation(agg)
             return weighted_average(stacked, weights)
 
-        # flatten to [n, dim] once for the attack/defense kernels
+        # flatten to [n, dim] once for the attack/defense kernels; drop
+        # zero-weight padding rows so rank-based defenses see real clients
+        if n < weights.shape[0]:
+            stacked = jax.tree.map(lambda x: x[:n], stacked)
+            weights = weights[:n]
         _, treedef, shapes = tree_flatten_to_vector(self.global_params)
         flat = jax.vmap(lambda t: tree_flatten_to_vector(t)[0])(stacked)
         gvec, _, _ = tree_flatten_to_vector(self.global_params)
@@ -295,6 +338,15 @@ class FedAvgAPI:
                 )
             self.history.append(entry)
         return last_eval
+
+
+def _masked_mean(values, wmask) -> float:
+    """Mean of per-client scalars, ignoring zero-mask (padding) entries."""
+    if values is None:
+        return float("nan")
+    if wmask is None:
+        return float(jnp.mean(values))
+    return float((values * wmask).sum() / jnp.maximum(wmask.sum(), 1.0))
 
 
 def _fednova_normalized_direction(global_params, stacked, tau):

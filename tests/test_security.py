@@ -163,3 +163,162 @@ def test_attacker_zero_frac_is_noop():
     updates = jnp.ones((4, 6))
     out = atk.attack_model(updates, jnp.ones(4), jax.random.PRNGKey(0))
     np.testing.assert_allclose(out, updates)
+
+
+# -- round-2 trust-suite additions ------------------------------------------
+
+def test_alie_attack_within_std_range():
+    """ALIE malicious rows sit at mean + z*std of honest rows — inside the
+    plausible range (so norm defenses pass them) but biased."""
+    key = jax.random.PRNGKey(0)
+    updates = jax.random.normal(key, (8, 16))
+    mask = jnp.array([1, 1, 0, 0, 0, 0, 0, 0], jnp.float32)
+    out = attacks.alie_attack(updates, mask, num_std=1.5)
+    honest = updates[2:]
+    mean, std = honest.mean(0), honest.std(0)
+    # malicious rows equal the prescribed point...
+    np.testing.assert_allclose(out[0], mean + 1.5 * std, rtol=1e-5)
+    np.testing.assert_allclose(out[0], out[1], rtol=1e-6)
+    # ...honest rows untouched
+    np.testing.assert_allclose(out[2:], honest)
+    # and the malicious norm is comparable to honest norms (stealth)
+    assert float(jnp.linalg.norm(out[0])) < 3 * float(
+        jnp.linalg.norm(honest, axis=1).max()
+    )
+
+
+def test_pattern_backdoor_poison_images():
+    x = jnp.zeros((2, 4, 8, 8, 3))  # [clients, cap, H, W, C]
+    y = jnp.ones((2, 4), jnp.int32) * 5
+    mask = jnp.zeros((2, 4)).at[0, :2].set(1.0)
+    px, py = attacks.pattern_backdoor_poison(x, y, mask, target_class=0,
+                                             pattern_value=2.8, pattern_size=3)
+    # poisoned samples get the patch + target label
+    assert float(px[0, 0, 0, 0, 0]) == pytest.approx(2.8)
+    assert int(py[0, 0]) == 0
+    # clean samples untouched
+    assert float(jnp.abs(px[1]).max()) == 0.0
+    assert int(py[1, 0]) == 5
+    # patch is spatially confined
+    assert float(jnp.abs(px[0, 0, 3:, 3:, :]).max()) == 0.0
+
+
+def test_reveal_labels_from_gradients_idlg():
+    """iDLG: with CE loss the true class's last-layer gradient row-sum is the
+    unique negative one."""
+    d_in, n_cls = 6, 4
+    W = jax.random.normal(jax.random.PRNGKey(1), (d_in, n_cls)) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(2), (d_in,))
+    true_label = 2
+
+    def loss(W_):
+        logits = x @ W_
+        return -jax.nn.log_softmax(logits)[true_label]
+
+    g = jax.grad(loss)(W)
+    scores = attacks.reveal_labels_from_gradients(g)
+    assert int(jnp.argmin(scores)) == true_label
+    assert float(scores[true_label]) < 0
+
+
+def test_invert_gradient_reconstructs_input():
+    """Cosine-matching inversion recovers a linear model's input (known
+    labels), like the reference's InvertGradient on its convex toy case."""
+    d = 8
+    W = jax.random.normal(jax.random.PRNGKey(3), (d, 3)) * 0.5
+    true_x = jax.random.normal(jax.random.PRNGKey(4), (d,))
+    label = jnp.asarray(1)
+
+    def grad_fn(x, y):
+        def loss(W_):
+            return -jax.nn.log_softmax(x @ W_)[y]
+
+        return (jax.grad(loss)(W),)
+
+    true_grads = grad_fn(true_x, label)
+    dx = attacks.invert_gradient_attack(
+        grad_fn, true_grads, jnp.zeros((d,)), label,
+        lr=0.05, iters=800, tv_weight=0.0,
+    )
+    # cosine objective drives direction; scale is not identifiable — compare
+    # normalized vectors
+    cos = float(
+        jnp.dot(dx, true_x) / (jnp.linalg.norm(dx) * jnp.linalg.norm(true_x))
+    )
+    assert cos > 0.95
+
+
+def test_soteria_mask_prunes_leaky_features():
+    """Features with tiny ||dr/dx||/|r| get pruned; informative ones stay."""
+
+    def feature_fn(x):
+        # feature 0 has tiny jacobian but large magnitude -> low ratio
+        return jnp.stack([1000.0 + 1e-6 * x[0], x[1] * 3.0, x[0] + x[2]])
+
+    mask = defenses.soteria_mask(feature_fn, jnp.ones(3), prune_percentile=40.0)
+    assert float(mask[0]) == 0.0
+    assert float(mask[1]) == 1.0 and float(mask[2]) == 1.0
+
+    g = jnp.ones((3, 5))
+    pruned = defenses.apply_soteria(g, mask)
+    assert float(jnp.abs(pruned[0]).max()) == 0.0
+    np.testing.assert_allclose(pruned[1:], g[1:])
+
+
+def test_wbc_perturbs_stagnant_subspace_only():
+    """Noise lands only where the gradient barely changed between rounds."""
+    dim = 1000
+    params = jnp.zeros(dim)
+    grad = jnp.zeros(dim).at[: dim // 2].set(100.0)  # active half
+    old = jnp.zeros(dim)
+    out = defenses.wbc_perturb(params, grad, old, jax.random.PRNGKey(0),
+                               pert_strength=1.0, learning_rate=0.1)
+    active, stagnant = out[: dim // 2], out[dim // 2:]
+    # active coordinates: |grad diff|=100 >> |noise| -> untouched
+    np.testing.assert_allclose(active, 0.0)
+    # stagnant coordinates: mostly perturbed
+    assert float(jnp.mean((jnp.abs(stagnant) > 0).astype(jnp.float32))) > 0.9
+
+
+def test_wbc_defender_dispatch():
+    class A:
+        enable_defense = True
+        defense_type = "wbc"
+        pert_strength = 0.01
+        wbc_lr = 0.1
+
+    d = FedMLDefender.get_instance()
+    d.init(A())
+    updates = jnp.ones((4, 16))
+    agg1 = d.defend(updates, jnp.ones(4), jnp.zeros(16), jax.random.PRNGKey(0))
+    assert agg1.shape == (16,)
+    # second round uses stored old gradients without error
+    agg2 = d.defend(updates * 1.1, jnp.ones(4), jnp.ones(16) * 0.5,
+                    jax.random.PRNGKey(1))
+    assert agg2.shape == (16,)
+    # perturbation is small relative to the aggregate
+    np.testing.assert_allclose(agg1, 1.0, atol=0.05)
+
+
+def test_backdoor_pattern_manager_poisons_data():
+    class A:
+        enable_attack = True
+        attack_type = "backdoor_pattern"
+        byzantine_client_frac = 0.5
+        poison_frac = 1.0
+        target_class = 0
+        pattern_value = 2.8
+        pattern_size = 2
+        random_seed = 0
+
+    atk = FedMLAttacker.get_instance()
+    atk.init(A())
+    assert atk.is_data_attack() and not atk.is_model_attack()
+    x = jnp.zeros((4, 6, 8, 8, 3))
+    y = jnp.ones((4, 6), jnp.int32)
+    px, py = atk.attack_data(x, y)
+    poisoned_clients = int(
+        (jnp.abs(px).reshape(4, -1).max(1) > 0).sum()
+    )
+    assert poisoned_clients == 2
+    assert int((py == 0).sum()) == 12  # half the clients fully relabelled

@@ -1,0 +1,132 @@
+"""Measure the reference's sp FedAvg throughput on THIS machine.
+
+Drives the reference's own single-process FedAvg loop
+(`/root/reference/python/fedml/simulation/sp/fedavg/fedavg_api.py:65-123`)
+with its own torch ResNet-56 (`model/cv/resnet.py:257`) and its own
+`ModelTrainerCLS` on synthetic CIFAR-10-shaped data, matching the config of
+`bench.py` (100 clients, 10/round, 1 local epoch, batch 32, 500 samples per
+client). torch has no TPU backend, so this runs on CPU — the reference's only
+available substrate here. The measured rounds/sec becomes bench.py's
+REF_ROUNDS_PER_SEC.
+
+Missing optional deps of the reference (wandb, paho, boto3, ...) are stubbed
+with MagicMock modules — none of them are on the measured hot path (the hot
+loop is pure torch: client batches + state-dict aggregation).
+
+Usage:  python tools/measure_ref_baseline.py [--rounds N]
+Prints one JSON line: {"ref_rounds_per_sec": ..., "rounds": N, "secs": ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import time
+import types
+from unittest import mock
+
+REF = "/root/reference/python"
+
+
+def _import_with_stubs(name: str, max_stubs: int = 60):
+    """Import `name`, stubbing any missing third-party modules."""
+    stubbed = []
+    for _ in range(max_stubs):
+        try:
+            return __import__(name, fromlist=["_"]), stubbed
+        except ModuleNotFoundError as e:
+            missing = e.name
+            if missing is None or missing in sys.modules:
+                raise
+            stub = mock.MagicMock(name=f"stub:{missing}")
+            stub.__spec__ = types.SimpleNamespace(name=missing)
+            stub.__path__ = []
+            sys.modules[missing] = stub
+            stubbed.append(missing)
+    raise RuntimeError(f"too many missing modules stubbed: {stubbed}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--clients-total", type=int, default=100)
+    ap.add_argument("--clients-per-round", type=int, default=10)
+    ap.add_argument("--samples-per-client", type=int, default=500)
+    ap.add_argument("--batch-size", type=int, default=32)
+    args_ns = ap.parse_args()
+
+    sys.path.insert(0, REF)
+    logging.disable(logging.INFO)  # the reference logs every batch
+
+    import numpy as np
+    import torch
+
+    torch.manual_seed(0)
+
+    _import_with_stubs("fedml")
+    from fedml.model.cv.resnet import resnet56
+    from fedml.simulation.sp.fedavg.fedavg_api import FedAvgAPI
+
+    n_total = args_ns.clients_total
+    per_client = args_ns.samples_per_client
+
+    # synthetic CIFAR-shaped shards, one TensorDataset loader per client
+    def make_loader(n, seed):
+        g = torch.Generator().manual_seed(seed)
+        x = torch.randn(n, 3, 32, 32, generator=g)
+        y = torch.randint(0, 10, (n,), generator=g)
+        return torch.utils.data.DataLoader(
+            torch.utils.data.TensorDataset(x, y),
+            batch_size=args_ns.batch_size, shuffle=False,
+        )
+
+    train_local = {i: make_loader(per_client, i) for i in range(n_total)}
+    test_local = {i: make_loader(64, 10_000 + i) for i in range(n_total)}
+    train_num = {i: per_client for i in range(n_total)}
+    dataset = [
+        n_total * per_client, n_total * 64, None, None,
+        train_num, train_local, test_local, 10,
+    ]
+
+    ref_args = argparse.Namespace(
+        dataset="cifar10", model="resnet56",
+        client_num_in_total=n_total,
+        client_num_per_round=args_ns.clients_per_round,
+        comm_round=args_ns.rounds, epochs=1,
+        batch_size=args_ns.batch_size, learning_rate=0.1,
+        client_optimizer="sgd", weight_decay=0.0,
+        frequency_of_the_test=100_000, enable_wandb=False,
+    )
+
+    model = resnet56(class_num=10)
+    api = FedAvgAPI(ref_args, torch.device("cpu"), dataset, model)
+
+    # eval is not part of the per-round cost in either framework's bench
+    api._local_test_on_all_clients = lambda *_a, **_k: None
+
+    # warmup: 1 round (thread pools, allocator)
+    ref_args.comm_round = 1
+    api.args = ref_args
+    t = time.perf_counter()
+    api.train()
+    warm = time.perf_counter() - t
+
+    ref_args.comm_round = args_ns.rounds
+    t0 = time.perf_counter()
+    api.train()
+    dt = time.perf_counter() - t0
+
+    out = {
+        "ref_rounds_per_sec": round(args_ns.rounds / dt, 5),
+        "rounds": args_ns.rounds,
+        "secs": round(dt, 2),
+        "warmup_round_secs": round(warm, 2),
+        "config": "100c/10pr/500spc/bs32/1ep resnet56 cifar10-shaped, torch CPU",
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
