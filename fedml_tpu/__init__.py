@@ -68,8 +68,12 @@ def get_args() -> Optional[Arguments]:
 # One-line launchers (reference: launch_simulation.py:10-30,
 # launch_cross_silo_horizontal.py:7-52, launch_cross_device.py:6-28)
 # ---------------------------------------------------------------------------
-def run_simulation(backend: str = constants.FEDML_SIMULATION_TYPE_SP) -> None:
-    """One-line FL simulation: init → device → data → model → run."""
+def run_simulation(backend: str = constants.FEDML_SIMULATION_TYPE_SP):
+    """One-line FL simulation: init → device → data → model → run.
+
+    Returns the final eval metrics (an upgrade over the reference's
+    ``launch_simulation.py``, which discards them).
+    """
     from . import data as data_mod
     from . import models as model_mod
     from .runner import FedMLRunner
@@ -82,19 +86,19 @@ def run_simulation(backend: str = constants.FEDML_SIMULATION_TYPE_SP) -> None:
     dataset, output_dim = data_mod.load(args)
     model = model_mod.create(args, output_dim)
     runner = FedMLRunner(args, device, dataset, model)
-    runner.run()
+    return runner.run()
 
 
-def run_cross_silo_server(**kwargs) -> None:
+def run_cross_silo_server(**kwargs):
     from .cross_silo import run_server
 
-    run_server(**kwargs)
+    return run_server(**kwargs)
 
 
-def run_cross_silo_client(**kwargs) -> None:
+def run_cross_silo_client(**kwargs):
     from .cross_silo import run_client
 
-    run_client(**kwargs)
+    return run_client(**kwargs)
 
 
 def get_device(args: Optional[Arguments] = None):

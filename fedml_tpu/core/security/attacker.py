@@ -76,12 +76,17 @@ class FedMLAttacker:
         boosted = attacks.model_replacement_scale(updates, global_vec, boost)
         return updates * (1 - mask[:, None]) + boosted * mask[:, None]
 
-    def attack_data(self, x: jax.Array, labels: jax.Array):
+    def attack_data(self, x: jax.Array, labels: jax.Array, n_valid: int = None):
         """Poison the cohort's training data → (x, labels).
 
         label_flipping leaves x alone; backdoor_pattern stamps the trigger
         patch on a fraction of the malicious clients' samples AND relabels
         them to the target class.
+
+        ``n_valid``: real (non-padding) leading rows — the mesh engine pads
+        the cohort to a device multiple, and malicious clients must be drawn
+        from the real rows only or the attack dilutes onto zero-weight
+        padding.
         """
         if not self.is_data_attack():
             return x, labels
@@ -93,12 +98,13 @@ class FedMLAttacker:
             )
         # backdoor_pattern: malicious clients poison poison_frac of samples
         n = labels.shape[0]
+        n_real = n if n_valid is None else min(int(n_valid), n)
         frac = float(getattr(self.args, "byzantine_client_frac", 0.2))
-        num_bad = int(round(n * frac))
+        num_bad = int(round(n_real * frac))
         rng = np.random.RandomState(int(getattr(self.args, "random_seed", 0)))
         client_mask = np.zeros((n,), np.float32)
         if num_bad:
-            client_mask[rng.choice(n, num_bad, replace=False)] = 1.0
+            client_mask[rng.choice(n_real, num_bad, replace=False)] = 1.0
         poison_frac = float(getattr(self.args, "poison_frac", 0.5))
         sample_mask = (
             rng.random_sample(labels.shape) < poison_frac

@@ -96,11 +96,17 @@ def pattern_backdoor_poison(
 
     ``x``: [..., H, W, C] images (NHWC — TPU-native layout) or [..., d] flat
     features; ``poison_mask``: broadcastable 0/1 over the sample axes. The
+    image-vs-flat decision uses the FEATURE rank (x.ndim minus the mask's
+    sample axes) — cohort-packed flat features arrive as [clients, cap, d],
+    whose absolute ndim would otherwise masquerade as an image batch. The
     trigger is written with a static slice so the op stays jit-compatible.
     """
     p = pattern_size
-    if x.ndim >= 3:  # images [..., H, W, C]
+    feature_rank = x.ndim - poison_mask.ndim
+    if feature_rank >= 3:  # images [..., H, W, C]
         patch = jnp.zeros_like(x).at[..., :p, :p, :].set(1.0)
+    elif feature_rank == 2:  # channel-less images [..., H, W]
+        patch = jnp.zeros_like(x).at[..., :p, :p].set(1.0)
     else:  # flat features [..., d]
         patch = jnp.zeros_like(x).at[..., :p].set(1.0)
     pm = poison_mask.reshape(poison_mask.shape + (1,) * (x.ndim - poison_mask.ndim))
@@ -119,14 +125,13 @@ def reveal_labels_from_gradients(last_layer_weight_grad: jax.Array) -> jax.Array
     exactly, and for batches classes with the most-negative scores are the
     labels present.
 
-    ``last_layer_weight_grad``: [d_in, num_classes] or [num_classes, d_in]
-    — reduced over the feature axis, keeping the class axis last.
+    ``last_layer_weight_grad``: [d_in, num_classes] — the flax Dense kernel
+    layout (class axis LAST). A torch ``nn.Linear.weight`` grad
+    ([num_classes, d_in]) must be transposed by the caller.
     """
     g = last_layer_weight_grad
     if g.ndim != 2:
         raise ValueError(f"expected 2-D last-layer grad, got {g.shape}")
-    # class axis = the one whose per-index sums are mostly tiny/negative —
-    # conventionally flax Dense kernels are [d_in, num_classes]
     return jnp.sum(g, axis=0)
 
 

@@ -37,7 +37,9 @@ class FedMLDefender:
         self.is_enabled = False
         self.defense_type = ""
         self.args = None
-        self._wbc_old = None  # previous round's pseudo-gradients (FL-WBC)
+        # FL-WBC: previous pseudo-gradient PER CLIENT ID (cohorts resample
+        # every round, so row position is not a client identity)
+        self._wbc_old = {}
 
     @classmethod
     def get_instance(cls) -> "FedMLDefender":
@@ -49,7 +51,7 @@ class FedMLDefender:
         self.is_enabled = bool(getattr(args, "enable_defense", False))
         self.defense_type = (getattr(args, "defense_type", "") or "").strip().lower()
         self.args = args
-        self._wbc_old = None
+        self._wbc_old = {}
         if self.is_enabled and self.defense_type not in DEFENSE_TYPES:
             raise ValueError(
                 f"unknown defense_type {self.defense_type!r}; known: {DEFENSE_TYPES}"
@@ -64,8 +66,15 @@ class FedMLDefender:
         weights: jax.Array,
         global_vec: jax.Array,
         key: jax.Array,
+        client_ids=None,
     ) -> jax.Array:
-        """Robust-aggregate the stacked updates → one aggregated vector."""
+        """Robust-aggregate the stacked updates → one aggregated vector.
+
+        ``client_ids``: the cohort's client identities, row-aligned with
+        ``updates`` — required by stateful defenses (FL-WBC) that compare a
+        client against ITS OWN previous round, not whoever sat in the same
+        row last time.
+        """
         a = self.args
         f = int(getattr(a, "byzantine_client_num", 1))
         t = self.defense_type
@@ -110,12 +119,23 @@ class FedMLDefender:
             )
         if t == "wbc":
             # FL-WBC applied round-wise: per-client pseudo-gradient vs the
-            # previous round's (manager state) identifies the stagnant
+            # SAME client's previous pseudo-gradient identifies the stagnant
             # subspace where poisoning persists; Laplace noise perturbs it.
+            # First sighting of a client contributes a zero old-grad (the
+            # gate then treats every coordinate as fresh).
             grads = updates - global_vec[None, :]
-            old = self._wbc_old if self._wbc_old is not None else jnp.zeros_like(grads)
-            if old.shape != grads.shape:
-                old = jnp.zeros_like(grads)
+            n = int(updates.shape[0])
+            ids = (
+                [int(i) for i in client_ids]
+                if client_ids is not None
+                else list(range(n))
+            )
+            import numpy as np
+
+            zero = np.zeros(grads.shape[1:], np.float32)
+            old = jnp.asarray(
+                np.stack([self._wbc_old.get(cid, zero) for cid in ids])
+            )
             keys = jax.random.split(key, updates.shape[0])
             perturbed = jax.vmap(
                 lambda u, g, o, k: defenses.wbc_perturb(
@@ -124,7 +144,16 @@ class FedMLDefender:
                     float(getattr(a, "wbc_lr", 0.1)),
                 )
             )(updates, grads, old, keys)
-            self._wbc_old = grads
+            # host-side store (one model vector per client is HBM-expensive),
+            # FIFO-bounded: beyond the cap, the oldest client's history is
+            # dropped and its next sighting starts fresh
+            grads_np = np.asarray(grads, np.float32)
+            cap = int(getattr(a, "wbc_history_cap", 4096))
+            for row, cid in enumerate(ids):
+                self._wbc_old.pop(cid, None)
+                self._wbc_old[cid] = grads_np[row]
+            while len(self._wbc_old) > cap:
+                self._wbc_old.pop(next(iter(self._wbc_old)))
             w = weights / jnp.sum(weights)
             return (w[:, None] * perturbed).sum(0)
         raise ValueError(f"unknown defense_type {t!r}")

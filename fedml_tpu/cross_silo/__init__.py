@@ -42,29 +42,83 @@ class FedMLCrossSiloServer:
 
 
 class FedMLCrossSiloClient:
+    """One silo. Hierarchical knobs (reference ``client_launcher.py`` +
+    ``process_group_manager.py``):
+
+    - ``args.silo_device_indices``: chips this silo trains over — intra-silo
+      data parallelism as ONE jit over a local mesh (per-step gradient psum,
+      the torch-DDP analog on ICI).
+    - ``args.silo_proc_num`` > 1: DCN-separated silo members; slaves run the
+      ``ClientSlaveManager`` FSM and the master round-averages the silo
+      before one update goes to the FL server.
+    """
+
     def __init__(self, args, device, dataset, model, client_trainer=None):
         self.args = args
         trainer = client_trainer or create_model_trainer(model, args)
         rank = int(getattr(args, "rank", 1))
         trainer.set_id(rank)
         size = int(getattr(args, "client_num_in_total", 1)) + 1
+        backend = str(getattr(args, "backend", constants.COMM_BACKEND_LOOPBACK))
         opt = str(getattr(args, "federated_optimizer", "FedAvg"))
+
+        silo_devices = getattr(args, "silo_device_indices", None)
+        if silo_devices:
+            from .process_group import SiloProcessGroup
+            from .trainer_dist_adapter import TrainerDistAdapter
+
+            group = SiloProcessGroup([int(i) for i in silo_devices])
+            trainer = TrainerDistAdapter(args, trainer, group)
+
         if opt == constants.FEDML_FEDERATED_OPTIMIZER_LSA:
             from .lightsecagg.lsa_client_manager import LightSecAggClientManager
 
             self.manager = LightSecAggClientManager(
                 args, trainer, rank=rank, size=size,
-                backend=str(getattr(args, "backend", constants.COMM_BACKEND_LOOPBACK)),
-                dataset=dataset,
+                backend=backend, dataset=dataset,
             )
-        else:
-            from .client_manager import ClientMasterManager
+            return
 
-            self.manager = ClientMasterManager(
-                args, trainer, rank=rank, size=size,
-                backend=str(getattr(args, "backend", constants.COMM_BACKEND_LOOPBACK)),
-                dataset=dataset,
+        from .client_manager import ClientMasterManager
+
+        silo_plane = None
+        silo_shard = None
+        self._slaves = []
+        silo_procs = int(getattr(args, "silo_proc_num", 1) or 1)
+        if silo_procs > 1:
+            # the in-process analog of the reference's client_launcher:
+            # spawn silo members and a master plane on a silo-private world
+            from ..core.distributed.loopback import LoopbackCommManager
+            from .client_slave_manager import (
+                ClientSlaveManager, SiloMasterPlane, split_silo_shard,
             )
+
+            world = f"{getattr(args, 'run_id', 'default')}:silo:{rank}"
+            shards = split_silo_shard(
+                *dataset.client_shard(rank - 1), m=silo_procs,
+                batch_size=int(getattr(args, "batch_size", 1)),
+            )
+            silo_shard = shards[0]
+            for s in range(1, silo_procs):
+                slave_trainer = create_model_trainer(model, args)
+                slave_trainer.set_id(rank * 1000 + s)
+                slave = ClientSlaveManager(
+                    args, slave_trainer,
+                    comm=LoopbackCommManager(s, silo_procs, world),
+                    rank=s, size=silo_procs, dataset=shards[s],
+                )
+                slave.run_async()
+                self._slaves.append(slave)
+            silo_plane = SiloMasterPlane(
+                args, comm=LoopbackCommManager(0, silo_procs, world),
+                size=silo_procs,
+            )
+
+        self.manager = ClientMasterManager(
+            args, trainer, rank=rank, size=size,
+            backend=backend, dataset=dataset,
+            silo_plane=silo_plane, silo_shard=silo_shard,
+        )
 
     def run(self):
         self.manager.run()

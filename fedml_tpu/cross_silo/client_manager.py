@@ -29,10 +29,16 @@ logger = logging.getLogger(__name__)
 
 class ClientMasterManager(FedMLCommManager):
     def __init__(self, args, trainer, comm=None, rank=0, size=0,
-                 backend=constants.COMM_BACKEND_LOOPBACK, dataset=None):
+                 backend=constants.COMM_BACKEND_LOOPBACK, dataset=None,
+                 silo_plane=None, silo_shard=None):
         super().__init__(args, comm, rank, size, backend)
-        self.trainer = trainer  # ClientTrainer
+        self.trainer = trainer  # ClientTrainer or TrainerDistAdapter
         self.ds = dataset
+        # hierarchical silo: master's handle on DCN slaves + its own slice
+        # of the silo shard (reference: fedml_client_master_manager.py with
+        # process_group_manager; here client_slave_manager.SiloMasterPlane)
+        self.silo_plane = silo_plane
+        self.silo_shard = silo_shard
         self.client_index = rank - 1
         self.round_idx = 0
         self.done = threading.Event()
@@ -90,15 +96,20 @@ class ClientMasterManager(FedMLCommManager):
     def _on_finish(self, msg: Message) -> None:
         self._install_params(msg)
         logger.info("client %d: finished", self.rank)
+        if self.silo_plane is not None:
+            self.silo_plane.broadcast_finish()
         self.done.set()
         self.finish()
 
     def _train_and_send(self) -> None:
         """reference: __train + send_model_to_server (:109-127,160)."""
         self.args.round_idx = self.round_idx
-        x, y, n = self.ds.client_shard(self.client_index)
-        metrics = self.trainer.train((x, y, n), None, self.args)
-        params = self.trainer.get_model_params()
+        if self.silo_plane is not None:
+            params, n, metrics = self._train_hierarchical()
+        else:
+            x, y, n = self.ds.client_shard(self.client_index)
+            metrics = self.trainer.train((x, y, n), None, self.args)
+            params = self.trainer.get_model_params()
         if self.dp is not None:
             key = jax.random.fold_in(
                 jax.random.PRNGKey(int(getattr(self.args, "random_seed", 0)) + self.rank),
@@ -112,3 +123,38 @@ class ClientMasterManager(FedMLCommManager):
                 float(metrics.get("train_loss", 0.0)))
         msg.set_arrays([np.asarray(l) for l in jax.tree.leaves(params)])
         self.send_message(msg)
+
+    def _train_hierarchical(self):
+        """Silo-parallel round: broadcast to DCN slaves, train the master's
+        own slice (possibly itself chip-parallel via TrainerDistAdapter),
+        weighted-average the silo before one update goes to the server.
+
+        reference: the DDP round of fedml_trainer_dist_adapter.py:24-36 —
+        re-founded as per-step psum over ICI (adapter) + round-level
+        averaging over DCN (this method).
+        """
+        global_params = self.trainer.get_model_params()
+        self.silo_plane.broadcast_sync(global_params, self.round_idx)
+        x, y, n = self.silo_shard
+        metrics = self.trainer.train((x, y, n), None, self.args)
+        own = self.trainer.get_model_params()
+        results = self.silo_plane.collect(
+            timeout=float(getattr(self.args, "silo_timeout", 120.0))
+        )
+        leaves_list = [jax.tree.leaves(own)] + [r[1] for r in results]
+        weights = np.asarray([float(n)] + [r[0] for r in results], np.float64)
+        w = weights / max(weights.sum(), 1e-12)
+        treedef = jax.tree.structure(own)
+        avg_leaves = [
+            sum(wi * jnp.asarray(ls[j]) for wi, ls in zip(w, leaves_list))
+            for j in range(len(leaves_list[0]))
+        ]
+        params = jax.tree.unflatten(treedef, avg_leaves)
+        self.trainer.set_model_params(params)
+        n_total = float(weights.sum())
+        losses = [metrics.get("train_loss", 0.0)] + [r[2] for r in results]
+        agg_metrics = dict(metrics)
+        agg_metrics["train_loss"] = float(
+            sum(wi * li for wi, li in zip(w, losses))
+        )
+        return params, n_total, agg_metrics

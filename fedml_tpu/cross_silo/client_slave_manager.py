@@ -1,0 +1,179 @@
+"""Silo slave: a DCN-separated silo member driven by the silo master.
+
+reference: ``cross_silo/client/fedml_client_slave_manager.py`` — non-master
+ranks of a silo block on ``train_ready`` broadcasts from rank 0 and train in
+DDP lock-step. TPU-native re-design: ICI-connected chips already train in
+lock-step inside one jit (``trainer_dist_adapter``), so the slave FSM only
+remains for silo members on *other hosts* (DCN), where per-step psum is not
+economical. Protocol, over the silo's own comm world (disjoint from the
+FL server world):
+
+    master --SILO_SYNC(params, round)--> slave     (train this round)
+    slave  --SILO_RESULT(params, n)--> master      (locally-trained update)
+    master --SILO_FINISH--> slave                  (tear down)
+
+The master weighted-averages its own result with the slaves' before sending
+one silo update to the FL server — round-level averaging over DCN, per-step
+psum over ICI.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import constants
+from ..core.distributed import FedMLCommManager, Message
+from .message_define import MyMessage
+
+logger = logging.getLogger(__name__)
+
+
+class ClientSlaveManager(FedMLCommManager):
+    """One DCN silo member. ``rank`` is silo-local (master = 0)."""
+
+    def __init__(self, args, trainer, comm=None, rank=1, size=0,
+                 backend=constants.COMM_BACKEND_LOOPBACK, dataset=None):
+        super().__init__(args, comm, rank, size, backend)
+        self.trainer = trainer
+        self.ds = dataset
+        self.round_idx = 0
+        self.done = threading.Event()
+        self._treedef: Optional[object] = None
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_SILO_SYNC, self._on_sync
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_SILO_FINISH, self._on_finish
+        )
+
+    def _install_params(self, msg: Message) -> None:
+        if self._treedef is None:
+            skeleton = self.trainer.model.init(
+                jax.random.PRNGKey(int(getattr(self.args, "random_seed", 0)))
+            )
+            self._treedef = jax.tree.structure(skeleton)
+        leaves = [jnp.asarray(a) for a in msg.get_arrays()]
+        self.trainer.set_model_params(jax.tree.unflatten(self._treedef, leaves))
+
+    def _on_sync(self, msg: Message) -> None:
+        self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0))
+        self._install_params(msg)
+        self.args.round_idx = self.round_idx
+        # this slave's sub-shard: the silo's client shard is range-split by
+        # silo rank in the data layer; here the slave owns the shard slice
+        # the master assigned at construction (dataset already sliced)
+        x, y, n = self.ds
+        metrics = self.trainer.train((x, y, n), None, self.args)
+        params = self.trainer.get_model_params()
+        reply = Message(MyMessage.MSG_TYPE_SILO_RESULT, self.rank, 0)
+        reply.add(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(n))
+        reply.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
+        reply.add(MyMessage.MSG_ARG_KEY_TRAIN_LOSS,
+                  float(metrics.get("train_loss", 0.0)))
+        reply.set_arrays([np.asarray(l) for l in jax.tree.leaves(params)])
+        self.send_message(reply)
+
+    def _on_finish(self, msg: Message) -> None:
+        logger.info("silo slave %d: finished", self.rank)
+        self.done.set()
+        self.finish()
+
+
+class SiloMasterPlane(FedMLCommManager):
+    """The master's handle on the silo world (rank 0 of the silo comm).
+
+    reference: the master side of the process-group rendezvous
+    (``fedml_client_master_manager.py`` + torch ``broadcast``); here a tiny
+    message FSM: broadcast SILO_SYNC, block-collect SILO_RESULTs.
+    """
+
+    def __init__(self, args, comm=None, size=0,
+                 backend=constants.COMM_BACKEND_LOOPBACK):
+        import queue
+
+        super().__init__(args, comm, 0, size, backend)
+        self._results: "queue.Queue[tuple]" = queue.Queue()
+        self.register_message_receive_handlers()
+        self.run_async()
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_SILO_RESULT, self._on_result
+        )
+
+    def _on_result(self, msg: Message) -> None:
+        leaves = [jnp.asarray(a) for a in msg.get_arrays()]
+        self._results.put((
+            float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0)),
+            leaves,
+            float(msg.get(MyMessage.MSG_ARG_KEY_TRAIN_LOSS, 0.0)),
+        ))
+
+    def broadcast_sync(self, params, round_idx: int) -> None:
+        leaves = [np.asarray(l) for l in jax.tree.leaves(params)]
+        for slave_rank in range(1, self.size):
+            msg = Message(MyMessage.MSG_TYPE_SILO_SYNC, 0, slave_rank)
+            msg.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, round_idx)
+            msg.set_arrays(leaves)
+            self.send_message(msg)
+
+    def collect(self, timeout: float = 120.0):
+        """Block for the slaves' results: [(n, leaves, loss), ...].
+
+        A slave that misses the deadline is dropped for the round (the silo
+        proceeds with whoever answered) — a dead slave must not take the
+        master's receive thread, and with it the whole federation, down.
+        """
+        import queue
+
+        out = []
+        for _ in range(self.size - 1):
+            try:
+                out.append(self._results.get(timeout=timeout))
+            except queue.Empty:
+                logger.warning(
+                    "silo master: %d/%d slave result(s) missing after %.0fs; "
+                    "continuing with partial silo",
+                    self.size - 1 - len(out), self.size - 1, timeout,
+                )
+                break
+        return out
+
+    def broadcast_finish(self) -> None:
+        for slave_rank in range(1, self.size):
+            self.send_message(
+                Message(MyMessage.MSG_TYPE_SILO_FINISH, 0, slave_rank)
+            )
+        self.finish()
+
+
+def split_silo_shard(x, y, n: int, m: int, batch_size: int = 1):
+    """Range-split one client shard among m silo members.
+
+    Returns [(x_s, y_s, n_s)] with padding rows staying at the tail of the
+    last slices (the packed layout puts real rows first). Each slice's
+    capacity is padded to a non-zero ``batch_size`` multiple — the local
+    training kernel's batch grid requires it.
+    """
+    x, y = np.asarray(x), np.asarray(y)
+    cap = int(x.shape[0])
+    local = -(-cap // m)  # ceil
+    local = max(-(-local // batch_size) * batch_size, batch_size)
+    pad = local * m - cap
+    if pad:
+        x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+        y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
+    out = []
+    for s in range(m):
+        n_s = min(local, max(0, int(n) - s * local))
+        out.append((x[s * local:(s + 1) * local],
+                    y[s * local:(s + 1) * local], n_s))
+    return out

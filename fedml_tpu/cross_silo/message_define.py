@@ -16,6 +16,12 @@ class MyMessage:
     MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT = "s2c_sync_model_to_client"
     MSG_TYPE_S2C_FINISH = "s2c_finish"
 
+    # intra-silo master <-> slave plane (hierarchical cross-silo;
+    # reference: cross_silo/client/fedml_client_slave_manager.py)
+    MSG_TYPE_SILO_SYNC = "silo_m2s_sync"
+    MSG_TYPE_SILO_RESULT = "silo_s2m_result"
+    MSG_TYPE_SILO_FINISH = "silo_m2s_finish"
+
     MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
     MSG_ARG_KEY_ROUND_IDX = "round_idx"
     MSG_ARG_KEY_CLIENT_INDEX = "client_idx"

@@ -1,0 +1,47 @@
+"""Silo-local device group: the chips one cross-silo client trains over.
+
+reference: ``cross_silo/client/process_group_manager.py:8-44`` — wraps
+``torch.distributed.init_process_group`` so the silo's N processes form a DDP
+group. TPU-native re-design: a silo's accelerators are ICI-connected chips on
+one host slice, so the "process group" is a ``jax.sharding.Mesh`` over a
+device subset with one ``silo_dp`` axis; gradient all-reduce becomes a
+``psum`` XLA emits inside the jitted step — there is no NCCL rendezvous, no
+master port, nothing to tear down.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+logger = logging.getLogger(__name__)
+
+SILO_AXIS = "silo_dp"
+
+
+class SiloProcessGroup:
+    """The device mesh backing one silo's intra-silo data parallelism.
+
+    ``device_indices`` selects chips from ``jax.devices()`` (a silo owns a
+    slice of the host's chips; distinct silos co-hosted in one test process
+    use disjoint slices). Default: all local devices.
+    """
+
+    def __init__(self, device_indices: Optional[Sequence[int]] = None):
+        devs = jax.devices()
+        if device_indices is not None:
+            devs = [devs[i] for i in device_indices]
+        self.devices = devs
+        self.mesh = Mesh(np.asarray(devs), (SILO_AXIS,))
+        logger.info(
+            "silo process group: %d device(s) on axis %r",
+            len(devs), SILO_AXIS,
+        )
+
+    @property
+    def size(self) -> int:
+        return len(self.devices)

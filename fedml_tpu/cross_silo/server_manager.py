@@ -112,7 +112,8 @@ class FedMLServerManager(FedMLCommManager):
             self._finish_round()
 
     def _finish_round(self) -> None:
-        raw = [self._models[r] for r in sorted(self._models)]
+        senders = sorted(self._models)
+        raw = [self._models[r] for r in senders]
         self._models.clear()
         raw = self.aggregator.on_before_aggregation(raw)
         weights = jnp.asarray([n for n, _ in raw])
@@ -124,7 +125,9 @@ class FedMLServerManager(FedMLCommManager):
         if self.defender.is_defense_enabled():
             gvec, treedef, shapes = tree_flatten_to_vector(self.global_params)
             flat = jax.vmap(lambda t: tree_flatten_to_vector(t)[0])(stacked)
-            agg_vec = self.defender.defend(flat, weights, gvec, rng)
+            agg_vec = self.defender.defend(
+                flat, weights, gvec, rng, client_ids=senders
+            )
             agg = tree_unflatten_from_vector(agg_vec, treedef, shapes)
         else:
             agg = weighted_average(stacked, weights)

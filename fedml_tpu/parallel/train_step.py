@@ -220,6 +220,16 @@ class CheetahTrainer:
         )
 
     def shard_batch(self, tokens, mask):
+        dp = 1
+        for ax in self._batch_shard.spec[0] or ():
+            dp *= int(self.mesh.shape[ax])
+        b = tokens.shape[1] if self.accum_steps > 1 else tokens.shape[0]
+        if b % dp:
+            raise ValueError(
+                f"batch size {b} must be divisible by the data-parallel "
+                f"extent {dp} (mesh {dict(self.mesh.shape)}); raise batch_size "
+                f"or shrink the data/fsdp axes"
+            )
         if self.accum_steps > 1:
             spec = P(None, *self._batch_shard.spec)
             shard = NamedSharding(self.mesh, spec)

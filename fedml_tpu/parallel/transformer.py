@@ -143,6 +143,16 @@ def flash_attention_tpu(
     return out.swapaxes(1, 2)
 
 
+def expand_gqa(k, v, n_heads):
+    """Repeat K/V heads up to n_heads (GQA) — one convention, one place."""
+    Hkv = k.shape[2]
+    if Hkv != n_heads:
+        rep = n_heads // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
 def attention_scores(
     q: jax.Array, k: jax.Array, v: jax.Array, mask: Optional[jax.Array]
 ) -> jax.Array:
@@ -153,11 +163,7 @@ def attention_scores(
     (``ring_attention.py``).
     """
     B, L, H, D = q.shape
-    Hkv = k.shape[2]
-    if Hkv != H:
-        rep = H // Hkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    k, v = expand_gqa(k, v, H)
     scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
     logits = jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32) * scale
     causal = jnp.tril(jnp.ones((L, L), jnp.bool_))
@@ -210,10 +216,7 @@ class Attention(nn.Module):
             from .. import constants as _c
             from .ring_attention import make_ring_attention
 
-            if Hkv != H:  # repeat K/V heads before sharding (GQA)
-                rep = H // Hkv
-                k = jnp.repeat(k, rep, axis=2)
-                v = jnp.repeat(v, rep, axis=2)
+            k, v = expand_gqa(k, v, H)  # expand before sharding (GQA)
             spec = P(
                 (_c.MESH_AXIS_DATA, _c.MESH_AXIS_FSDP),
                 seq_ctx.axis_name,
@@ -226,10 +229,7 @@ class Attention(nn.Module):
                 out_specs=spec, check_rep=False,
             )(q, k, v)
         elif mask is None and L >= 128 and L % 128 == 0 and _use_flash(cfg.attn_impl):
-            if Hkv != H:
-                rep = H // Hkv
-                k = jnp.repeat(k, rep, axis=2)
-                v = jnp.repeat(v, rep, axis=2)
+            k, v = expand_gqa(k, v, H)
             out = flash_attention_tpu(q, k, v)
         else:
             out = attention_scores(q, k, v, mask)

@@ -42,6 +42,10 @@ logger = logging.getLogger(__name__)
 class MeshFedAvgAPI(FedAvgAPI):
     """FedAvg-family rounds with the cohort sharded over a ``clients`` axis."""
 
+    # cohorts are host-gathered and placed sharded over the mesh — the
+    # single-device HBM-resident fast path must not allocate in __init__
+    hbm_resident_default = False
+
     def __init__(self, args, device, dataset, model, client_trainer=None,
                  server_aggregator=None):
         super().__init__(args, device, dataset, model, client_trainer,
@@ -56,9 +60,6 @@ class MeshFedAvgAPI(FedAvgAPI):
         self.axis_size = self.mesh.shape[constants.MESH_AXIS_CLIENTS]
         self._shard = NamedSharding(self.mesh, P(constants.MESH_AXIS_CLIENTS))
         self._repl = NamedSharding(self.mesh, P())
-        # the packed dataset stays host-side; cohorts are gathered on host and
-        # placed sharded (the HBM-resident fast path assumes one device)
-        self.hbm_resident = False
         logger.info(
             "mesh simulator: %d-way client sharding over %s",
             self.axis_size, self.mesh,

@@ -64,6 +64,21 @@ class FedAvgAPI:
     model — under vmap the whole cohort is already one device program.)
     """
 
+    # subclasses whose placement hooks gather host-side (mesh) flip this OFF
+    # so __init__ never parks a dead dataset copy in device-0 HBM
+    hbm_resident_default = True
+
+    @staticmethod
+    def _hbm_budget() -> int:
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            limit = int(stats.get("bytes_limit", 0))
+            if limit > 0:
+                return int(limit * 0.6)
+        except Exception:
+            pass
+        return 4 * 1024**3
+
     def __init__(self, args, device, dataset, model, client_trainer=None,
                  server_aggregator=None):
         self.args = args
@@ -109,10 +124,12 @@ class FedAvgAPI:
         # HBM-resident federation (SURVEY.md §7 "Heterogeneous per-client data
         # residency"): park the whole packed dataset on device once and gather
         # cohorts there — no per-round host→device transfer. Falls back to
-        # host-side gather for datasets too large for HBM.
+        # host-side gather for datasets too large for HBM. The budget is
+        # queried from the device (60% of its memory limit, leaving room for
+        # params/grads/cohort working set); 4 GB if the backend reports none.
         total_bytes = self.ds.train_x.nbytes + self.ds.train_y.nbytes
-        self.hbm_resident = bool(
-            getattr(args, "hbm_resident", total_bytes < 4 * 1024**3)
+        self.hbm_resident = self.hbm_resident_default and bool(
+            getattr(args, "hbm_resident", total_bytes < self._hbm_budget())
         )
         if self.hbm_resident:
             self._dev_x = jax.device_put(self.ds.train_x)
@@ -183,7 +200,7 @@ class FedAvgAPI:
         n_valid = len(cohort) if wmask is None else int(wmask.sum())
         cx, cy, cn = self._gather_cohort(cohort)
         if self.attacker.is_data_attack():
-            cx, cy = self.attacker.attack_data(cx, cy)
+            cx, cy = self.attacker.attack_data(cx, cy, n_valid)
 
         round_rng = jax.random.fold_in(self.root_rng, round_idx)
         rngs = self._place(jax.random.split(round_rng, len(cohort)))
@@ -192,7 +209,7 @@ class FedAvgAPI:
         if self.fedsgd:
             grads, metrics = self.cohort_fn(self.global_params, cx, cy, cn, rngs)
             weights = metrics["num_samples"] if wm is None else metrics["num_samples"] * wm
-            agg_grad = self._aggregate(grads, weights, round_rng, n_valid)
+            agg_grad = self._aggregate(grads, weights, round_rng, n_valid, cohort)
             updates, self.server_opt_state = self.server_opt.update(
                 agg_grad, self.server_opt_state, self.global_params
             )
@@ -238,7 +255,7 @@ class FedAvgAPI:
                 lambda g, dd: g - tau_eff * dd, self.global_params, d
             )
         else:
-            w_agg = self._aggregate(stacked, weights, round_rng, n_valid)
+            w_agg = self._aggregate(stacked, weights, round_rng, n_valid, cohort)
             if self.opt_name == constants.FEDML_FEDERATED_OPTIMIZER_FEDOPT:
                 import optax
 
@@ -258,7 +275,8 @@ class FedAvgAPI:
 
     # -- aggregation with trust hooks ---------------------------------------
     def _aggregate(
-        self, stacked: PyTree, weights: jax.Array, rng, n_valid: int = None
+        self, stacked: PyTree, weights: jax.Array, rng, n_valid: int = None,
+        client_ids=None,
     ) -> PyTree:
         """attack → defend → weighted-average → (local/central DP applied by
         caller), all on the stacked [cohort, ...] arrays.
@@ -303,8 +321,9 @@ class FedAvgAPI:
                 flat, weights, jax.random.fold_in(rng, 1)
             )
         if self.defender.is_defense_enabled():
+            ids = None if client_ids is None else list(client_ids)[:n]
             agg_vec = self.defender.defend(
-                flat, weights, gvec, jax.random.fold_in(rng, 2)
+                flat, weights, gvec, jax.random.fold_in(rng, 2), client_ids=ids
             )
         else:
             w = weights / jnp.maximum(weights.sum(), 1e-12)

@@ -34,7 +34,7 @@ class TurboAggregateAPI(FedAvgAPI):
         self.q_bits = int(getattr(args, "ta_quantize_bits", 8))
         self.group_size = int(getattr(args, "ta_group_size", 2))
 
-    def _aggregate(self, stacked, weights, rng):
+    def _aggregate(self, stacked, weights, rng, n_valid=None, client_ids=None):
         """Replace the trusted-server average with additive-share aggregation.
 
         Each client i quantizes its weighted update and splits it into
@@ -45,7 +45,10 @@ class TurboAggregateAPI(FedAvgAPI):
         """
         import jax.numpy as jnp
 
-        n = int(weights.shape[0])
+        n = int(weights.shape[0]) if n_valid is None else int(n_valid)
+        if n < weights.shape[0]:
+            stacked = jax.tree.map(lambda x: x[:n], stacked)
+            weights = weights[:n]
         w = np.asarray(weights, np.float64)
         w = w / max(w.sum(), 1e-12)
         _, treedef, shapes = tree_flatten_to_vector(self.global_params)
