@@ -115,35 +115,61 @@ def bench_cheetah() -> dict:
 
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu:
-        cfg = TransformerConfig(
-            vocab_size=32000, d_model=1024, n_layers=24, n_heads=16,
-            n_kv_heads=16, d_ff=2816, max_seq_len=2048,
+        base = dict(
+            vocab_size=32000, d_model=1024, n_layers=24, n_heads=8,
+            n_kv_heads=8, d_ff=2816, max_seq_len=2048,
         )
+        # memory/recompute ladder, fastest first (tools/mfu_sweep.py):
+        # no-remat needs the most HBM; "dots" saves matmul outputs only;
+        # full-block remat always fits
+        ladder = [
+            dict(remat=False),
+            dict(remat=True, remat_policy="dots"),
+            dict(remat=True, remat_policy="full"),
+        ]
         batch, seq, steps, warmup = 8, 2048, 20, 3
     else:  # CPU smoke config so the bench degrades gracefully off-TPU
-        cfg = TransformerConfig(
-            vocab_size=1024, d_model=256, n_layers=4, n_heads=8,
-            n_kv_heads=8, d_ff=704, max_seq_len=512,
+        base = dict(
+            vocab_size=1024, d_model=256, n_heads=8,
+            n_kv_heads=8, d_ff=704, max_seq_len=512, n_layers=4,
         )
+        ladder = [dict(remat=False)]
         batch, seq, steps, warmup = 2, 256, 4, 1
 
     mesh = make_mesh()  # all local devices on the data axis
-    trainer = CheetahTrainer(
-        cfg, mesh,
-        optimizer=make_optimizer(learning_rate=3e-4, warmup_steps=10,
-                                 total_steps=steps + warmup),
-    )
-    state = trainer.init_state(jax.random.PRNGKey(0))
-    n_params = sum(int(p.size) for p in jax.tree.leaves(state.params))
-
     rng = np.random.RandomState(0)
+
+    state = trainer = cfg = None
+    last_err = None
+    for rung in ladder:
+        cfg = TransformerConfig(**{**base, **rung})
+        trainer = CheetahTrainer(
+            cfg, mesh,
+            optimizer=make_optimizer(learning_rate=3e-4, warmup_steps=10,
+                                     total_steps=steps + warmup,
+                                     mu_dtype=jnp.bfloat16),
+        )
+        try:
+            state = trainer.init_state(jax.random.PRNGKey(0))
+            mask = jnp.ones((batch, seq), jnp.int32)
+            tok = jnp.asarray(
+                rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+            )
+            state, metrics = trainer.train_step(state, tok, mask)
+            _sync(metrics["loss"])
+            break  # this rung compiles and fits
+        except Exception as e:  # OOM at this rung: drop to more remat
+            last_err = e
+            state = None
+    if state is None:
+        raise RuntimeError(f"no cheetah config fit on this chip: {last_err}")
+    n_params = sum(int(p.size) for p in jax.tree.leaves(state.params))
 
     def batch_tokens():
         return jnp.asarray(
             rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
         )
 
-    mask = jnp.ones((batch, seq), jnp.int32)
     for _ in range(warmup):
         state, metrics = trainer.train_step(state, batch_tokens(), mask)
     _sync(metrics["loss"])
@@ -167,6 +193,7 @@ def bench_cheetah() -> dict:
         "cheetah_params_m": round(n_params / 1e6, 1),
         "cheetah_seq_len": seq,
         "cheetah_device_kind": kind,
+        "cheetah_remat": cfg.remat_policy if cfg.remat else "none",
     }
     if peak:
         out["cheetah_mfu"] = round(achieved / (peak * n_chips), 4)

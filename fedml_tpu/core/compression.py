@@ -99,3 +99,100 @@ def uniform_quantize(vec: jax.Array, bits: int = 8) -> QuantizePayload:
 
 def uniform_dequantize(payload: QuantizePayload) -> jax.Array:
     return payload.q.astype(payload.scale.dtype) * payload.scale + payload.zero
+
+
+# ---------------------------------------------------------------------------
+# Wire codec: compressed client->server updates for the message plane
+# ---------------------------------------------------------------------------
+class UpdateCodec:
+    """Codec for C2S model updates on the cross-silo message plane.
+
+    reference: the fedavg_seq message hook compresses each client update
+    before it rides MPI (``utils/compression.py:9-281`` wired through
+    ``simulation/mpi/fedavg_seq``). Here the client encodes the DELTA between
+    its trained params and the round's broadcast global (deltas are sparse/
+    low-entropy where raw params are not); the server reconstructs
+    ``global + delta``. EF-TopK carries the per-client residual across
+    rounds, so dropped mass is re-injected instead of lost.
+
+    ``args.compression`` ∈ {"", "topk", "eftopk", "qsgd", "quantize"};
+    ``args.compression_ratio`` (top-k fraction), ``args.quantize_bits``,
+    ``args.qsgd_levels``.
+    """
+
+    META_KEY = "__compression__"
+
+    def __init__(self, args):
+        self.scheme = str(getattr(args, "compression", "") or "").lower()
+        self.ratio = float(getattr(args, "compression_ratio", 0.1))
+        self.bits = int(getattr(args, "quantize_bits", 8))
+        self.levels = int(getattr(args, "qsgd_levels", 256))
+        self.seed = int(getattr(args, "random_seed", 0))
+        self._residual = None  # EF-TopK state (client side)
+
+    def enabled(self) -> bool:
+        return self.scheme in ("topk", "eftopk", "qsgd", "quantize")
+
+    def encode(self, global_vec, new_vec, round_idx: int = 0):
+        """-> (arrays, meta) for the wire. Inputs are 1-D jax/np vectors."""
+        import numpy as np
+
+        delta = jnp.asarray(new_vec) - jnp.asarray(global_vec)
+        dim = int(delta.shape[0])
+        meta = {"scheme": self.scheme, "dim": dim}
+        if self.scheme in ("topk", "eftopk"):
+            k = max(1, int(dim * self.ratio))
+            meta["k"] = k
+            if self.scheme == "eftopk":
+                if self._residual is None or self._residual.shape != delta.shape:
+                    self._residual = jnp.zeros_like(delta)
+                payload, self._residual = ef_topk_compress(
+                    delta, self._residual, k
+                )
+            else:
+                payload = topk_compress(delta, k)
+            arrays = [np.asarray(payload.values),
+                      np.asarray(payload.indices).astype(np.int32)]
+        elif self.scheme == "qsgd":
+            key = jax.random.fold_in(jax.random.PRNGKey(self.seed), round_idx)
+            payload = qsgd_compress(delta, key, self.levels)
+            meta["s"] = self.levels
+            arrays = [np.asarray(payload.norm).reshape(1),
+                      np.asarray(payload.signed_levels)]
+        elif self.scheme == "quantize":
+            payload = uniform_quantize(delta, self.bits)
+            meta["bits"] = self.bits
+            arrays = [np.asarray(payload.q),
+                      np.asarray(payload.scale).reshape(1),
+                      np.asarray(payload.zero).reshape(1)]
+        else:
+            raise ValueError(f"unknown compression scheme {self.scheme!r}")
+        return arrays, meta
+
+    @staticmethod
+    def decode(global_vec, arrays, meta):
+        """Reconstruct the client's new vector from the wire payload."""
+        scheme = meta["scheme"]
+        dim = int(meta["dim"])
+        if scheme in ("topk", "eftopk"):
+            payload = TopKPayload(
+                values=jnp.asarray(arrays[0]),
+                indices=jnp.asarray(arrays[1]), dim=dim,
+            )
+            delta = topk_decompress(payload)
+        elif scheme == "qsgd":
+            payload = QSGDPayload(
+                norm=jnp.asarray(arrays[0])[0],
+                signed_levels=jnp.asarray(arrays[1]), s=int(meta["s"]),
+            )
+            delta = qsgd_decompress(payload)
+        elif scheme == "quantize":
+            payload = QuantizePayload(
+                q=jnp.asarray(arrays[0]),
+                scale=jnp.asarray(arrays[1])[0],
+                zero=jnp.asarray(arrays[2])[0],
+            )
+            delta = uniform_dequantize(payload)
+        else:
+            raise ValueError(f"unknown compression scheme {scheme!r}")
+        return jnp.asarray(global_vec) + delta

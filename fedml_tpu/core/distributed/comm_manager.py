@@ -28,6 +28,8 @@ MessageHandler = Callable[[Message], None]
 class FedMLCommManager(Observer):
     def __init__(self, args, comm=None, rank: int = 0, size: int = 0,
                  backend: str = constants.COMM_BACKEND_LOOPBACK):
+        from .payload_store import store_from_args
+
         self.args = args
         self.size = int(size)
         self.rank = int(rank)
@@ -35,6 +37,12 @@ class FedMLCommManager(Observer):
         self.com_manager: Optional[BaseCommunicationManager] = comm
         self.message_handler_dict: Dict[str, MessageHandler] = {}
         self._thread: Optional[threading.Thread] = None
+        # payload-by-reference mode (reference MQTT+S3 split): arrays above
+        # the inline limit ride the shared store, not the control channel
+        self.payload_store = store_from_args(args)
+        self.payload_inline_limit = int(
+            getattr(args, "payload_inline_limit_bytes", 1 * 1024 * 1024)
+        )
         if self.com_manager is None:
             self._init_manager()
         self.com_manager.add_observer(self)
@@ -67,9 +75,31 @@ class FedMLCommManager(Observer):
         return self._thread
 
     def send_message(self, message: Message) -> None:
+        from .payload_store import PAYLOAD_REF_KEY
+
+        if (
+            self.payload_store is not None
+            and message.arrays
+            and sum(a.nbytes for a in message.arrays) > self.payload_inline_limit
+        ):
+            # content-addressed: an N-client broadcast of the same model
+            # writes one blob; stale blobs age out via TTL sweep
+            key = self.payload_store.put_dedup(message.arrays)
+            message.add(PAYLOAD_REF_KEY, key)
+            message.set_arrays([])
+            self.payload_store.sweep(
+                float(getattr(self.args, "payload_ttl_seconds", 3600.0))
+            )
         self.com_manager.send_message(message)
 
     def receive_message(self, msg_type: str, msg: Message) -> None:
+        from .payload_store import PAYLOAD_REF_KEY
+
+        ref = msg.get(PAYLOAD_REF_KEY)
+        if ref and self.payload_store is not None:
+            # blobs are content-addressed and shared across recipients —
+            # never consumed on read; the sender's TTL sweep reclaims them
+            msg.set_arrays(self.payload_store.get(str(ref)))
         handler = self.message_handler_dict.get(str(msg_type))
         if handler is None:
             logger.debug("rank %d: no handler for %r", self.rank, msg_type)

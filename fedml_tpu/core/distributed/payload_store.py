@@ -1,0 +1,119 @@
+"""Payload-by-reference bulk channel: control/data split for the message plane.
+
+reference: the production Octopus/Beehive transports split small control
+messages from bulk model payloads — MQTT carries JSON control, S3 carries the
+tensors, and the message holds the S3 key
+(``communication/mqtt_s3/mqtt_s3_multi_clients_comm_manager.py:20-352``,
+``communication/s3/remote_storage.py:18-183``).
+
+TPU-native re-design: one ``PayloadStore`` abstraction over a shared
+filesystem directory (NFS / GCS-FUSE in production pods, a tmp dir in tests).
+Arrays are written once as an npz blob with an atomic rename; the wire
+message carries only the key, so a 1 GB model never rides the control
+channel. The npz format matches ``Message``'s inline body — no pickle in
+either path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import logging
+import os
+import time
+import uuid
+from typing import List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# message param carrying the reference (absent = inline payload)
+PAYLOAD_REF_KEY = "__payload_ref__"
+
+
+class PayloadStore:
+    """npz blobs under a shared directory, addressed by opaque keys."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        path = os.path.abspath(os.path.join(self.root, key))
+        if not path.startswith(self.root + os.sep):
+            raise ValueError(f"payload key escapes the store root: {key!r}")
+        return path
+
+    def new_key(self, hint: str = "payload") -> str:
+        safe = "".join(c if c.isalnum() or c in "-_" else "_" for c in hint)
+        return f"{safe}-{uuid.uuid4().hex}.npz"
+
+    def put(self, key: str, arrays: List[np.ndarray]) -> str:
+        """Write atomically (tmp + rename): a reader never sees a torn blob."""
+        buf = io.BytesIO()
+        np.savez(buf, *[np.asarray(a) for a in arrays])
+        path = self._path(key)
+        tmp = f"{path}.tmp-{uuid.uuid4().hex}"
+        with open(tmp, "wb") as f:
+            f.write(buf.getvalue())
+        os.replace(tmp, path)
+        return key
+
+    def put_dedup(self, arrays: List[np.ndarray]) -> str:
+        """Content-addressed put: a broadcast of the same model to N peers
+        writes ONE blob (key = sha256 of the serialized payload), not N."""
+        buf = io.BytesIO()
+        np.savez(buf, *[np.asarray(a) for a in arrays])
+        data = buf.getvalue()
+        key = f"cas-{hashlib.sha256(data).hexdigest()}.npz"
+        path = self._path(key)
+        if not os.path.exists(path):
+            tmp = f"{path}.tmp-{uuid.uuid4().hex}"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        return key
+
+    def sweep(self, max_age_seconds: float = 3600.0) -> int:
+        """Drop blobs older than the TTL (content-addressed blobs are shared
+        by many readers, so delete-on-read is wrong; age is the contract)."""
+        cutoff = time.time() - max_age_seconds
+        dropped = 0
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return 0
+        for name in names:
+            path = os.path.join(self.root, name)
+            try:
+                if os.path.getmtime(path) < cutoff:
+                    os.remove(path)
+                    dropped += 1
+            except OSError:
+                continue
+        return dropped
+
+    def get(self, key: str, delete: bool = False) -> List[np.ndarray]:
+        path = self._path(key)
+        with open(path, "rb") as f:
+            data = f.read()
+        with np.load(io.BytesIO(data)) as z:
+            arrays = [z[k] for k in z.files]
+        if delete:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        return arrays
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except OSError:
+            pass
+
+
+def store_from_args(args) -> Optional[PayloadStore]:
+    root = str(getattr(args, "payload_store_dir", "") or "")
+    return PayloadStore(root) if root else None

@@ -49,6 +49,11 @@ class ClientMasterManager(FedMLCommManager):
             else None
         )
         self._treedef: Optional[object] = None
+        # wire compression of the C2S update delta (core/compression.UpdateCodec)
+        from ..core.compression import UpdateCodec
+
+        self.codec = UpdateCodec(args)
+        self._round_global_vec = None  # broadcast params, codec reference
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -78,7 +83,12 @@ class ClientMasterManager(FedMLCommManager):
             )
             self._treedef = jax.tree.structure(skeleton)
         leaves = [jnp.asarray(a) for a in msg.get_arrays()]
-        self.trainer.set_model_params(jax.tree.unflatten(self._treedef, leaves))
+        params = jax.tree.unflatten(self._treedef, leaves)
+        self.trainer.set_model_params(params)
+        if self.codec.enabled():
+            from ..utils.tree import tree_flatten_to_vector
+
+            self._round_global_vec, _, _ = tree_flatten_to_vector(params)
 
     def _on_init(self, msg: Message) -> None:
         self.client_index = int(
@@ -121,7 +131,17 @@ class ClientMasterManager(FedMLCommManager):
         msg.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
         msg.add(MyMessage.MSG_ARG_KEY_TRAIN_LOSS,
                 float(metrics.get("train_loss", 0.0)))
-        msg.set_arrays([np.asarray(l) for l in jax.tree.leaves(params)])
+        if self.codec.enabled() and self._round_global_vec is not None:
+            from ..utils.tree import tree_flatten_to_vector
+
+            vec, _, _ = tree_flatten_to_vector(params)
+            arrays, meta = self.codec.encode(
+                self._round_global_vec, vec, self.round_idx
+            )
+            msg.add(self.codec.META_KEY, meta)
+            msg.set_arrays(arrays)
+        else:
+            msg.set_arrays([np.asarray(l) for l in jax.tree.leaves(params)])
         self.send_message(msg)
 
     def _train_hierarchical(self):
@@ -139,7 +159,8 @@ class ClientMasterManager(FedMLCommManager):
         metrics = self.trainer.train((x, y, n), None, self.args)
         own = self.trainer.get_model_params()
         results = self.silo_plane.collect(
-            timeout=float(getattr(self.args, "silo_timeout", 120.0))
+            self.round_idx,
+            timeout=float(getattr(self.args, "silo_timeout", 120.0)),
         )
         leaves_list = [jax.tree.leaves(own)] + [r[1] for r in results]
         weights = np.asarray([float(n)] + [r[0] for r in results], np.float64)

@@ -115,6 +115,7 @@ class SiloMasterPlane(FedMLCommManager):
             float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0)),
             leaves,
             float(msg.get(MyMessage.MSG_ARG_KEY_TRAIN_LOSS, 0.0)),
+            int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, -1)),
         ))
 
     def broadcast_sync(self, params, round_idx: int) -> None:
@@ -125,19 +126,23 @@ class SiloMasterPlane(FedMLCommManager):
             msg.set_arrays(leaves)
             self.send_message(msg)
 
-    def collect(self, timeout: float = 120.0):
-        """Block for the slaves' results: [(n, leaves, loss), ...].
+    def collect(self, round_idx: int, timeout: float = 120.0):
+        """Block for the slaves' round-``round_idx`` results:
+        [(n, leaves, loss), ...].
 
         A slave that misses the deadline is dropped for the round (the silo
         proceeds with whoever answered) — a dead slave must not take the
         master's receive thread, and with it the whole federation, down.
+        A stale result from a PREVIOUS round (slave answered after the
+        deadline; the queue persists) is discarded, not mistaken for this
+        round's.
         """
         import queue
 
         out = []
-        for _ in range(self.size - 1):
+        while len(out) < self.size - 1:
             try:
-                out.append(self._results.get(timeout=timeout))
+                n, leaves, loss, r = self._results.get(timeout=timeout)
             except queue.Empty:
                 logger.warning(
                     "silo master: %d/%d slave result(s) missing after %.0fs; "
@@ -145,6 +150,13 @@ class SiloMasterPlane(FedMLCommManager):
                     self.size - 1 - len(out), self.size - 1, timeout,
                 )
                 break
+            if r != round_idx:
+                logger.warning(
+                    "silo master: discarding stale round-%d slave result "
+                    "(current round %d)", r, round_idx,
+                )
+                continue
+            out.append((n, leaves, loss))
         return out
 
     def broadcast_finish(self) -> None:
@@ -155,13 +167,15 @@ class SiloMasterPlane(FedMLCommManager):
         self.finish()
 
 
-def split_silo_shard(x, y, n: int, m: int, batch_size: int = 1):
-    """Range-split one client shard among m silo members.
+def padded_silo_split(x, y, n: int, m: int, batch_size: int = 1):
+    """Shared split geometry for both silo paths (ICI mesh + DCN slaves).
 
-    Returns [(x_s, y_s, n_s)] with padding rows staying at the tail of the
-    last slices (the packed layout puts real rows first). Each slice's
-    capacity is padded to a non-zero ``batch_size`` multiple — the local
-    training kernel's batch grid requires it.
+    Pads the packed shard so each of the m members owns ``local`` rows where
+    ``local`` is a non-zero ``batch_size`` multiple (the local training
+    kernel's batch grid requires it), and computes per-member real-sample
+    counts (real rows sit contiguously at the front of the packed layout).
+
+    Returns ``(x_padded, y_padded, local, counts)``.
     """
     x, y = np.asarray(x), np.asarray(y)
     cap = int(x.shape[0])
@@ -171,9 +185,21 @@ def split_silo_shard(x, y, n: int, m: int, batch_size: int = 1):
     if pad:
         x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
         y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
-    out = []
-    for s in range(m):
-        n_s = min(local, max(0, int(n) - s * local))
-        out.append((x[s * local:(s + 1) * local],
-                    y[s * local:(s + 1) * local], n_s))
-    return out
+    counts = np.asarray(
+        [min(local, max(0, int(n) - s * local)) for s in range(m)], np.int32
+    )
+    return x, y, local, counts
+
+
+def split_silo_shard(x, y, n: int, m: int, batch_size: int = 1):
+    """Range-split one client shard among m silo members (DCN path).
+
+    Returns [(x_s, y_s, n_s)]; padding rows stay at the tail of the last
+    slices.
+    """
+    x, y, local, counts = padded_silo_split(x, y, n, m, batch_size)
+    return [
+        (x[s * local:(s + 1) * local], y[s * local:(s + 1) * local],
+         int(counts[s]))
+        for s in range(m)
+    ]

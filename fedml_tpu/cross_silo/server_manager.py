@@ -41,9 +41,16 @@ class FedMLServerManager(FedMLCommManager):
         self.round_idx = 0
         self.client_num = self.size - 1
         self._online = set()
+        self._dead = set()  # clients that went OFFLINE or timed out
         self._models: Dict[int, tuple] = {}
         self._lock = threading.Lock()
         self._init_sent = False
+        # dropout tolerance (the reference's MQTT last-will analog +
+        # a cohort deadline it never had): after round_timeout seconds the
+        # round aggregates whoever answered, if at least min_clients did
+        self.round_timeout = float(getattr(args, "round_timeout", 0.0) or 0.0)
+        self.min_clients = int(getattr(args, "min_clients_per_round", 1))
+        self._round_timer: Optional[threading.Timer] = None
         self.global_params = (
             aggregator.get_model_params()
             if aggregator.get_model_params() is not None
@@ -78,14 +85,78 @@ class FedMLServerManager(FedMLCommManager):
 
     def _on_client_status(self, msg: Message) -> None:
         status = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS)
+        finish = False
         with self._lock:
             if status == MyMessage.CLIENT_STATUS_ONLINE:
                 self._online.add(msg.get_sender_id())
-            ready = len(self._online) == self.client_num and not self._init_sent
+                self._dead.discard(msg.get_sender_id())
+            elif status == MyMessage.CLIENT_STATUS_OFFLINE:
+                # explicit departure (the MQTT last-will analog): stop
+                # waiting for this client from now on
+                self._dead.add(msg.get_sender_id())
+                self._online.discard(msg.get_sender_id())
+                logger.warning(
+                    "server: client %d went OFFLINE", msg.get_sender_id()
+                )
+                finish = self._round_complete_locked()
+            # init barrier counts the dead as resolved — a client that died
+            # during startup must not stall the federation forever
+            ready = (
+                len(self._online) + len(self._dead) >= self.client_num
+                and len(self._online) > 0
+                and not self._init_sent
+            )
             if ready:
                 self._init_sent = True
         if ready:
             self._send_init_msg()
+        elif finish:
+            self._finish_round()
+
+    def _round_complete_locked(self) -> bool:
+        """Caller holds the lock. True when every still-live client of the
+        current round has reported."""
+        expected = self.client_num - len(self._dead)
+        return len(self._models) >= max(expected, self.min_clients) > 0
+
+    def _arm_round_timer(self) -> None:
+        if self.round_timeout <= 0:
+            return
+        if self._round_timer is not None:
+            self._round_timer.cancel()
+        self._round_timer = threading.Timer(
+            self.round_timeout, self._on_round_timeout, args=(self.round_idx,)
+        )
+        self._round_timer.daemon = True
+        self._round_timer.start()
+
+    def _on_round_timeout(self, round_idx: int) -> None:
+        """Cohort deadline: aggregate the subset that answered; clients that
+        missed the deadline are marked dead (they rejoin by re-sending
+        ONLINE status)."""
+        with self._lock:
+            if round_idx != self.round_idx:
+                return
+            if not self._models or len(self._models) < self.min_clients:
+                logger.warning(
+                    "server round %d: timeout with %d/%d models "
+                    "(< min_clients %d) — keep waiting",
+                    round_idx, len(self._models), self.client_num,
+                    self.min_clients,
+                )
+                self._arm_round_timer()  # keep the deadline alive
+                return
+            missing = (
+                set(range(1, self.size)) - set(self._models) - self._dead
+            )
+            self._dead.update(missing)
+        if missing:
+            logger.warning(
+                "server round %d: deadline passed; dropping %s and "
+                "aggregating %d/%d models",
+                round_idx, sorted(missing), len(self._models), self.client_num,
+            )
+        self._finish_round()
 
     def _send_init_msg(self) -> None:
         """reference: fedml_server_manager.py:93-118 (online barrier → init)."""
@@ -95,26 +166,56 @@ class FedMLServerManager(FedMLCommManager):
             msg.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
             msg.add(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, client_rank - 1)
             msg.set_arrays(leaves)
-            self.send_message(msg)
+            self._send_or_mark_dead(client_rank, msg)
         logger.info("server: init sent to %d clients", self.client_num)
+        self._arm_round_timer()
 
     def _on_model_received(self, msg: Message) -> None:
         sender = msg.get_sender_id()
-        leaves = [jnp.asarray(a) for a in msg.get_arrays()]
-        params = jax.tree.unflatten(
-            jax.tree.structure(self.global_params), leaves
-        )
+        if int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)) != self.round_idx:
+            logger.warning(
+                "server: stale round model from client %d ignored", sender
+            )
+            return
+        from ..core.compression import UpdateCodec
+
+        meta = msg.get(UpdateCodec.META_KEY)
+        if meta:
+            # compressed update delta: the reference vector is this round's
+            # broadcast global
+            gvec, treedef, shapes = tree_flatten_to_vector(self.global_params)
+            vec = UpdateCodec.decode(gvec, msg.get_arrays(), meta)
+            params = tree_unflatten_from_vector(vec, treedef, shapes)
+        else:
+            leaves = [jnp.asarray(a) for a in msg.get_arrays()]
+            params = jax.tree.unflatten(
+                jax.tree.structure(self.global_params), leaves
+            )
         n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0))
+        msg_round = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx))
         with self._lock:
+            if msg_round != self.round_idx:
+                return  # round closed between the unlocked check and here
             self._models[sender] = (n, params)
-            have_all = len(self._models) == self.client_num
+            have_all = self._round_complete_locked()
         if have_all:
             self._finish_round()
 
     def _finish_round(self) -> None:
-        senders = sorted(self._models)
-        raw = [self._models[r] for r in senders]
-        self._models.clear()
+        with self._lock:
+            if not self._models:
+                return  # already aggregated (timeout/model-arrival race)
+            if self._round_timer is not None:
+                self._round_timer.cancel()
+                self._round_timer = None
+            senders = sorted(self._models)
+            raw = [self._models[r] for r in senders]
+            self._models.clear()
+            # close the round window NOW: any round-r straggler arriving
+            # while the (slow) aggregation below runs must be rejected by
+            # the stale-round check, not counted toward round r+1
+            round_r = self.round_idx
+            self.round_idx += 1
         raw = self.aggregator.on_before_aggregation(raw)
         weights = jnp.asarray([n for n, _ in raw])
         stacked = stack_trees([p for _, p in raw])
@@ -139,31 +240,46 @@ class FedMLServerManager(FedMLCommManager):
 
         if self.ds is not None:
             freq = max(int(getattr(self.args, "frequency_of_the_test", 1)), 1)
-            if self.round_idx % freq == 0 or self.round_idx == self.round_num - 1:
+            if round_r % freq == 0 or round_r == self.round_num - 1:
                 self.final_metrics = make_eval_fn(self.bundle)(
                     agg, self.ds.test_x, self.ds.test_y
                 )
                 logger.info(
-                    "server round %d: acc=%.4f", self.round_idx,
+                    "server round %d: acc=%.4f", round_r,
                     self.final_metrics["test_acc"],
                 )
 
-        self.round_idx += 1
         leaves = [np.asarray(l) for l in jax.tree.leaves(self.global_params)]
         if self.round_idx < self.round_num:
             for client_rank in range(1, self.size):
+                if client_rank in self._dead:
+                    continue  # dropped client; it rejoins via ONLINE status
                 msg = Message(
                     MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank,
                     client_rank,
                 )
                 msg.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
                 msg.set_arrays(leaves)
-                self.send_message(msg)
+                self._send_or_mark_dead(client_rank, msg)
+            self._arm_round_timer()
         else:
             for client_rank in range(1, self.size):
                 msg = Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, client_rank)
                 msg.set_arrays(leaves)
-                self.send_message(msg)
+                self._send_or_mark_dead(client_rank, msg)
             logger.info("server: training finished after %d rounds", self.round_num)
             self.done.set()
             self.finish()
+
+    def _send_or_mark_dead(self, client_rank: int, msg: Message) -> None:
+        """Transport-level liveness: an unreachable peer (dead gRPC channel)
+        is marked dead instead of crashing the FSM."""
+        try:
+            self.send_message(msg)
+        except Exception as e:
+            logger.warning(
+                "server: send to client %d failed (%s) — marking dead",
+                client_rank, e,
+            )
+            with self._lock:
+                self._dead.add(client_rank)

@@ -168,23 +168,12 @@ class TrainerDistAdapter:
         if k <= 1:
             return self.trainer.train(train_data, device, args)
         x, y, n = train_data
-        x, y = np.asarray(x), np.asarray(y)
-        cap = int(x.shape[0])
-        # per-device capacity must stay a (non-zero) batch multiple — the
-        # scan's batch grid slices batch_size rows from each local perm
-        bs = int(self.args.batch_size)
-        local_cap = -(-cap // k)  # ceil
-        local_cap = max(-(-local_cap // bs) * bs, bs)
-        pad = local_cap * k - cap
-        if pad:
-            x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
-            y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
-        # real samples land contiguously: device d's slice
-        # [d*local_cap, (d+1)*local_cap) holds min(local_cap, max(0, n - d*local_cap))
-        n = int(n)
-        n_dev = np.asarray(
-            [min(local_cap, max(0, n - d * local_cap)) for d in range(k)],
-            np.int32,
+        # shared split geometry with the DCN path (client_slave_manager):
+        # per-device capacity a non-zero batch multiple, contiguous real rows
+        from .client_slave_manager import padded_silo_split
+
+        x, y, local_cap, n_dev = padded_silo_split(
+            x, y, int(n), k, int(self.args.batch_size)
         )
         if local_cap not in self._jitted:
             self._jitted[local_cap] = make_silo_dp_train_fn(

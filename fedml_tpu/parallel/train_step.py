@@ -43,13 +43,19 @@ def make_optimizer(
     grad_clip: float = 1.0,
     warmup_steps: int = 100,
     total_steps: int = 10000,
+    mu_dtype=None,
 ) -> optax.GradientTransformation:
+    """``mu_dtype=jnp.bfloat16`` halves the first-moment buffer — on a
+    single 16 GB chip the difference between spilling and staying resident."""
     schedule = optax.warmup_cosine_decay_schedule(
         0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1)
     )
     return optax.chain(
         optax.clip_by_global_norm(grad_clip),
-        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
+        optax.adamw(
+            schedule, b1=b1, b2=b2, weight_decay=weight_decay,
+            mu_dtype=mu_dtype,
+        ),
     )
 
 

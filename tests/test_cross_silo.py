@@ -202,3 +202,223 @@ class TestHierarchicalSilo:
         # DCN slaves reached FINISH too (async wrt the master's join)
         for slave in clients[2]._slaves:
             assert slave.done.wait(timeout=30)
+
+
+class TestLivenessAndPayloadRef:
+    """VERDICT next #6: dropout tolerance + payload-by-reference transport
+    (reference MQTT last-will + MQTT+S3 split)."""
+
+    def test_payload_store_roundtrip(self, tmp_path):
+        from fedml_tpu.core.distributed.payload_store import PayloadStore
+
+        store = PayloadStore(str(tmp_path))
+        arrays = [np.arange(12, dtype=np.float32).reshape(3, 4),
+                  np.ones((2,), np.int64)]
+        key = store.new_key("model-0to1")
+        store.put(key, arrays)
+        back = store.get(key, delete=True)
+        for a, b in zip(arrays, back):
+            np.testing.assert_array_equal(a, b)
+        with pytest.raises(OSError):
+            store.get(key)  # consumed
+        with pytest.raises(ValueError):
+            store.put("../escape.npz", arrays)
+
+    def test_cross_silo_payload_by_reference(self, tmp_path):
+        """Full FSM with bulk payloads riding the store: the control messages
+        stay small (>=4x smaller than inline), training still converges."""
+        from fedml_tpu.core.distributed.loopback import LoopbackCommManager
+
+        sizes = []
+        orig = LoopbackCommManager.send_message
+
+        def spy(self, msg):
+            sizes.append(len(msg.serialize()))
+            return orig(self, msg)
+
+        LoopbackCommManager.send_message = spy
+        try:
+            result, server, clients = run_world(
+                "pr1", payload_store_dir=str(tmp_path),
+                payload_inline_limit_bytes=64,
+            )
+        finally:
+            LoopbackCommManager.send_message = orig
+        assert result["test_acc"] > 0.5
+        # every wire message is control-sized; the lr model inline would be
+        # ~25 KB (3x65x4B x2 leaves + header)
+        assert max(sizes) < 4096, f"bulk payload leaked onto the wire: {max(sizes)}"
+
+    def test_round_timeout_drops_dead_client(self):
+        """4 clients; 1 dies after reporting ONLINE (never trains). With
+        round_timeout the server aggregates the 3 live models and training
+        completes; without it the round would hang forever."""
+        n = 4
+        args_s = make_args("live1", role="server", client_num_in_total=n,
+                           round_timeout=3.0, comm_round=2)
+        ds, od = data_mod.load(args_s)
+        bundle = model_mod.create(args_s, od)
+        server = FedMLCrossSiloServer(args_s, None, ds, bundle)
+
+        clients = []
+        for rank in range(1, n):  # ranks 1..3 are real
+            args_c = make_args("live1", role="client", rank=rank,
+                               client_num_in_total=n, comm_round=2)
+            clients.append(FedMLCrossSiloClient(args_c, None, ds, bundle))
+
+        # rank 4: sends ONLINE, then goes silent (killed mid-round)
+        from fedml_tpu.core.distributed import FedMLCommManager, Message
+        from fedml_tpu.cross_silo.message_define import MyMessage
+
+        class DeadClient(FedMLCommManager):
+            def register_message_receive_handlers(self):
+                self.register_message_receive_handler(
+                    MyMessage.MSG_TYPE_CONNECTION_IS_READY, self._on_ready
+                )
+
+            def _on_ready(self, msg):
+                status = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS,
+                                 self.rank, 0)
+                status.add(MyMessage.MSG_ARG_KEY_CLIENT_STATUS,
+                           MyMessage.CLIENT_STATUS_ONLINE)
+                self.send_message(status)
+                self.finish()  # dies here: receives nothing, sends nothing
+
+        args_d = make_args("live1", role="client", rank=n,
+                           client_num_in_total=n, comm_round=2)
+        dead = DeadClient(args_d, rank=n, size=n + 1, backend="LOOPBACK")
+
+        threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+        threads.append(threading.Thread(target=dead.run, daemon=True))
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        result = server.run()
+        for c in clients:
+            c.manager.join(timeout=30)
+        assert server.manager.round_idx == 2
+        assert n in server.manager._dead
+        assert result is not None and result["test_acc"] > 0.4
+        for c in clients:
+            assert c.manager.done.is_set()
+
+    def test_offline_status_shrinks_expectation(self):
+        """A client that declares OFFLINE mid-training is not waited for."""
+        from fedml_tpu.core.distributed import FedMLCommManager, Message
+        from fedml_tpu.cross_silo.message_define import MyMessage
+
+        n = 3
+        args_s = make_args("live2", role="server", client_num_in_total=n,
+                           comm_round=2)
+        ds, od = data_mod.load(args_s)
+        bundle = model_mod.create(args_s, od)
+        server = FedMLCrossSiloServer(args_s, None, ds, bundle)
+
+        clients = []
+        for rank in range(1, n):
+            args_c = make_args("live2", role="client", rank=rank,
+                               client_num_in_total=n, comm_round=2)
+            clients.append(FedMLCrossSiloClient(args_c, None, ds, bundle))
+
+        class QuittingClient(FedMLCommManager):
+            """ONLINE, then OFFLINE on INIT (graceful mid-run departure)."""
+
+            def register_message_receive_handlers(self):
+                self.register_message_receive_handler(
+                    MyMessage.MSG_TYPE_CONNECTION_IS_READY, self._on_ready
+                )
+                self.register_message_receive_handler(
+                    MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self._on_init
+                )
+
+            def _on_ready(self, msg):
+                s = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
+                s.add(MyMessage.MSG_ARG_KEY_CLIENT_STATUS,
+                      MyMessage.CLIENT_STATUS_ONLINE)
+                self.send_message(s)
+
+            def _on_init(self, msg):
+                s = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
+                s.add(MyMessage.MSG_ARG_KEY_CLIENT_STATUS,
+                      MyMessage.CLIENT_STATUS_OFFLINE)
+                self.send_message(s)
+                self.finish()
+
+        args_q = make_args("live2", role="client", rank=n,
+                           client_num_in_total=n, comm_round=2)
+        quitter = QuittingClient(args_q, rank=n, size=n + 1, backend="LOOPBACK")
+
+        threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+        threads.append(threading.Thread(target=quitter.run, daemon=True))
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        result = server.run()
+        assert server.manager.round_idx == 2
+        assert result is not None
+
+
+class TestWireCompression:
+    """VERDICT next #8: per-client update compression in the C2S message with
+    error feedback (reference fedavg_seq + utils/compression.py hook)."""
+
+    @pytest.mark.parametrize("scheme", ["eftopk", "qsgd", "quantize"])
+    def test_compressed_fsm_converges(self, scheme):
+        from fedml_tpu.core.distributed.loopback import LoopbackCommManager
+
+        c2s_sizes = {}
+        orig = LoopbackCommManager.send_message
+
+        def spy(self, msg):
+            if msg.get_type() == "c2s_send_model_to_server":
+                c2s_sizes.setdefault(scheme, []).append(
+                    sum(a.nbytes for a in msg.get_arrays())
+                )
+            return orig(self, msg)
+
+        LoopbackCommManager.send_message = spy
+        try:
+            result, server, clients = run_world(
+                f"comp-{scheme}", compression=scheme, compression_ratio=0.1,
+            )
+        finally:
+            LoopbackCommManager.send_message = orig
+        baseline, *_ = run_world(f"comp-base-{scheme}")
+        assert result["test_acc"] > baseline["test_acc"] - 0.15, (
+            f"{scheme}: compressed acc {result['test_acc']} too far below "
+            f"uncompressed {baseline['test_acc']}"
+        )
+        # payload reduction >= 4x: uncompressed arrays are the full fp32
+        # param vector; eftopk@0.1 sends ~10% (values+int32 indices)
+        import jax
+
+        inline_bytes = sum(
+            np.asarray(l).nbytes
+            for l in jax.tree.leaves(server.manager.global_params)
+        )
+        if scheme == "eftopk":
+            assert max(c2s_sizes[scheme]) * 4 <= inline_bytes
+
+    def test_ef_residual_reinjects_dropped_mass(self):
+        """EF-TopK: mass dropped in round r re-surfaces in round r+1."""
+        import jax
+        import jax.numpy as jnp
+
+        from fedml_tpu.core.compression import UpdateCodec
+
+        class A: pass
+        a = A(); a.compression = "eftopk"; a.compression_ratio = 0.25
+        a.random_seed = 0
+        codec = UpdateCodec(a)
+        g = jnp.zeros(8)
+        v = jnp.asarray([5.0, 4.0, 0.5, 0.4, 0.3, 0.2, 0.1, 0.05])
+        arrays, meta = codec.encode(g, v, 0)
+        r1 = UpdateCodec.decode(g, arrays, meta)
+        # k=2: only the two largest survive round 1
+        assert float(r1[0]) == 5.0 and float(r1[1]) == 4.0
+        assert float(jnp.abs(r1[2:]).sum()) == 0.0
+        # round 2 with zero new delta: residual re-emits the next-largest
+        arrays2, meta2 = codec.encode(g, g, 1)
+        r2 = UpdateCodec.decode(g, arrays2, meta2)
+        assert float(r2[2]) == pytest.approx(0.5)
+        assert float(r2[3]) == pytest.approx(0.4)

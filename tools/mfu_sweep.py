@@ -1,0 +1,112 @@
+"""Sweep Cheetah single-chip configs for MFU — each config in a FRESH process.
+
+HBM on the axon chip is not reclaimed promptly across trainer rebuilds inside
+one process (dead state poisons later measurements), so the parent spawns one
+subprocess per config and reads a JSON line back.
+
+Usage:
+  python tools/mfu_sweep.py            # run the sweep matrix
+  python tools/mfu_sweep.py --one '{"n_heads": 8, ...}'   # child mode
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BASE = dict(
+    vocab_size=32000, d_model=1024, n_layers=24, n_heads=8, n_kv_heads=8,
+    d_ff=2816, max_seq_len=2048, remat=True, remat_policy="full",
+    attn_impl="flash", batch=8, seq=2048, steps=8, loss_chunk=256,
+    mu_bf16=False,
+)
+
+
+def run_one(cfg: dict) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fedml_tpu.parallel.sharding import make_mesh
+    from fedml_tpu.parallel.train_step import CheetahTrainer, make_optimizer
+    from fedml_tpu.parallel.transformer import TransformerConfig
+
+    B, L, steps = cfg.pop("batch"), cfg.pop("seq"), cfg.pop("steps")
+    loss_chunk = cfg.pop("loss_chunk")
+    mu_bf16 = cfg.pop("mu_bf16", False)
+    tc = TransformerConfig(**cfg)
+    mesh = make_mesh()
+    tr = CheetahTrainer(
+        tc, mesh,
+        optimizer=make_optimizer(
+            3e-4, warmup_steps=10, total_steps=100,
+            mu_dtype=jnp.bfloat16 if mu_bf16 else None,
+        ),
+        loss_chunk=loss_chunk,
+    )
+    state = tr.init_state(jax.random.PRNGKey(0))
+    n_params = sum(int(p.size) for p in jax.tree.leaves(state.params))
+    rng = np.random.RandomState(0)
+    tok = jnp.asarray(rng.randint(0, tc.vocab_size, (B, L)).astype(np.int32))
+    mask = jnp.ones((B, L), jnp.int32)
+    tok_d, mask_d = tr.shard_batch(tok, mask)
+    with mesh:
+        state, m = tr._step_jit(state, tok_d, mask_d)
+        float(np.asarray(m["loss"]))  # true sync (axon block_until_ready no-op)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = tr._step_jit(state, tok_d, mask_d)
+        float(np.asarray(m["loss"]))
+        dt = (time.perf_counter() - t0) / steps
+    fpt = 6.0 * n_params + 12.0 * L * tc.n_layers * tc.d_model
+    tps = B * L / dt
+    sys.path.insert(0, REPO)
+    from bench import TPU_PEAK_FLOPS
+
+    peak = TPU_PEAK_FLOPS.get(jax.devices()[0].device_kind, 197e12)
+    print(json.dumps({
+        "step_s": round(dt, 3), "tok_s": round(tps), "params_m": round(n_params / 1e6, 1),
+        "mfu": round(tps * fpt / peak, 4),
+    }))
+
+
+def main() -> None:
+    if len(sys.argv) > 2 and sys.argv[1] == "--one":
+        run_one(json.loads(sys.argv[2]))
+        return
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--matrix", default="")
+    ns = ap.parse_args()
+    if ns.matrix:
+        matrix = json.loads(ns.matrix)
+    else:
+        matrix = [
+            dict(remat_policy="dots"),
+            dict(remat_policy="dots", mu_bf16=True),
+            dict(remat_policy="dots", mu_bf16=True, n_heads=16, n_kv_heads=16),
+            dict(remat_policy="dots", mu_bf16=True, batch=4),
+            dict(remat_policy="dots", mu_bf16=True, batch=16),
+            dict(remat=False, mu_bf16=True),
+        ]
+    for delta in matrix:
+        cfg = {**BASE, **delta}
+        tag = json.dumps(delta) or "base"
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        p = subprocess.run(
+            [sys.executable, __file__, "--one", json.dumps(cfg)],
+            capture_output=True, text=True, timeout=900, env=env,
+        )
+        line = (p.stdout.strip().splitlines() or ["<no output>"])[-1]
+        err = (p.stderr.strip().splitlines() or [""])[-1] if p.returncode else ""
+        print(f"{tag:55s} {line} {err[:120]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
