@@ -139,8 +139,10 @@ def bench_cheetah() -> dict:
     mesh = make_mesh()  # all local devices on the data axis
     rng = np.random.RandomState(0)
 
+    import gc
+
     state = trainer = cfg = None
-    last_err = None
+    last_err = ""
     for rung in ladder:
         cfg = TransformerConfig(**{**base, **rung})
         trainer = CheetahTrainer(
@@ -159,8 +161,11 @@ def bench_cheetah() -> dict:
             _sync(metrics["loss"])
             break  # this rung compiles and fits
         except Exception as e:  # OOM at this rung: drop to more remat
-            last_err = e
-            state = None
+            # keep only the repr — the traceback would pin the OOMed
+            # trainer's buffers and poison the next rung's HBM headroom
+            last_err = f"{type(e).__name__}: {e}"[:500]
+            state = trainer = None
+            gc.collect()
     if state is None:
         raise RuntimeError(f"no cheetah config fit on this chip: {last_err}")
     n_params = sum(int(p.size) for p in jax.tree.leaves(state.params))

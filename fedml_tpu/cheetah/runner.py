@@ -66,14 +66,57 @@ class CheetahRunner:
         self.dataset = dataset
         self.checkpoint_dir = str(getattr(args, "checkpoint_dir", "") or "")
 
+    def _token_stream(self) -> Optional[np.ndarray]:
+        """The packed dataset's tokens as one contiguous stream, or None.
+
+        The data layer packs NWP datasets as [clients, cap, seq] int token
+        windows; pretraining doesn't care about client boundaries, so the
+        whole corpus flattens into a single stream that random seq_len
+        windows are drawn from. Token ids are clipped into the model's
+        vocab (a staged corpus may use a smaller alphabet — fine; a larger
+        one would silently alias, so clip and warn once).
+        """
+        ds = self.dataset
+        if ds is None or getattr(ds, "task", "") != "nwp":
+            return None
+        # only each client's REAL rows — the packed layout zero-pads beyond
+        # train_counts[c], and training on runs of pad token 0 poisons loss
+        tx = np.asarray(ds.train_x)
+        counts = np.asarray(ds.train_counts)
+        parts = [
+            tx[c, : int(counts[c])].reshape(-1)
+            for c in range(tx.shape[0])
+            if int(counts[c]) > 0
+        ]
+        if not parts:
+            return None
+        stream = np.concatenate(parts).astype(np.int32)
+        if stream.size < (self.seq_len + 1) * 2:
+            return None
+        vmax = int(stream.max())
+        if vmax >= self.cfg.vocab_size:
+            logger.warning(
+                "cheetah: corpus vocab %d exceeds model vocab %d; clipping",
+                vmax + 1, self.cfg.vocab_size,
+            )
+            stream = np.minimum(stream, self.cfg.vocab_size - 1)
+        return stream
+
     def _batches(self, rng: np.random.RandomState):
-        """Token batches from the dataset's packed stream or synthetic."""
+        """Token batches from the dataset's packed stream, else synthetic."""
         V = self.cfg.vocab_size
         shape = (self.batch_size, self.seq_len)
         if self.accum_steps > 1:
             shape = (self.accum_steps,) + shape
+        stream = self._token_stream()
+        if stream is None:
+            while True:
+                yield rng.randint(0, V, shape).astype(np.int32)
+        n_rows = int(np.prod(shape[:-1]))
         while True:
-            yield rng.randint(0, V, shape).astype(np.int32)
+            starts = rng.randint(0, stream.size - self.seq_len, size=n_rows)
+            rows = np.stack([stream[s:s + self.seq_len] for s in starts])
+            yield rows.reshape(shape)
 
     def run(self) -> dict:
         state = self.trainer.init_state(

@@ -96,10 +96,27 @@ class FedMLCommManager(Observer):
         from .payload_store import PAYLOAD_REF_KEY
 
         ref = msg.get(PAYLOAD_REF_KEY)
-        if ref and self.payload_store is not None:
-            # blobs are content-addressed and shared across recipients —
-            # never consumed on read; the sender's TTL sweep reclaims them
-            msg.set_arrays(self.payload_store.get(str(ref)))
+        if ref:
+            if self.payload_store is None:
+                # fail HERE, loudly — otherwise the handler sees an empty
+                # array list and dies far away in tree_unflatten
+                logger.error(
+                    "rank %d: message %r carries payload reference %r but "
+                    "this node has no payload_store_dir configured — "
+                    "dropping message", self.rank, msg_type, ref,
+                )
+                return
+            try:
+                # blobs are content-addressed and shared across recipients —
+                # never consumed on read; the sender's TTL sweep reclaims them
+                msg.set_arrays(self.payload_store.get(str(ref)))
+            except OSError as e:
+                logger.error(
+                    "rank %d: payload blob %r for %r is gone (%s) — likely "
+                    "TTL-swept before delivery; raise payload_ttl_seconds. "
+                    "Dropping message.", self.rank, ref, msg_type, e,
+                )
+                return
         handler = self.message_handler_dict.get(str(msg_type))
         if handler is None:
             logger.debug("rank %d: no handler for %r", self.rank, msg_type)

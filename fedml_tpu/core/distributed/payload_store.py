@@ -68,7 +68,14 @@ class PayloadStore:
         data = buf.getvalue()
         key = f"cas-{hashlib.sha256(data).hexdigest()}.npz"
         path = self._path(key)
-        if not os.path.exists(path):
+        if os.path.exists(path):
+            # refresh the TTL clock: a dedup hit on a near-expired blob must
+            # not leave an in-flight reference pointing at a sweep target
+            try:
+                os.utime(path, None)
+            except OSError:
+                pass
+        else:
             tmp = f"{path}.tmp-{uuid.uuid4().hex}"
             with open(tmp, "wb") as f:
                 f.write(data)

@@ -42,6 +42,7 @@ class FedMLServerManager(FedMLCommManager):
         self.client_num = self.size - 1
         self._online = set()
         self._dead = set()  # clients that went OFFLINE or timed out
+        self._offline_declared = set()  # explicit departures (never resync)
         self._models: Dict[int, tuple] = {}
         self._lock = threading.Lock()
         self._init_sent = False
@@ -94,6 +95,7 @@ class FedMLServerManager(FedMLCommManager):
                 # explicit departure (the MQTT last-will analog): stop
                 # waiting for this client from now on
                 self._dead.add(msg.get_sender_id())
+                self._offline_declared.add(msg.get_sender_id())
                 self._online.discard(msg.get_sender_id())
                 logger.warning(
                     "server: client %d went OFFLINE", msg.get_sender_id()
@@ -115,9 +117,13 @@ class FedMLServerManager(FedMLCommManager):
 
     def _round_complete_locked(self) -> bool:
         """Caller holds the lock. True when every still-live client of the
-        current round has reported."""
+        current round has reported. Models from clients that died AFTER
+        submitting don't count toward the live quorum — a healthy on-time
+        client must not have its round discarded because someone else both
+        contributed and left."""
+        live_models = sum(1 for s in self._models if s not in self._dead)
         expected = self.client_num - len(self._dead)
-        return len(self._models) >= max(expected, self.min_clients) > 0
+        return live_models >= max(expected, self.min_clients) > 0
 
     def _arm_round_timer(self) -> None:
         if self.round_timeout <= 0:
@@ -197,6 +203,9 @@ class FedMLServerManager(FedMLCommManager):
             if msg_round != self.round_idx:
                 return  # round closed between the unlocked check and here
             self._models[sender] = (n, params)
+            # a model from a previously-dropped client revives it — one
+            # missed deadline must not exclude a live client forever
+            self._dead.discard(sender)
             have_all = self._round_complete_locked()
         if have_all:
             self._finish_round()
@@ -252,8 +261,11 @@ class FedMLServerManager(FedMLCommManager):
         leaves = [np.asarray(l) for l in jax.tree.leaves(self.global_params)]
         if self.round_idx < self.round_num:
             for client_rank in range(1, self.size):
-                if client_rank in self._dead:
-                    continue  # dropped client; it rejoins via ONLINE status
+                # dropped clients still receive the sync (maybe the stall was
+                # transient); they rejoin the quorum when a model arrives.
+                # Clients that DECLARED OFFLINE have torn down — skip them.
+                if client_rank in self._offline_declared:
+                    continue
                 msg = Message(
                     MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank,
                     client_rank,

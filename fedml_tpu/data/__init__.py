@@ -26,6 +26,19 @@ logger = logging.getLogger(__name__)
 __all__ = ["load", "FedDataset", "REGISTRY", "DatasetSpec"]
 
 
+def _try_natural_partition(name: str, cache_dir: str, spec: DatasetSpec):
+    """LEAF-format on-disk loaders (None when files aren't staged)."""
+    if name == "femnist":
+        from .leaf import try_load_leaf_femnist
+
+        return try_load_leaf_femnist(cache_dir)
+    if name in ("shakespeare", "fed_shakespeare"):
+        from .leaf import try_load_leaf_shakespeare
+
+        return try_load_leaf_shakespeare(cache_dir, spec.seq_len)
+    return None
+
+
 def load(args) -> Tuple[FedDataset, int]:
     """Load + partition + pack a federated dataset per ``args``.
 
@@ -42,8 +55,45 @@ def load(args) -> Tuple[FedDataset, int]:
     client_num = int(getattr(args, "client_num_in_total", 0) or spec.default_clients)
     n_train = client_num * spec.train_per_client
     seed = int(getattr(args, "random_seed", 0))
+    cache_dir = getattr(args, "data_cache_dir", "./data_cache")
+
+    # LEAF datasets carry a NATURAL per-author partition when staged on disk
+    # (reference: data_loader.py dispatches femnist/shakespeare to LEAF JSON
+    # loaders) — use it and let the file define the client count
+    natural = _try_natural_partition(name, cache_dir, spec)
+    if natural is not None:
+        client_xs, client_ys, ex, ey = natural
+        tx = np.concatenate(client_xs)
+        ty = np.concatenate(client_ys)
+        idx_map, start = {}, 0
+        for cid, cx in enumerate(client_xs):
+            idx_map[cid] = np.arange(start, start + len(cx))
+            start += len(cx)
+        if int(getattr(args, "client_num_in_total", 0) or 0) not in (
+            0, len(client_xs),
+        ):
+            logger.warning(
+                "data: %s LEAF files define %d clients; overriding "
+                "client_num_in_total=%s", name, len(client_xs),
+                args.client_num_in_total,
+            )
+        args.client_num_in_total = len(client_xs)
+        x, y, counts = pack_partitions(tx, ty, idx_map)
+        ds = FedDataset(
+            train_x=x, train_y=y, train_counts=counts.astype(np.int32),
+            test_x=ex, test_y=ey, class_num=spec.class_num, task=spec.task,
+            meta={"vocab_size": spec.vocab_size, "seq_len": spec.seq_len,
+                  "name": name, "natural_partition": True},
+        )
+        ds = pad_cap_to_batch_multiple(ds, int(getattr(args, "batch_size", 32)))
+        logger.info(
+            "data: %s (LEAF) clients=%d cap=%d train=%d test=%d",
+            name, ds.client_num, ds.cap, ds.train_data_num, ds.test_data_num,
+        )
+        return ds, spec.class_num
+
     tx, ty, ex, ey = load_raw(
-        spec, getattr(args, "data_cache_dir", "./data_cache"), n_train, spec.test_total, seed
+        spec, cache_dir, n_train, spec.test_total, seed
     )
 
     # --- partition ---------------------------------------------------------

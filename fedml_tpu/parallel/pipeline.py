@@ -1,0 +1,336 @@
+"""GPipe pipeline parallelism over the ``pipeline`` mesh axis.
+
+New-capability work (SURVEY.md §2.5: the reference's only layer-split
+precedent is SplitNN, ``simulation/mpi/split_nn/``) — here a TPU-native
+schedule:
+
+- the transformer's blocks live as ONE stacked param tree ``[n_layers, ...]``
+  reshaped to ``[n_stages, layers_per_stage, ...]`` and sharded over the
+  ``pipeline`` mesh axis: each pipeline rank holds its stage's slice only
+- the whole schedule is a single ``shard_map`` program: a ``lax.scan`` over
+  ``M + S - 1`` ticks; every tick each stage applies its blocks and hands its
+  activation to the next stage over ICI with ``lax.ppermute``
+- backward needs no hand-written schedule: the transpose of ``ppermute`` is
+  the reverse rotation, so ``jax.grad`` through the scan IS the backward
+  pipeline (GPipe with rematerialised stages)
+- embedding / final norm / LM head are replicated across the pipeline axis
+  (stage 0 consumes the embedding, the last stage the head; replication keeps
+  the per-device program uniform, which SPMD requires)
+- the ``data`` mesh axis composes: microbatches are additionally sharded over
+  ``data`` and gradients psum over it — pp x dp in one program
+
+Bubble fraction is the GPipe (S-1)/(M+S-1); raise ``microbatches`` to
+amortise.
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map_raw
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_raw
+
+
+def shard_map(fn, mesh, in_specs, out_specs):
+    """shard_map across jax versions (check_rep/check_vma kwarg churn)."""
+    for kw in ({"check_vma": False}, {"check_rep": False}, {}):
+        try:
+            return _shard_map_raw(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+            )
+        except TypeError:
+            continue
+    raise RuntimeError("no compatible shard_map signature")
+
+from .. import constants
+from .transformer import (
+    Block,
+    TransformerConfig,
+    rms_norm,
+    rotary_embedding,
+)
+
+logger = logging.getLogger(__name__)
+
+PyTree = Any
+
+DATA = constants.MESH_AXIS_DATA
+PIPELINE = constants.MESH_AXIS_PIPELINE
+
+
+class PipelineCheetah:
+    """Pipeline-parallel trainer for the Cheetah transformer.
+
+    ``mesh`` must carry a ``pipeline`` axis of size S >= 2 (a ``data`` axis
+    composes; tensor/sequence inside a stage are future work) and
+    ``cfg.n_layers`` must divide evenly into S stages.
+    """
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        mesh: Mesh,
+        microbatches: int = 4,
+        optimizer: Optional[optax.GradientTransformation] = None,
+    ):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.n_stages = int(mesh.shape[PIPELINE])
+        if self.n_stages < 2:
+            raise ValueError("pipeline axis must have size >= 2")
+        if cfg.n_layers % self.n_stages:
+            raise ValueError(
+                f"n_layers {cfg.n_layers} not divisible by "
+                f"{self.n_stages} stages"
+            )
+        self.layers_per_stage = cfg.n_layers // self.n_stages
+        self.microbatches = int(microbatches)
+        self.block = Block(cfg)
+        self.opt = optimizer or optax.adamw(3e-4)
+        self._step = None
+
+    # -- params -------------------------------------------------------------
+    def init_params(self, rng: jax.Array) -> PyTree:
+        """{'embed', 'blocks' (stacked [n_layers, ...]), 'norm_f', 'head'}."""
+        cfg = self.cfg
+        k_embed, k_blocks, k_head = jax.random.split(rng, 3)
+        dummy = jnp.zeros((1, 8, cfg.d_model), cfg.dtype)
+        pos = jnp.arange(8)[None, :]
+        cos, sin = rotary_embedding(pos, cfg.head_dim, cfg.rope_theta)
+
+        def init_one(k):
+            variables = self.block.init(k, dummy, cos, sin)
+            return jax.tree.map(
+                lambda p: p.value if hasattr(p, "value") else p,
+                variables["params"],
+                is_leaf=lambda x: hasattr(x, "value"),
+            )
+
+        block_keys = jax.random.split(k_blocks, cfg.n_layers)
+        blocks = jax.jit(jax.vmap(init_one))(block_keys)
+        params = {
+            "embed": jax.random.normal(
+                k_embed, (cfg.vocab_size, cfg.d_model), cfg.param_dtype
+            ) * 0.02,
+            "blocks": blocks,
+            "norm_f": jnp.ones((cfg.d_model,), jnp.float32),
+            "head": jax.random.normal(
+                k_head, (cfg.d_model, cfg.vocab_size), cfg.param_dtype
+            ) * 0.02,
+        }
+        return jax.device_put(params, self.param_shardings())
+
+    def param_shardings(self) -> PyTree:
+        """blocks sharded over pipeline on the layer axis; rest replicated."""
+        repl = NamedSharding(self.mesh, P())
+        stage = NamedSharding(self.mesh, P(PIPELINE))
+        return {
+            "embed": repl,
+            "blocks": jax.tree.map(lambda _: stage, self._blocks_structure()),
+            "norm_f": repl,
+            "head": repl,
+        }
+
+    def _blocks_structure(self):
+        """Unboxed single-block param shapes (same treedef as one entry of
+        the stacked 'blocks' tree)."""
+        cfg = self.cfg
+        dummy = jnp.zeros((1, 8, cfg.d_model), cfg.dtype)
+        pos = jnp.arange(8)[None, :]
+        cos, sin = rotary_embedding(pos, cfg.head_dim, cfg.rope_theta)
+
+        def init_unboxed(k):
+            variables = self.block.init(k, dummy, cos, sin)
+            return jax.tree.map(
+                lambda p: p.value if hasattr(p, "value") else p,
+                variables["params"],
+                is_leaf=lambda x: hasattr(x, "value"),
+            )
+
+        return jax.eval_shape(init_unboxed, jax.random.PRNGKey(0))
+
+    # -- the pipelined program ----------------------------------------------
+    def _apply_stage(self, stage_blocks, x, cos, sin):
+        """Run this stage's layers_per_stage blocks (scan over the slice)."""
+
+        def body(h, layer_params):
+            unboxed = jax.tree.map(
+                lambda p: p.value if hasattr(p, "value") else p,
+                layer_params, is_leaf=lambda q: hasattr(q, "value"),
+            )
+            h = self.block.apply({"params": unboxed}, h, cos, sin)
+            return h, None
+
+        x, _ = jax.lax.scan(body, x, stage_blocks)
+        return x
+
+    def _loss_device(self, params, tokens, mask):
+        """Per-device GPipe loop. tokens [M, mb_local, L] (local slice)."""
+        cfg = self.cfg
+        S, M = self.n_stages, self.microbatches
+        stage = jax.lax.axis_index(PIPELINE)
+        Mb, L = tokens.shape[1], tokens.shape[2]
+        pos = jnp.arange(L)[None, :]
+        cos, sin = rotary_embedding(pos, cfg.head_dim, cfg.rope_theta)
+        # this device's stage slice: [layers_per_stage, ...] — under
+        # shard_map the leading n_layers axis arrives already sliced
+        stage_blocks = params["blocks"]
+
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        T = M + S - 1
+
+        def tick(buf, t):
+            # stage 0 embeds microbatch t (junk for t >= M; dropped later)
+            mb = jnp.take(
+                tokens, jnp.minimum(t, M - 1), axis=0
+            )  # [mb_local, L]
+            x0 = jnp.take(params["embed"], mb, axis=0).astype(cfg.dtype)
+            x_in = jnp.where(stage == 0, x0, buf)
+            y = self._apply_stage(stage_blocks, x_in, cos, sin)
+            buf_next = jax.lax.ppermute(y, PIPELINE, perm)
+            return buf_next, y
+
+        buf0 = jnp.zeros((Mb, L, cfg.d_model), cfg.dtype)
+        _, ys = jax.lax.scan(tick, buf0, jnp.arange(T))  # [T, mb, L, D]
+
+        # last stage's ticks S-1 .. T-1 hold microbatches 0..M-1
+        outs = jax.lax.dynamic_slice_in_dim(ys, S - 1, M, axis=0)
+        h = rms_norm(
+            outs, params["norm_f"].astype(jnp.float32), cfg.norm_eps
+        )
+        logits = jnp.einsum(
+            "mbld,dv->mblv", h, params["head"].astype(cfg.dtype)
+        ).astype(jnp.float32)
+        targets = tokens[:, :, 1:]
+        m = mask[:, :, 1:].astype(jnp.float32)
+        per = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :, :-1], targets
+        )
+        local_sum = (per * m).sum()
+        local_cnt = m.sum()
+        # only the final stage's logits are meaningful. The returned value is
+        # the LOCAL loss over the GLOBAL token count — never psum the
+        # numerator inside the differentiated function: psum's transpose is
+        # psum, so a psum'd numerator multiplies every gradient by the axis
+        # size. Callers psum the scalar afterwards for reporting.
+        is_last = (stage == S - 1).astype(jnp.float32)
+        cnt = jax.lax.psum(local_cnt * is_last, PIPELINE)
+        if DATA in self.mesh.axis_names and self.mesh.shape[DATA] > 1:
+            cnt = jax.lax.psum(cnt, DATA)
+        return local_sum * is_last / jnp.maximum(cnt, 1.0)
+
+    def _all_reduce_scalar(self, x):
+        x = jax.lax.psum(x, PIPELINE)
+        if DATA in self.mesh.axis_names and self.mesh.shape[DATA] > 1:
+            x = jax.lax.psum(x, DATA)
+        return x
+
+    def _train_step_device(self, params, opt_state, tokens, mask):
+        loss, grads = jax.value_and_grad(self._loss_device)(
+            params, tokens, mask
+        )
+        loss = self._all_reduce_scalar(loss)  # reporting only
+        # cross-stage grad flow rode the ppermute transpose; replicated
+        # params (embed/norm/head) need their grads summed across stages,
+        # and everything psums over data
+        def sync(path_is_blocks, g):
+            if not path_is_blocks:
+                g = jax.lax.psum(g, PIPELINE)
+            if DATA in self.mesh.axis_names and self.mesh.shape[DATA] > 1:
+                g = jax.lax.psum(g, DATA)
+            return g
+
+        grads = {
+            "embed": sync(False, grads["embed"]),
+            "blocks": jax.tree.map(partial(sync, True), grads["blocks"]),
+            "norm_f": sync(False, grads["norm_f"]),
+            "head": sync(False, grads["head"]),
+        }
+        updates, opt_state = self.opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    # -- public API ----------------------------------------------------------
+    def init_opt_state(self, params: PyTree) -> PyTree:
+        with self.mesh:
+            return jax.jit(self.opt.init)(params)
+
+    def _specs(self):
+        blocks_spec = jax.tree.map(
+            lambda _: P(PIPELINE), self._blocks_structure()
+        )
+        p_spec = {
+            "embed": P(), "blocks": blocks_spec, "norm_f": P(), "head": P(),
+        }
+        d_spec = P(None, DATA) if DATA in self.mesh.axis_names else P(None, None)
+        return p_spec, d_spec
+
+    def loss(self, params, tokens, mask) -> jax.Array:
+        """tokens/mask: [M, B, L] microbatched global arrays."""
+        p_spec, d_spec = self._specs()
+
+        def full_loss(params, tokens, mask):
+            return self._all_reduce_scalar(
+                self._loss_device(params, tokens, mask)
+            )
+
+        fn = shard_map(
+            full_loss, mesh=self.mesh,
+            in_specs=(p_spec, d_spec, d_spec), out_specs=P(),
+        )
+        with self.mesh:
+            return jax.jit(fn)(params, tokens, mask)
+
+    def train_step(self, params, opt_state, tokens, mask):
+        if self._step is None:
+            p_spec, d_spec = self._specs()
+            # opt state mirrors param sharding (adam moments have the
+            # params' shapes); match specs to leaves by shape
+            import jax.tree_util as jtu
+
+            def spec_like(tree):
+                p_flat, p_def = jtu.tree_flatten(params)
+                ps_flat, _ = jtu.tree_flatten(p_spec)
+                spec_by_shape = {}
+                for leaf, sp in zip(p_flat, ps_flat):
+                    spec_by_shape.setdefault(
+                        tuple(leaf.shape), sp
+                    )
+
+                def one(x):
+                    if hasattr(x, "shape") and tuple(x.shape) in spec_by_shape:
+                        return spec_by_shape[tuple(x.shape)]
+                    return P()
+
+                return jax.tree.map(one, tree)
+
+            o_spec = spec_like(opt_state)
+            fn = shard_map(
+                self._train_step_device, mesh=self.mesh,
+                in_specs=(p_spec, o_spec, d_spec, d_spec),
+                out_specs=(p_spec, o_spec, P()),
+            )
+            self._step = jax.jit(fn)
+        with self.mesh:
+            return self._step(params, opt_state, tokens, mask)
+
+
+def microbatch(tokens: np.ndarray, mask: np.ndarray, m: int):
+    """[B, L] -> [M, B/M, L]."""
+    B, L = tokens.shape
+    if B % m:
+        raise ValueError(f"batch {B} not divisible by microbatches {m}")
+    return (
+        tokens.reshape(m, B // m, L),
+        mask.reshape(m, B // m, L),
+    )

@@ -1,0 +1,139 @@
+"""Cheetah data path + LEAF readers (VERDICT next #9 / weak #8):
+the trainer must consume the data layer's packed token streams, and
+femnist/shakespeare must load real LEAF JSON when staged."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import fedml_tpu as fedml
+from fedml_tpu import data as data_mod
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.runner import FedMLRunner
+
+
+class TestCheetahRealTokens:
+    def test_loss_decreases_on_corpus_tokens(self):
+        """Markov-chain shakespeare tokens are learnable: the loss after
+        training must beat the first-step loss by a clear margin (random
+        tokens would stay at ~ln(V))."""
+        args = fedml.init(Arguments(overrides=dict(
+            training_type="distributed", dataset="shakespeare", model="transformer",
+            model_size="tiny", vocab_size=90, total_steps=30, batch_size=8,
+            seq_len=64, client_num_in_total=8, client_num_per_round=8,
+            learning_rate=3e-3,
+            warmup_steps=5,
+        )), should_init_logs=False)
+        ds, _ = data_mod.load(args)
+        runner = FedMLRunner(args, fedml.get_device(args), ds, None)
+        # the batch generator must draw from the corpus, not rng.randint
+        stream = runner.runner._token_stream()
+        assert stream is not None and stream.size > 1000
+        gen = runner.runner._batches(np.random.RandomState(0))
+        batch = next(gen)
+        assert batch.shape == (8, 64)
+        assert int(batch.max()) < 90
+        res = runner.run()
+        import math
+
+        assert res["final_loss"] < math.log(90) - 0.4, res
+
+    def test_synthetic_fallback_without_dataset(self):
+        args = fedml.init(Arguments(overrides=dict(
+            training_type="distributed", dataset="synthetic", model="transformer",
+            model_size="tiny", total_steps=2, batch_size=8, seq_len=32,
+        )), should_init_logs=False)
+        runner = FedMLRunner(args, fedml.get_device(args), None, None)
+        assert runner.runner._token_stream() is None
+        res = runner.run()
+        assert res["steps"] == 2
+
+
+def _write_leaf_shakespeare(root):
+    os.makedirs(os.path.join(root, "shakespeare", "train"))
+    os.makedirs(os.path.join(root, "shakespeare", "test"))
+    users = {}
+    for u in range(3):
+        text = ("the quick brown fox jumps over the lazy dog " * 20)
+        xs = [text[i:i + 80] for i in range(0, 400, 80)]
+        ys = [text[i + 80] for i in range(0, 400, 80)]
+        users[f"user{u}"] = {"x": xs, "y": ys}
+    blob = {
+        "users": list(users), "user_data": users,
+        "num_samples": [len(users[u]["x"]) for u in users],
+    }
+    with open(os.path.join(root, "shakespeare", "train", "all.json"), "w") as f:
+        json.dump(blob, f)
+    with open(os.path.join(root, "shakespeare", "test", "all.json"), "w") as f:
+        json.dump(blob, f)
+
+
+def _write_leaf_femnist(root):
+    os.makedirs(os.path.join(root, "femnist", "train"))
+    os.makedirs(os.path.join(root, "femnist", "test"))
+    rng = np.random.RandomState(0)
+    users = {}
+    for u in range(4):
+        n = 6 + u
+        users[f"w{u}"] = {
+            "x": rng.rand(n, 784).round(3).tolist(),
+            "y": rng.randint(0, 62, n).tolist(),
+        }
+    blob = {
+        "users": list(users), "user_data": users,
+        "num_samples": [len(users[u]["y"]) for u in users],
+    }
+    with open(os.path.join(root, "femnist", "train", "all.json"), "w") as f:
+        json.dump(blob, f)
+    with open(os.path.join(root, "femnist", "test", "all.json"), "w") as f:
+        json.dump(blob, f)
+
+
+class TestLeafReaders:
+    def test_shakespeare_leaf_roundtrip(self, tmp_path):
+        _write_leaf_shakespeare(str(tmp_path))
+        args = fedml.init(Arguments(overrides=dict(
+            dataset="shakespeare", data_cache_dir=str(tmp_path),
+            client_num_in_total=3, client_num_per_round=2, batch_size=4,
+        )), should_init_logs=False)
+        ds, class_num = data_mod.load(args)
+        assert class_num == 90
+        assert ds.client_num == 3  # LEAF users define the federation
+        assert ds.meta.get("natural_partition") is True
+        x, y, n = ds.client_shard(0)
+        assert n > 0 and x.shape[1] == 80
+        # per-position NWP targets: y is x shifted with the next char last
+        real = np.asarray(x[0], np.int32)
+        np.testing.assert_array_equal(np.asarray(y[0])[:-1], real[1:])
+
+    def test_femnist_leaf_natural_partition(self, tmp_path):
+        _write_leaf_femnist(str(tmp_path))
+        args = fedml.init(Arguments(overrides=dict(
+            dataset="femnist", data_cache_dir=str(tmp_path),
+            client_num_in_total=999, client_num_per_round=2, batch_size=4,
+        )), should_init_logs=False)
+        ds, class_num = data_mod.load(args)
+        assert class_num == 62
+        assert ds.client_num == 4
+        assert args.client_num_in_total == 4  # overridden by the files
+        counts = [ds.client_shard(c)[2] for c in range(4)]
+        assert counts == [6, 7, 8, 9]
+
+    def test_femnist_falls_back_synthetic(self, tmp_path):
+        args = fedml.init(Arguments(overrides=dict(
+            dataset="femnist", data_cache_dir=str(tmp_path),
+            client_num_in_total=5, client_num_per_round=2, batch_size=4,
+        )), should_init_logs=False)
+        ds, _ = data_mod.load(args)
+        assert ds.client_num == 5  # synthetic respects the args
+
+    def test_char_encoding_stable(self):
+        from fedml_tpu.data.leaf import ALL_LETTERS, encode_chars
+
+        assert len(ALL_LETTERS) == 80
+        enc = encode_chars("the", 5)
+        assert enc.shape == (5,)
+        assert enc[3] == enc[4] == 0  # padding
+        assert (enc[:3] > 0).all()
