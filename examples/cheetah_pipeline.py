@@ -1,0 +1,27 @@
+"""GPipe pipeline parallelism: 2 stages (needs >= 2 devices; on one host
+set XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.parallel.pipeline import PipelineCheetah, microbatch
+from fedml_tpu.parallel.sharding import make_mesh
+from fedml_tpu.parallel.transformer import TransformerConfig
+
+if len(jax.devices()) < 2:
+    raise SystemExit("need >= 2 devices for pipeline parallelism")
+
+cfg = TransformerConfig(vocab_size=256, d_model=128, n_layers=4, n_heads=4,
+                        n_kv_heads=4, d_ff=384, max_seq_len=64, remat=False)
+mesh = make_mesh({"pipeline": 2}, devices=jax.devices()[:2])
+pp = PipelineCheetah(cfg, mesh, microbatches=4, optimizer=optax.adamw(1e-3))
+params = pp.init_params(jax.random.PRNGKey(0))
+opt = pp.init_opt_state(params)
+rng = np.random.RandomState(0)
+tok = rng.randint(0, 256, (8, 64)).astype(np.int32)
+mt, mm = microbatch(tok, np.ones_like(tok), 4)
+for step in range(10):
+    params, opt, loss = pp.train_step(params, opt, jnp.asarray(mt), jnp.asarray(mm))
+    print(f"step {step}: loss={float(loss):.4f}")

@@ -1,0 +1,17 @@
+"""Cheetah: sharded LLM pretraining over a dp/fsdp/tp mesh. On a 1-chip
+host the mesh collapses to single-device; same program either way."""
+
+import fedml_tpu as fedml
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.runner import FedMLRunner
+
+args = fedml.init(Arguments(overrides=dict(
+    training_type="distributed", dataset="shakespeare", model="transformer",
+    model_size="tiny", vocab_size=90, total_steps=30, batch_size=8,
+    seq_len=64, client_num_in_total=8, client_num_per_round=8,
+    learning_rate=3e-3, warmup_steps=5,
+)), should_init_logs=False)
+from fedml_tpu import data as data_mod
+
+ds, _ = data_mod.load(args)
+print(FedMLRunner(args, fedml.get_device(args), ds, None).run())

@@ -1,0 +1,15 @@
+"""Serverless gossip FL: one mixing-matrix matmul per round."""
+
+import fedml_tpu as fedml
+from fedml_tpu import data as data_mod, models as model_mod
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.runner import FedMLRunner
+
+args = fedml.init(Arguments(overrides=dict(
+    dataset="synthetic", model="lr", federated_optimizer="decentralized_fl",
+    client_num_in_total=8, client_num_per_round=8, comm_round=6, epochs=1,
+    batch_size=16, learning_rate=0.1, topology="ring",
+)), should_init_logs=False)
+ds, od = data_mod.load(args)
+bundle = model_mod.create(args, od)
+print(FedMLRunner(args, fedml.get_device(args), ds, bundle).run())

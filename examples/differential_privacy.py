@@ -1,0 +1,18 @@
+"""Central and local DP on the same engine."""
+
+import fedml_tpu as fedml
+from fedml_tpu import data as data_mod, models as model_mod
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.runner import FedMLRunner
+
+for dp_type in ("cdp", "ldp"):
+    args = fedml.init(Arguments(overrides=dict(
+        dataset="synthetic", model="lr", client_num_in_total=16,
+        client_num_per_round=8, comm_round=5, epochs=1, batch_size=16,
+        learning_rate=0.1, enable_dp=True, dp_type=dp_type, epsilon=50.0,
+        delta=1e-5, clipping_norm=5.0, mechanism_type="gaussian",
+    )), should_init_logs=False)
+    ds, od = data_mod.load(args)
+    bundle = model_mod.create(args, od)
+    res = FedMLRunner(args, fedml.get_device(args), ds, bundle).run()
+    print(f"{dp_type} acc={res['test_acc']:.3f}")

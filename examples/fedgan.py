@@ -1,0 +1,16 @@
+"""Federated GAN: both nets averaged every round."""
+
+import fedml_tpu as fedml
+from fedml_tpu import data as data_mod
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.simulation.fedgan_api import FedGanAPI
+
+args = fedml.init(Arguments(overrides=dict(
+    dataset="synthetic", model="lr", federated_optimizer="FedGAN",
+    client_num_in_total=4, client_num_per_round=4, comm_round=8, epochs=3,
+    batch_size=16, learning_rate=2e-3,
+)), should_init_logs=False)
+ds, _ = data_mod.load(args)
+api = FedGanAPI(args, None, ds)
+print(api.train())
+print("samples:", api.sample(4).shape)

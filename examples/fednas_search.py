@@ -1,0 +1,16 @@
+"""Federated DARTS search: weights + alphas averaged every round."""
+
+import fedml_tpu as fedml
+from fedml_tpu import data as data_mod, models as model_mod
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.runner import FedMLRunner
+
+args = fedml.init(Arguments(overrides=dict(
+    dataset="synthetic", model="darts", federated_optimizer="FedNAS",
+    client_num_in_total=4, client_num_per_round=4, comm_round=6, epochs=2,
+    batch_size=16, learning_rate=0.05,
+)), should_init_logs=False)
+ds, od = data_mod.load(args)
+bundle = model_mod.create(args, od)
+res = FedMLRunner(args, fedml.get_device(args), ds, bundle).run()
+print("acc:", res["test_acc"], "genotype:", res["genotype"])

@@ -1,0 +1,15 @@
+"""Federated semantic segmentation with mIoU reporting."""
+
+import fedml_tpu as fedml
+from fedml_tpu import data as data_mod, models as model_mod
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.runner import FedMLRunner
+
+args = fedml.init(Arguments(overrides=dict(
+    dataset="pascal_voc", model="fcn", federated_optimizer="FedSeg",
+    client_num_in_total=4, client_num_per_round=4, comm_round=4, epochs=2,
+    batch_size=8, learning_rate=0.05,
+)), should_init_logs=False)
+ds, od = data_mod.load(args)
+bundle = model_mod.create(args, od)
+print(FedMLRunner(args, fedml.get_device(args), ds, bundle).run())

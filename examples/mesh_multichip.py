@@ -1,0 +1,16 @@
+"""Cohort sharded over every local chip (`clients` mesh axis)."""
+
+import fedml_tpu as fedml
+from fedml_tpu import data as data_mod, models as model_mod
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.runner import FedMLRunner
+
+args = fedml.init(Arguments(overrides=dict(
+    training_type="simulation", backend="mesh", dataset="synthetic",
+    model="cnn" if False else "lr", client_num_in_total=16,
+    client_num_per_round=8, comm_round=5, epochs=1, batch_size=16,
+    learning_rate=0.1,
+)), should_init_logs=False)
+ds, od = data_mod.load(args)
+bundle = model_mod.create(args, od)
+print(FedMLRunner(args, fedml.get_device(args), ds, bundle).run())

@@ -1,0 +1,17 @@
+"""The whole FedAvg optimizer family on the fused sp engine."""
+
+import fedml_tpu as fedml
+from fedml_tpu import data as data_mod, models as model_mod
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.runner import FedMLRunner
+
+for opt in ("FedAvg", "FedProx", "FedOpt", "FedNova", "SCAFFOLD", "FedSGD"):
+    args = fedml.init(Arguments(overrides=dict(
+        dataset="synthetic", model="lr", federated_optimizer=opt,
+        client_num_in_total=16, client_num_per_round=8, comm_round=5,
+        epochs=1, batch_size=16, learning_rate=0.1,
+    )), should_init_logs=False)
+    ds, od = data_mod.load(args)
+    bundle = model_mod.create(args, od)
+    res = FedMLRunner(args, fedml.get_device(args), ds, bundle).run()
+    print(f"{opt:10s} acc={res['test_acc']:.3f}")

@@ -239,6 +239,10 @@ def add_args() -> argparse.Namespace:
     parser.add_argument("--local_rank", type=int, default=0)
     parser.add_argument("--node_rank", type=int, default=0)
     parser.add_argument("--role", type=str, default="client")
+    parser.add_argument(
+        "--silo_device_indices", type=int, nargs="*", default=None,
+        help="chips this silo trains over (intra-silo data parallelism)",
+    )
     args, _ = parser.parse_known_args()
     return args
 

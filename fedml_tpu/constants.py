@@ -48,6 +48,7 @@ FEDML_FEDERATED_OPTIMIZER_MIME = "Mime"
 FEDML_FEDERATED_OPTIMIZER_FEDGAN = "FedGAN"
 FEDML_FEDERATED_OPTIMIZER_FEDGKT = "FedGKT"
 FEDML_FEDERATED_OPTIMIZER_FEDNAS = "FedNAS"
+FEDML_FEDERATED_OPTIMIZER_FEDSEG = "FedSeg"
 FEDML_FEDERATED_OPTIMIZER_SPLIT_NN = "SplitNN"
 FEDML_FEDERATED_OPTIMIZER_VFL = "vertical_fl"
 FEDML_FEDERATED_OPTIMIZER_DECENTRALIZED_FL = "decentralized_fl"

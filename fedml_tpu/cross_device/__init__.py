@@ -12,9 +12,12 @@ leaf files (documented compatibility surface replacing ``.mnn``).
 """
 
 from .server import ServerMNN, read_artifact_as_tensor_dict, write_tensor_dict_to_artifact
+from .server_lsa import DeviceLSA, ServerMNNLSA
 
 __all__ = [
     "ServerMNN",
+    "ServerMNNLSA",
+    "DeviceLSA",
     "read_artifact_as_tensor_dict",
     "write_tensor_dict_to_artifact",
 ]

@@ -1,0 +1,201 @@
+"""Cross-device LightSecAgg over model-artifact files.
+
+reference: ``cross_device/server_mnn_lsa/`` (859 LoC — the BeeHive artifact
+server + LightSecAgg: devices upload MASKED models; the server reconstructs
+only the aggregate). Artifact analog of the MQTT+S3 transport, mirroring
+``cross_silo/lightsecagg``'s math (one shared ``core/mpc/lightsecagg``
+kernel set):
+
+round phases, all files under ``upload_dir``:
+
+1. server publishes the global model (``ServerMNN.publish_global_model``)
+2. every device writes its LCC-encoded mask shares:  ``shares_{d}.npz``
+   holding rows for ALL peers (the reference routes shares through the
+   server/broker as opaque payloads — a shared directory is the same trust
+   model: shares are field-random without T+1 collusion)
+3. surviving devices write masked quantized models: ``masked_{d}.npz``
+4. after the server names the survivor set (``survivors.json``), each
+   surviving device sums the share-rows addressed to it from survivors and
+   writes ``aggshare_{d}.npz``
+5. the server field-sums the masked models, LCC-decodes Σz from any U
+   aggregate shares, unmasks, dequantizes → the average — individual
+   updates are never visible to anyone.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.mpc import lightsecagg as lsa
+from ..utils.tree import tree_flatten_to_vector, tree_unflatten_from_vector
+from .server import ServerMNN
+
+logger = logging.getLogger(__name__)
+
+
+class DeviceLSA:
+    """The device side of the artifact LSA flow (reference: the MNN device's
+    LightSecAgg client; here it doubles as the test/demo harness)."""
+
+    def __init__(self, device_id: int, upload_dir: str, N: int, U: int, T: int,
+                 q_bits: int = 8, seed: int = 0):
+        self.d_id = int(device_id)
+        self.dir = upload_dir
+        self.N, self.U, self.T = N, U, T
+        self.q_bits = q_bits
+        self.rng = np.random.RandomState(1000 + seed * 131 + device_id)
+        self._z: Optional[np.ndarray] = None
+
+    def write_shares(self, dim: int) -> None:
+        """Phase 2: generate mask, encode, publish the share rows."""
+        self._z, shares = lsa.mask_encoding(
+            dim, self.N, self.U, self.T, self.rng
+        )
+        np.savez(os.path.join(self.dir, f"shares_{self.d_id}.npz"),
+                 shares=shares)
+
+    def write_masked_model(self, vec: np.ndarray, n_samples: float) -> None:
+        """Phase 3: upload (quantized model + z) mod p."""
+        q = np.asarray(lsa.quantize_to_field(vec, self.q_bits))
+        masked = np.asarray(lsa.model_masking(
+            jnp.asarray(q, jnp.int32), jnp.asarray(self._z, jnp.int32)
+        ))
+        np.savez(os.path.join(self.dir, f"masked_{self.d_id}.npz"),
+                 masked=masked, n=np.asarray([n_samples]))
+
+    def write_aggregate_share(self, survivors: List[int]) -> None:
+        """Phase 4: sum the rows addressed to me from surviving peers."""
+        rows = []
+        for s in survivors:
+            with np.load(os.path.join(self.dir, f"shares_{s}.npz")) as z:
+                rows.append(z["shares"][self.d_id])
+        agg = lsa.aggregate_shares(rows)
+        np.savez(os.path.join(self.dir, f"aggshare_{self.d_id}.npz"), agg=agg)
+
+
+class ServerMNNLSA(ServerMNN):
+    """Artifact FL server that only ever sees masked models.
+
+    ``args``: ``lsa_privacy_guarantee`` (T), ``lsa_surviving_threshold`` (U,
+    default N-1), ``lsa_quantize_bits``.
+    """
+
+    def __init__(self, args, device, dataset, model, server_aggregator=None):
+        super().__init__(args, device, dataset, model, server_aggregator)
+        self.N = int(getattr(args, "client_num_in_total", 1))
+        self.T = int(getattr(args, "lsa_privacy_guarantee", 1))
+        self.U = int(getattr(args, "lsa_surviving_threshold", 0)) or max(
+            self.T + 1, self.N - 1
+        )
+        # q_bits must leave headroom in the 2**15-19 field: values scale
+        # by 2**q_bits and N of them sum before unmasking
+        self.q_bits = int(getattr(args, "lsa_quantize_bits", 8))
+        vec, self._treedef, self._shapes = tree_flatten_to_vector(
+            self.global_params
+        )
+        self._dim = int(vec.shape[0])
+
+    # -- round phases --------------------------------------------------------
+    def list_masked_uploads(self) -> Dict[int, np.ndarray]:
+        out = {}
+        if not os.path.isdir(self.upload_dir):
+            return out
+        for fn in sorted(os.listdir(self.upload_dir)):
+            if fn.startswith("masked_") and fn.endswith(".npz"):
+                d_id = int(fn[len("masked_"):-len(".npz")])
+                with np.load(os.path.join(self.upload_dir, fn)) as z:
+                    out[d_id] = z["masked"].astype(np.int64)
+        return out
+
+    def publish_survivors(self, survivors: List[int]) -> None:
+        with open(os.path.join(self.upload_dir, "survivors.json"), "w") as f:
+            json.dump(sorted(survivors), f)
+
+    def reconstruct(self, masked: Dict[int, np.ndarray]) -> np.ndarray:
+        """Field-sum survivors' masked models, decode Σz, unmask, dequantize."""
+        survivors = sorted(masked)
+        masked_sum = np.zeros(self._dim, np.int64)
+        for d_id in survivors:
+            masked_sum = (masked_sum + masked[d_id]) % lsa.FIELD_P
+        # any U survivors' aggregate shares suffice
+        agg_shares, points = [], []
+        for d_id in survivors:
+            path = os.path.join(self.upload_dir, f"aggshare_{d_id}.npz")
+            if not os.path.exists(path):
+                continue
+            with np.load(path) as z:
+                agg_shares.append(z["agg"].astype(np.int64))
+            points.append(d_id + 1)  # α_j = device index + 1
+            if len(agg_shares) == self.U:
+                break
+        if len(agg_shares) < self.U:
+            raise RuntimeError(
+                f"LSA needs {self.U} aggregate shares, got {len(agg_shares)}"
+            )
+        mask_sum = lsa.decode_aggregate_mask(
+            agg_shares, points, self._dim, self.N, self.U, self.T
+        )
+        clear = np.asarray(lsa.model_unmasking(
+            jnp.asarray(masked_sum % lsa.FIELD_P, jnp.int32),
+            jnp.asarray(mask_sum % lsa.FIELD_P, jnp.int32),
+        ))
+        return lsa.dequantize_from_field(clear, self.q_bits) / max(
+            len(survivors), 1
+        )
+
+    def run_one_round(self) -> Optional[dict]:
+        """Two poll phases, like the broker flow: (a) enough masked uploads →
+        name the survivor set and wait for aggregate shares; (b) U aggregate
+        shares present → reconstruct and advance the round."""
+        masked = self.list_masked_uploads()
+        if len(masked) < max(self.U, 1):
+            logger.info(
+                "cross_device lsa: %d/%d masked uploads — waiting",
+                len(masked), self.U,
+            )
+            return None
+        survivors_file = os.path.join(self.upload_dir, "survivors.json")
+        if not os.path.exists(survivors_file):
+            self.publish_survivors(sorted(masked))
+            return None  # devices now compute their aggregate shares
+        n_agg = sum(
+            1 for fn in os.listdir(self.upload_dir)
+            if fn.startswith("aggshare_")
+        )
+        if n_agg < self.U:
+            logger.info(
+                "cross_device lsa: %d/%d aggregate shares — waiting",
+                n_agg, self.U,
+            )
+            return None
+        avg = self.reconstruct(masked)
+        self.global_params = tree_unflatten_from_vector(
+            jnp.asarray(avg, jnp.float32), self._treedef, self._shapes
+        )
+        self.aggregator.set_model_params(self.global_params)
+        self.publish_global_model()
+        self.round_idx += 1
+        # consume the round's artifacts
+        for fn in os.listdir(self.upload_dir):
+            if fn.startswith(("masked_", "aggshare_", "shares_")) or (
+                fn == "survivors.json"
+            ):
+                try:
+                    os.remove(os.path.join(self.upload_dir, fn))
+                except OSError:
+                    pass
+        if self.ds is not None:
+            self.final_metrics = self.evaluate(
+                self.global_params, self.ds.test_x, self.ds.test_y
+            )
+            logger.info(
+                "cross_device lsa round %d: acc=%.4f", self.round_idx,
+                self.final_metrics["test_acc"],
+            )
+        return self.final_metrics

@@ -125,14 +125,19 @@ class FedMLCrossSiloClient:
 
 
 def run_server(**overrides):
-    """One-line server launcher (reference: launch_cross_silo_horizontal.py:7)."""
+    """One-line server launcher (reference: launch_cross_silo_horizontal.py:7).
+
+    Parses the CLI (``--cf config.yaml --rank 0 --role server``) like the
+    simulation launcher, then applies keyword overrides on top.
+    """
     import fedml_tpu as fedml
     from .. import data as data_mod
     from .. import models as model_mod
-    from ..arguments import Arguments
+    from ..arguments import add_args, Arguments
 
     args = fedml.init(
-        Arguments(training_type=constants.FEDML_TRAINING_PLATFORM_CROSS_SILO,
+        Arguments(add_args(),
+                  training_type=constants.FEDML_TRAINING_PLATFORM_CROSS_SILO,
                   overrides={**overrides, "role": "server"})
     )
     device = fedml.get_device(args)
@@ -146,11 +151,12 @@ def run_client(**overrides):
     import fedml_tpu as fedml
     from .. import data as data_mod
     from .. import models as model_mod
-    from ..arguments import Arguments
+    from ..arguments import add_args, Arguments
 
     args = fedml.init(
-        Arguments(training_type=constants.FEDML_TRAINING_PLATFORM_CROSS_SILO,
-                  overrides={**overrides, "role": "client"})
+        Arguments(add_args(),
+                  training_type=constants.FEDML_TRAINING_PLATFORM_CROSS_SILO,
+                  overrides={"role": "client", **overrides})
     )
     device = fedml.get_device(args)
     dataset, output_dim = data_mod.load(args)

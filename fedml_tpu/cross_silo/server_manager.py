@@ -91,6 +91,7 @@ class FedMLServerManager(FedMLCommManager):
             if status == MyMessage.CLIENT_STATUS_ONLINE:
                 self._online.add(msg.get_sender_id())
                 self._dead.discard(msg.get_sender_id())
+                self._offline_declared.discard(msg.get_sender_id())
             elif status == MyMessage.CLIENT_STATUS_OFFLINE:
                 # explicit departure (the MQTT last-will analog): stop
                 # waiting for this client from now on
@@ -206,6 +207,7 @@ class FedMLServerManager(FedMLCommManager):
             # a model from a previously-dropped client revives it — one
             # missed deadline must not exclude a live client forever
             self._dead.discard(sender)
+            self._offline_declared.discard(sender)
             have_all = self._round_complete_locked()
         if have_all:
             self._finish_round()

@@ -63,6 +63,20 @@ def load(args) -> Tuple[FedDataset, int]:
     natural = _try_natural_partition(name, cache_dir, spec)
     if natural is not None:
         client_xs, client_ys, ex, ey = natural
+        # real LEAF partitions are heavily skewed; the packed layout's cap is
+        # the LARGEST client, so bound per-client samples or the dense
+        # [clients, cap, ...] array explodes (shakespeare: some authors have
+        # tens of thousands of windows)
+        max_per = int(getattr(args, "leaf_max_samples_per_client", 2048))
+        capped = sum(1 for cx in client_xs if len(cx) > max_per)
+        if capped:
+            logger.warning(
+                "data: %s — subsampling %d/%d LEAF clients to "
+                "leaf_max_samples_per_client=%d (packed cap bound)",
+                name, capped, len(client_xs), max_per,
+            )
+            client_xs = [cx[:max_per] for cx in client_xs]
+            client_ys = [cy[:max_per] for cy in client_ys]
         tx = np.concatenate(client_xs)
         ty = np.concatenate(client_ys)
         idx_map, start = {}, 0

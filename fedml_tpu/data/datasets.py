@@ -73,6 +73,15 @@ REGISTRY = {
     "stackoverflow_lr": DatasetSpec(
         "stackoverflow_lr", (10000,), 500, "tagpred", 200, 30, 400
     ),
+    # semantic segmentation (reference: simulation/mpi/fedseg — pascal_voc /
+    # cityscapes loaders at data/{pascal_voc_augmented,cityscapes}/); synthetic
+    # fallback keeps the per-pixel label geometry at toy resolution
+    "pascal_voc": DatasetSpec(
+        "pascal_voc", (32, 32, 3), 21, "segmentation", 20, 40, 200
+    ),
+    "cityscapes": DatasetSpec(
+        "cityscapes", (32, 32, 3), 19, "segmentation", 20, 40, 200
+    ),
     # adversarial-FL fixture (reference: data/edge_case_examples) — plain
     # CIFAR-10 shapes; poisoning is applied by the attack layer, not the data.
     "edge_case_examples": DatasetSpec(
@@ -244,6 +253,31 @@ def synth_nwp(spec: DatasetSpec, n_train: int, n_test: int, seed: int):
     return tx, shift(tx), ex, shift(ex)
 
 
+def synth_segmentation(spec: DatasetSpec, n_train: int, n_test: int, seed: int):
+    """Images of colored rectangles; labels = class id per pixel (background
+    0). Learnable: each class has a distinct mean color."""
+    rng = np.random.RandomState(seed)
+    H, W, _ = spec.sample_shape
+    C = spec.class_num
+    protos = rng.rand(C, 3).astype(np.float32) * 2 - 1
+
+    def make(n, rng):
+        x = rng.randn(n, H, W, 3).astype(np.float32) * 0.3
+        y = np.zeros((n, H, W), np.int32)
+        for i in range(n):
+            for _ in range(rng.randint(1, 4)):
+                c = rng.randint(1, C)
+                h0, w0 = rng.randint(0, H - 8), rng.randint(0, W - 8)
+                dh, dw = rng.randint(6, 14), rng.randint(6, 14)
+                y[i, h0:h0 + dh, w0:w0 + dw] = c
+                x[i, h0:h0 + dh, w0:w0 + dw] += protos[c]
+        return x, y
+
+    tx, ty = make(n_train, rng)
+    ex, ey = make(n_test, rng)
+    return tx, ty, ex, ey
+
+
 def load_raw(spec: DatasetSpec, cache_dir: str, n_train: int, n_test: int, seed: int):
     """Real data if cached on disk, else synthetic with identical shapes."""
     if spec.name == "mnist":
@@ -261,4 +295,6 @@ def load_raw(spec: DatasetSpec, cache_dir: str, n_train: int, n_test: int, seed:
         return synth_classification(spec, n_train, n_test, seed)
     if spec.task == "tagpred":
         return synth_tagpred(spec, n_train, n_test, seed)
+    if spec.task == "segmentation":
+        return synth_segmentation(spec, n_train, n_test, seed)
     return synth_nwp(spec, n_train, n_test, seed)

@@ -53,10 +53,29 @@ def tagpred_loss(logits, y, sample_mask) -> Tuple[jnp.ndarray, Metrics]:
     return loss, {"loss_sum": per * sample_mask, "correct": correct, "count": sample_mask.sum()}
 
 
+def segmentation_loss(logits, y, sample_mask) -> Tuple[jnp.ndarray, Metrics]:
+    """Per-pixel CE. logits [B, H, W, C], y [B, H, W] int labels.
+
+    reference: ``simulation/mpi/fedseg/utils.py`` SegmentationLosses (CE mode)
+    + pixel-accuracy Evaluator; mIoU is computed by the FedSeg eval pass.
+    """
+    per_px = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+    px_mask = sample_mask[:, None, None] * jnp.ones_like(per_px)
+    denom = jnp.maximum(px_mask.sum(), 1.0)
+    loss = (per_px * px_mask).sum() / denom
+    correct = ((jnp.argmax(logits, -1) == y) * px_mask).sum()
+    return loss, {
+        "loss_sum": (per_px * px_mask).sum((1, 2)),
+        "correct": correct,
+        "count": px_mask.sum(),
+    }
+
+
 LOSSES = {
     "classification": classification_loss,
     "nwp": nwp_loss,
     "tagpred": tagpred_loss,
+    "segmentation": segmentation_loss,
 }
 
 

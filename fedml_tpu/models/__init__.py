@@ -24,9 +24,11 @@ from .vision import (
     VGG11_CFG,
     VGG16_CFG,
     CNNDropOut,
+    EfficientNetB0,
     LogisticRegression,
     MobileNetV1,
     MobileNetV2,
+    MobileNetV3Small,
     resnet18_gn,
     resnet20,
     resnet56,
@@ -70,7 +72,8 @@ def create(args, output_dim: int) -> ModelBundle:
 
     Name registry follows the reference's dispatch (model_hub.py:20-83):
     lr, cnn (CNN_DropOut), resnet18_gn, resnet20, resnet56, mobilenet,
-    mobilenet_v2, vgg11/vgg16, rnn (dataset-routed), mlp.
+    mobilenet_v2, mobilenet_v3, efficientnet, vgg11/vgg16, rnn
+    (dataset-routed), mlp, fcn/deeplab (segmentation), darts (NAS search).
     """
     name = str(args.model).lower()
     dataset = getattr(args, "dataset", "synthetic")
@@ -105,6 +108,18 @@ def create(args, output_dim: int) -> ModelBundle:
             module = RNNOriginalFedAvg(vocab_size=output_dim)
     elif name == "mlp":
         module = MLP((128, 64, output_dim))
+    elif name in ("efficientnet", "efficientnet_b0", "efficientnet-b0"):
+        module = EfficientNetB0(output_dim)
+    elif name in ("mobilenet_v3", "mobilenet_v3_small"):
+        module = MobileNetV3Small(output_dim)
+    elif name in ("fcn", "deeplab", "deeplabv3_plus", "unet"):
+        from .segmentation import FCNSeg
+
+        module = FCNSeg(output_dim)
+    elif name in ("darts", "darts_search"):
+        from .darts import DartsNetwork
+
+        module = DartsNetwork(output_dim)
     else:
         raise ValueError(f"unknown model {name!r}")
 

@@ -1,0 +1,103 @@
+"""DARTS search network: mixed ops weighted by architecture parameters.
+
+reference: ``model/cv/darts/`` (model_search.py — MixedOp over PRIMITIVES,
+softmax over alphas; architect.py — the bilevel arch step). TPU-native
+re-design: the cell is a fixed DAG of mixed ops whose branch outputs are a
+single stacked tensor contracted with softmax(alpha) — one einsum instead of
+a Python sum over op modules, so the whole search net stays one fused XLA
+program under vmap over clients.
+
+Architecture parameters live in the regular param tree under ``alpha_*`` —
+``split_arch_params`` partitions them out for FedNAS's separate averaging
+(reference FedNASAggregator averages weights AND alphas).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+PyTree = Any
+
+# op primitives (vector-data analog of the reference's conv PRIMITIVES)
+N_OPS = 4  # [zero, identity, relu-dense, tanh-dense]
+
+
+class MixedLayer(nn.Module):
+    """All primitives computed, stacked, contracted with softmax(alpha)."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, x, alpha):
+        d_in = x.shape[-1]
+        proj = (
+            x if d_in == self.features
+            else nn.Dense(self.features, use_bias=False, name="proj")(x)
+        )
+        branches = jnp.stack(
+            [
+                jnp.zeros_like(proj),                       # zero
+                proj,                                        # identity
+                nn.relu(nn.Dense(self.features)(x)),         # relu-dense
+                jnp.tanh(nn.Dense(self.features)(x)),        # tanh-dense
+            ],
+            axis=0,
+        )  # [N_OPS, B, F]
+        w = jax.nn.softmax(alpha)
+        return jnp.einsum("o,obf->bf", w, branches)
+
+
+class DartsNetwork(nn.Module):
+    """A stack of mixed layers + classifier head.
+
+    Flattens any input shape; alphas are params ``alpha_0..alpha_{L-1}``.
+    """
+
+    num_classes: int
+    n_layers: int = 3
+    features: int = 64
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = x.reshape((x.shape[0], -1))
+        for i in range(self.n_layers):
+            alpha = self.param(
+                f"alpha_{i}", nn.initializers.zeros, (N_OPS,), jnp.float32
+            )
+            h = MixedLayer(self.features, name=f"mixed_{i}")(h, alpha)
+        return nn.Dense(self.num_classes, name="head")(h)
+
+
+def is_arch_param(path: Tuple) -> bool:
+    return any(
+        str(getattr(k, "key", k)).startswith("alpha_") for k in path
+    )
+
+
+def split_arch_params(params: PyTree) -> Tuple[PyTree, PyTree]:
+    """-> (weights-with-zeroed-alphas mask, alphas mask) as boolean trees."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    return (
+        jax.tree_util.tree_map_with_path(
+            lambda p, x: not is_arch_param(p), params
+        ),
+        jax.tree_util.tree_map_with_path(is_arch_param, params),
+    )
+
+
+def genotype(params: PyTree) -> dict:
+    """Discretize: argmax op per layer (reference model_search.genotype)."""
+    out = {}
+
+    def visit(path, leaf):
+        if is_arch_param(path):
+            name = "/".join(str(getattr(k, "key", k)) for k in path)
+            out[name] = int(jnp.argmax(leaf))
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, params)
+    return out

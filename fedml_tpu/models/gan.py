@@ -1,0 +1,43 @@
+"""Federated GAN model pair: generator + discriminator.
+
+reference: ``simulation/mpi/fedgan/`` trains a vanilla GAN per client
+(FedGANTrainer.py: BCE adversarial losses, alternating D/G steps) and
+averages both nets. The modules here are dataset-shape-agnostic: they
+generate/score flattened samples, so one pair serves every registered
+dataset (images flatten; the API reshapes on the way out).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class Generator(nn.Module):
+    """z [B, z_dim] -> samples [B, *sample_shape] in tanh range."""
+
+    sample_shape: tuple
+    hidden: int = 256
+
+    @nn.compact
+    def __call__(self, z, train: bool = False):
+        d = int(np.prod(self.sample_shape))
+        h = nn.relu(nn.Dense(self.hidden)(z))
+        h = nn.relu(nn.Dense(self.hidden)(h))
+        out = jnp.tanh(nn.Dense(d)(h)) * 3.0  # cover the data range
+        return out.reshape((z.shape[0],) + tuple(self.sample_shape))
+
+
+class Discriminator(nn.Module):
+    """samples [B, *shape] -> real/fake logit [B]."""
+
+    hidden: int = 256
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = x.reshape((x.shape[0], -1))
+        h = nn.leaky_relu(nn.Dense(self.hidden)(h), 0.2)
+        h = nn.leaky_relu(nn.Dense(self.hidden)(h), 0.2)
+        return nn.Dense(1)(h)[:, 0]

@@ -226,3 +226,142 @@ class VGG(nn.Module):
 VGG11_CFG = (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M")
 VGG16_CFG = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
              512, 512, 512, "M", 512, 512, 512, "M")
+
+
+class SqueezeExcite(nn.Module):
+    """SE attention over channels (ratio wrt the block's input width)."""
+
+    reduced: int
+
+    @nn.compact
+    def __call__(self, x):
+        s = jnp.mean(x, axis=(1, 2))
+        s = nn.relu(nn.Dense(self.reduced)(s))
+        s = nn.sigmoid(nn.Dense(x.shape[-1])(s))
+        return x * s[:, None, None, :]
+
+
+def hard_swish(x):
+    return x * nn.relu6(x + 3.0) / 6.0
+
+
+class MBConv(nn.Module):
+    """EfficientNet MBConv: expand → depthwise → SE → project (+residual)."""
+
+    filters: int
+    strides: int
+    expand: int
+    kernel: int = 3
+    se_ratio: float = 0.25
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        hidden = in_ch * self.expand
+        y = x
+        if self.expand != 1:
+            y = nn.Conv(hidden, (1, 1), use_bias=False)(y)
+            y = group_norm(hidden)(y)
+            y = nn.swish(y)
+        y = nn.Conv(hidden, (self.kernel, self.kernel),
+                    strides=(self.strides, self.strides), padding="SAME",
+                    feature_group_count=hidden, use_bias=False)(y)
+        y = group_norm(hidden)(y)
+        y = nn.swish(y)
+        y = SqueezeExcite(max(1, int(in_ch * self.se_ratio)))(y)
+        y = nn.Conv(self.filters, (1, 1), use_bias=False)(y)
+        y = group_norm(self.filters)(y)
+        if self.strides == 1 and in_ch == self.filters:
+            y = y + x
+        return y
+
+
+class EfficientNetB0(nn.Module):
+    """reference: ``model/cv/efficientnet/`` (B0 scaling). GroupNorm instead
+    of BN — batch-stat-free for tiny non-IID client batches."""
+
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(32, (3, 3), strides=(2, 2), padding="SAME", use_bias=False)(x)
+        x = group_norm(32)(x)
+        x = nn.swish(x)
+        cfg = [  # (expand, filters, repeats, stride, kernel)
+            (1, 16, 1, 1, 3), (6, 24, 2, 2, 3), (6, 40, 2, 2, 5),
+            (6, 80, 3, 2, 3), (6, 112, 3, 1, 5), (6, 192, 4, 2, 5),
+            (6, 320, 1, 1, 3),
+        ]
+        for expand, filters, repeats, stride, kernel in cfg:
+            for r in range(repeats):
+                x = MBConv(filters, stride if r == 0 else 1, expand, kernel)(
+                    x, train=train
+                )
+        x = nn.Conv(1280, (1, 1), use_bias=False)(x)
+        x = group_norm(1280)(x)
+        x = nn.swish(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.2, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class MobileNetV3Block(nn.Module):
+    filters: int
+    hidden: int
+    strides: int
+    kernel: int
+    use_se: bool
+    use_hs: bool
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        act = hard_swish if self.use_hs else nn.relu
+        in_ch = x.shape[-1]
+        y = x
+        if self.hidden != in_ch:
+            y = nn.Conv(self.hidden, (1, 1), use_bias=False)(y)
+            y = group_norm(self.hidden)(y)
+            y = act(y)
+        y = nn.Conv(self.hidden, (self.kernel, self.kernel),
+                    strides=(self.strides, self.strides), padding="SAME",
+                    feature_group_count=self.hidden, use_bias=False)(y)
+        y = group_norm(self.hidden)(y)
+        if self.use_se:
+            y = SqueezeExcite(max(1, self.hidden // 4))(y)
+        y = act(y)
+        y = nn.Conv(self.filters, (1, 1), use_bias=False)(y)
+        y = group_norm(self.filters)(y)
+        if self.strides == 1 and in_ch == self.filters:
+            y = y + x
+        return y
+
+
+class MobileNetV3Small(nn.Module):
+    """reference: ``model/cv/mobilenet_v3.py`` (small profile)."""
+
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(16, (3, 3), strides=(2, 2), padding="SAME", use_bias=False)(x)
+        x = group_norm(16)(x)
+        x = hard_swish(x)
+        cfg = [  # (kernel, hidden, filters, se, hs, stride)
+            (3, 16, 16, True, False, 2), (3, 72, 24, False, False, 2),
+            (3, 88, 24, False, False, 1), (5, 96, 40, True, True, 2),
+            (5, 240, 40, True, True, 1), (5, 240, 40, True, True, 1),
+            (5, 120, 48, True, True, 1), (5, 144, 48, True, True, 1),
+            (5, 288, 96, True, True, 2), (5, 576, 96, True, True, 1),
+            (5, 576, 96, True, True, 1),
+        ]
+        for kernel, hidden, filters, se, hs, stride in cfg:
+            x = MobileNetV3Block(filters, hidden, stride, kernel, se, hs)(
+                x, train=train
+            )
+        x = nn.Conv(576, (1, 1), use_bias=False)(x)
+        x = group_norm(576)(x)
+        x = hard_swish(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = hard_swish(nn.Dense(1024)(x))
+        x = nn.Dropout(0.2, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)

@@ -98,26 +98,16 @@ class PipelineCheetah:
         self.block = Block(cfg)
         self.opt = optimizer or optax.adamw(3e-4)
         self._step = None
+        self._loss_jit = None
+        self._blocks_struct = None  # computed once, reused everywhere
 
     # -- params -------------------------------------------------------------
     def init_params(self, rng: jax.Array) -> PyTree:
         """{'embed', 'blocks' (stacked [n_layers, ...]), 'norm_f', 'head'}."""
         cfg = self.cfg
         k_embed, k_blocks, k_head = jax.random.split(rng, 3)
-        dummy = jnp.zeros((1, 8, cfg.d_model), cfg.dtype)
-        pos = jnp.arange(8)[None, :]
-        cos, sin = rotary_embedding(pos, cfg.head_dim, cfg.rope_theta)
-
-        def init_one(k):
-            variables = self.block.init(k, dummy, cos, sin)
-            return jax.tree.map(
-                lambda p: p.value if hasattr(p, "value") else p,
-                variables["params"],
-                is_leaf=lambda x: hasattr(x, "value"),
-            )
-
         block_keys = jax.random.split(k_blocks, cfg.n_layers)
-        blocks = jax.jit(jax.vmap(init_one))(block_keys)
+        blocks = jax.jit(jax.vmap(self._init_one_block))(block_keys)
         params = {
             "embed": jax.random.normal(
                 k_embed, (cfg.vocab_size, cfg.d_model), cfg.param_dtype
@@ -141,23 +131,27 @@ class PipelineCheetah:
             "head": repl,
         }
 
-    def _blocks_structure(self):
-        """Unboxed single-block param shapes (same treedef as one entry of
-        the stacked 'blocks' tree)."""
+    def _init_one_block(self, k):
+        """Init + unbox one block's params — the single source of the block
+        param structure (init_params vmaps it; _blocks_structure shapes it)."""
         cfg = self.cfg
         dummy = jnp.zeros((1, 8, cfg.d_model), cfg.dtype)
         pos = jnp.arange(8)[None, :]
         cos, sin = rotary_embedding(pos, cfg.head_dim, cfg.rope_theta)
+        variables = self.block.init(k, dummy, cos, sin)
+        return jax.tree.map(
+            lambda p: p.value if hasattr(p, "value") else p,
+            variables["params"],
+            is_leaf=lambda x: hasattr(x, "value"),
+        )
 
-        def init_unboxed(k):
-            variables = self.block.init(k, dummy, cos, sin)
-            return jax.tree.map(
-                lambda p: p.value if hasattr(p, "value") else p,
-                variables["params"],
-                is_leaf=lambda x: hasattr(x, "value"),
+    def _blocks_structure(self):
+        """Unboxed single-block param shapes (computed once)."""
+        if self._blocks_struct is None:
+            self._blocks_struct = jax.eval_shape(
+                self._init_one_block, jax.random.PRNGKey(0)
             )
-
-        return jax.eval_shape(init_unboxed, jax.random.PRNGKey(0))
+        return self._blocks_struct
 
     # -- the pipelined program ----------------------------------------------
     def _apply_stage(self, stage_blocks, x, cos, sin):
@@ -277,19 +271,21 @@ class PipelineCheetah:
 
     def loss(self, params, tokens, mask) -> jax.Array:
         """tokens/mask: [M, B, L] microbatched global arrays."""
-        p_spec, d_spec = self._specs()
+        if self._loss_jit is None:
+            p_spec, d_spec = self._specs()
 
-        def full_loss(params, tokens, mask):
-            return self._all_reduce_scalar(
-                self._loss_device(params, tokens, mask)
+            def full_loss(params, tokens, mask):
+                return self._all_reduce_scalar(
+                    self._loss_device(params, tokens, mask)
+                )
+
+            fn = shard_map(
+                full_loss, mesh=self.mesh,
+                in_specs=(p_spec, d_spec, d_spec), out_specs=P(),
             )
-
-        fn = shard_map(
-            full_loss, mesh=self.mesh,
-            in_specs=(p_spec, d_spec, d_spec), out_specs=P(),
-        )
+            self._loss_jit = jax.jit(fn)
         with self.mesh:
-            return jax.jit(fn)(params, tokens, mask)
+            return self._loss_jit(params, tokens, mask)
 
     def train_step(self, params, opt_state, tokens, mask):
         if self._step is None:

@@ -53,10 +53,12 @@ class TransformerConfig:
     # "dots" saves matmul outputs and recomputes only elementwise/norm ops —
     # far cheaper backward for a modest activation-memory increase
     remat_policy: str = "full"
-    # "auto": Pallas flash attention on TPU, XLA attention elsewhere;
-    # "flash" / "xla" force one. Flash keeps the [L, L] score matrix in VMEM
-    # tiles (never materialised in HBM) — the decisive single-chip win at
-    # long sequence.
+    # "auto": Pallas splash attention on TPU (falls back to flash, then XLA),
+    # elsewhere XLA. "splash" / "flash" / "xla" force one. The Pallas kernels
+    # keep the [L, L] score matrix in VMEM tiles (never materialised in HBM)
+    # — measured on the v5e, splash beats the older flash kernel by 5-10x on
+    # fwd+bwd and its backward avoids flash's f32 [B,H,L,128] broadcasts,
+    # which is what keeps the no-remat memory rung viable.
     attn_impl: str = "auto"
 
     @property
@@ -114,17 +116,45 @@ def apply_rotary(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
 
 
-def _use_flash(impl: str) -> bool:
-    if impl == "flash":
-        return True
-    if impl == "auto":
-        import jax as _jax
+def _attn_backend(impl: str) -> str:
+    """Resolve cfg.attn_impl to one of {"splash", "flash", "xla"}."""
+    if impl in ("splash", "flash", "xla"):
+        return impl
+    import jax as _jax
 
-        try:
-            return _jax.devices()[0].platform == "tpu"
-        except Exception:
-            return False
-    return False
+    try:
+        on_tpu = _jax.devices()[0].platform == "tpu"
+    except Exception:
+        on_tpu = False
+    if not on_tpu:
+        return "xla"
+    try:
+        import jax.experimental.pallas.ops.tpu.splash_attention  # noqa: F401
+
+        return "splash"
+    except ImportError:
+        return "flash"
+
+
+def splash_attention_tpu(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Causal splash attention (the current-generation Pallas TPU kernel).
+
+    q/k/v: [B, L, H, D] (Hkv already expanded for GQA) → out [B, L, H, D].
+    The kernel is built per trace — make_splash_mha captures trace-local
+    mask arrays, so caching it across jit traces leaks tracers.
+    """
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk,
+        splash_attention_mask as sm,
+    )
+
+    B, L, H, D = q.shape
+    mask = sm.MultiHeadMask([sm.CausalMask((L, L))] * H)
+    kernel = sk.make_splash_mha(mask=mask, head_shards=1, q_seq_shards=1)
+    scale = float(1.0 / D ** 0.5)
+    qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))  # [B, H, L, D]
+    out = jax.vmap(kernel)(qt * scale, kt, vt)
+    return out.swapaxes(1, 2)
 
 
 def flash_attention_tpu(
@@ -232,9 +262,15 @@ class Attention(nn.Module):
                 ring, mesh=seq_ctx.mesh, in_specs=(spec, spec, spec),
                 out_specs=spec, check_rep=False,
             )(q, k, v)
-        elif mask is None and L >= 128 and L % 128 == 0 and _use_flash(cfg.attn_impl):
+        elif (
+            mask is None and L >= 128 and L % 128 == 0
+            and _attn_backend(cfg.attn_impl) != "xla"
+        ):
             k, v = expand_gqa(k, v, H)
-            out = flash_attention_tpu(q, k, v)
+            if _attn_backend(cfg.attn_impl) == "splash":
+                out = splash_attention_tpu(q, k, v)
+            else:
+                out = flash_attention_tpu(q, k, v)
         else:
             out = attention_scores(q, k, v, mask)
         out = out.reshape(B, L, H * hd)

@@ -5,6 +5,17 @@ GKTClientTrainer.py) — clients train a small feature extractor + classifier;
 the server trains a large network on the clients' extracted features with a
 CE + KL(client soft labels) loss, and returns its own soft labels for the
 client's KD term. Only features/logits cross the boundary, never raw data.
+
+TPU-first: one jitted program per round —
+- the CLIENT phase is ``vmap``-ped over the stacked ``[clients, cap, ...]``
+  dataset (local epochs are a ``lax.scan`` inside), so the whole cohort's
+  extractor/classifier updates are a single fused device program;
+- the SERVER phase is inherently sequential (its params update after each
+  client's features, reference GKTServerTrainer.train_large_model_on_the_server),
+  so it runs as ONE ``lax.scan`` over the client axis instead of n Python
+  dispatches;
+- eval follows the reference's protocol: the server net is scored through
+  EVERY client's extractor (mean accuracy), not just client 0's.
 """
 
 from __future__ import annotations
@@ -62,6 +73,7 @@ class FedGKTAPI:
         feat_dim = int(getattr(args, "gkt_feat_dim", 64))
         self.temp = float(getattr(args, "gkt_temperature", 3.0))
         self.alpha = float(getattr(args, "gkt_alpha", 1.0))  # KD weight
+        self.epochs = max(int(getattr(args, "epochs", 1)), 1)
         self.extractor = ClientFeatureNet(feat_dim)
         self.client_head = nn.Dense(C)
         self.server_net = ServerNet(C)
@@ -81,8 +93,12 @@ class FedGKTAPI:
         self.c_opt = optax.sgd(lr)
         self.s_opt = optax.adam(1e-3)
         self.s_opt_state = self.s_opt.init(self.server_params)
+        self.c_opt_states = jax.vmap(
+            lambda e, h: self.c_opt.init((e, h))
+        )(self.client_ex, self.client_hd)
 
-        def client_loss(ex, hd, x, y, mask, server_logits):
+        def client_loss(params, x, y, mask, server_logits):
+            ex, hd = params
             feats = self.extractor.apply(ex, x)
             logits = self.client_head.apply(hd, feats)
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
@@ -90,25 +106,27 @@ class FedGKTAPI:
             per = ce + self.alpha * kd
             return (per * mask).sum() / jnp.maximum(mask.sum(), 1.0)
 
-        def closs(params, x, y, mask, server_logits):
-            ex, hd = params
-            return client_loss(ex, hd, x, y, mask, server_logits)
-
-        @jax.jit
         def client_update(ex, hd, c_state, x, y, mask, server_logits):
-            loss, grads = jax.value_and_grad(closs)(
-                (ex, hd), x, y, mask, server_logits
+            """``epochs`` full-batch steps under lax.scan, then the features
+            and soft labels that cross to the server."""
+
+            def epoch(carry, _):
+                ex, hd, c_state = carry
+                loss, grads = jax.value_and_grad(client_loss)(
+                    (ex, hd), x, y, mask, server_logits
+                )
+                updates, c_state = self.c_opt.update(
+                    grads, c_state, (ex, hd)
+                )
+                ex, hd = optax.apply_updates((ex, hd), updates)
+                return (ex, hd, c_state), loss
+
+            (ex, hd, c_state), losses = jax.lax.scan(
+                epoch, (ex, hd, c_state), None, length=self.epochs
             )
-            updates, c_state = self.c_opt.update(grads, c_state, (ex, hd))
-            ex, hd = optax.apply_updates((ex, hd), updates)
             feats = self.extractor.apply(ex, x)
             logits = self.client_head.apply(hd, feats)
-            return ex, hd, c_state, feats, logits, loss
-
-        self._client_update = client_update
-        self.c_opt_states = jax.vmap(
-            lambda e, h: self.c_opt.init((e, h))
-        )(self.client_ex, self.client_hd)
+            return ex, hd, c_state, feats, logits, losses.mean()
 
         def server_loss(sp, feats, y, mask, client_logits):
             logits = self.server_net.apply(sp, feats)
@@ -117,7 +135,6 @@ class FedGKTAPI:
             per = ce + self.alpha * kd
             return (per * mask).sum() / jnp.maximum(mask.sum(), 1.0)
 
-        @jax.jit
         def server_update(sp, s_state, feats, y, mask, client_logits):
             loss, grads = jax.value_and_grad(server_loss)(
                 sp, feats, y, mask, client_logits
@@ -127,7 +144,43 @@ class FedGKTAPI:
             logits = self.server_net.apply(sp, feats)
             return sp, s_state, logits, loss
 
-        self._server_update = server_update
+        @jax.jit
+        def round_fn(client_ex, client_hd, c_opt_states, server_params,
+                     s_opt_state, server_logits, x, y, masks):
+            # client phase: the whole cohort in one vmapped program
+            ex, hd, cs, feats, logits, closses = jax.vmap(client_update)(
+                client_ex, client_hd, c_opt_states, x, y, masks, server_logits
+            )
+
+            # server phase: sequential by construction → one scan, not n
+            # Python dispatches
+            def body(carry, inp):
+                sp, ss = carry
+                f, yy, m, cl = inp
+                sp, ss, slog, sl = server_update(sp, ss, f, yy, m, cl)
+                return (sp, ss), (slog, sl)
+
+            (server_params, s_opt_state), (slogits, slosses) = jax.lax.scan(
+                body, (server_params, s_opt_state), (feats, y, masks, logits)
+            )
+            return (ex, hd, cs, server_params, s_opt_state, slogits,
+                    closses.mean(), slosses.mean())
+
+        self._round_fn = round_fn
+
+        @jax.jit
+        def eval_fn(client_ex, server_params, test_x, test_y):
+            """Server net through EVERY client's extractor → mean accuracy
+            (reference: server-side eval across edge feature extractors)."""
+
+            def one(ex):
+                feats = self.extractor.apply(ex, test_x)
+                logits = self.server_net.apply(server_params, feats)
+                return (jnp.argmax(logits, -1) == test_y).mean()
+
+            return jax.vmap(one)(client_ex).mean()
+
+        self._eval_fn = eval_fn
         self.history = []
 
     def train(self) -> Dict[str, float]:
@@ -136,47 +189,28 @@ class FedGKTAPI:
         C = self.ds.class_num
         # per-client cached server logits (start at zeros = uniform teacher)
         server_logits = jnp.zeros((self.n, self.ds.cap, C))
+        x = jnp.asarray(self.ds.train_x)
+        y = jnp.asarray(self.ds.train_y).astype(jnp.int32)
+        masks = (
+            jnp.arange(self.ds.cap)[None, :]
+            < jnp.asarray(self.ds.train_counts)[:, None]
+        ).astype(jnp.float32)
+        test_x = jnp.asarray(self.ds.test_x)
+        test_y = jnp.asarray(self.ds.test_y)
         for r in range(rounds):
-            c_losses, s_losses = [], []
-            for c in range(self.n):
-                ex = jax.tree.map(lambda t: t[c], self.client_ex)
-                hd = jax.tree.map(lambda t: t[c], self.client_hd)
-                cs = jax.tree.map(lambda t: t[c], self.c_opt_states)
-                x, y, cnt = self.ds.client_shard(c)
-                xj = jnp.asarray(x)
-                yj = jnp.asarray(y).astype(jnp.int32)
-                mask = (jnp.arange(self.ds.cap) < cnt).astype(jnp.float32)
-                # several local full-batch steps per round (reference: client
-                # trains `epochs` local epochs before the exchange)
-                for _ in range(max(int(getattr(self.args, "epochs", 1)), 1)):
-                    ex, hd, cs, feats, logits, closs_v = self._client_update(
-                        ex, hd, cs, xj, yj, mask, server_logits[c]
-                    )
-                # client → server: features + soft labels (never raw x)
-                self.server_params, self.s_opt_state, slogits, sloss_v = (
-                    self._server_update(self.server_params, self.s_opt_state,
-                                        feats, yj, mask, logits)
-                )
-                server_logits = server_logits.at[c].set(slogits)
-                self.client_ex = jax.tree.map(
-                    lambda a, t: a.at[c].set(t), self.client_ex, ex)
-                self.client_hd = jax.tree.map(
-                    lambda a, t: a.at[c].set(t), self.client_hd, hd)
-                self.c_opt_states = jax.tree.map(
-                    lambda a, t: a.at[c].set(t), self.c_opt_states, cs)
-                c_losses.append(float(closs_v))
-                s_losses.append(float(sloss_v))
-            # eval: client-0 extractor + server net (reference: server-side
-            # eval on the big model)
-            ex0 = jax.tree.map(lambda t: t[0], self.client_ex)
-            feats = self.extractor.apply(ex0, jnp.asarray(self.ds.test_x))
-            logits = self.server_net.apply(self.server_params, feats)
-            acc = float(
-                (jnp.argmax(logits, -1) == jnp.asarray(self.ds.test_y)).mean()
+            (self.client_ex, self.client_hd, self.c_opt_states,
+             self.server_params, self.s_opt_state, server_logits,
+             closs, sloss) = self._round_fn(
+                self.client_ex, self.client_hd, self.c_opt_states,
+                self.server_params, self.s_opt_state, server_logits,
+                x, y, masks,
             )
+            acc = float(self._eval_fn(
+                self.client_ex, self.server_params, test_x, test_y
+            ))
             last = {"test_acc": acc,
-                    "train_loss": float(np.mean(c_losses)),
-                    "server_loss": float(np.mean(s_losses))}
+                    "train_loss": float(closs),
+                    "server_loss": float(sloss)}
             self.history.append({"round": r, **last})
             logger.info("fedgkt round %d: closs=%.4f sloss=%.4f acc=%.4f",
                         r, last["train_loss"], last["server_loss"], acc)

@@ -1,0 +1,174 @@
+"""FedNAS: federated neural architecture search (DARTS-based).
+
+reference: ``simulation/mpi/fednas/`` (FedNASTrainer.search — alternate an
+architecture step on held-out data with a weight step on train data, first-
+order DARTS; FedNASAggregator — average weights AND alphas across clients;
+after the search phase the argmax genotype is trained).
+
+TPU-first: the whole cohort searches as ONE vmapped program. Each client's
+local search is a ``lax.scan`` of (alpha-step on the validation half,
+w-step on the train half); the round averages both param groups with the
+same stacked-tree kernel as FedAvg. Arch params live in the regular param
+tree (``models/darts.py``) and are split by path mask, so "average weights
+and alphas" is a single weighted average of the whole tree — exactly the
+reference's aggregate, with none of its tensor bookkeeping.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..core.aggregate import weighted_average
+from ..models.darts import genotype
+from ..ml.evaluate import make_eval_fn
+
+logger = logging.getLogger(__name__)
+
+
+class FedNASAPI:
+    def __init__(self, args, device, dataset, model):
+        if model.name not in ("darts", "darts_search"):
+            raise ValueError(
+                f"FedNAS needs the darts search model, got {model.name!r}"
+            )
+        self.args = args
+        self.ds = dataset
+        self.bundle = model
+        self.n = dataset.client_num
+        self.epochs = max(int(getattr(args, "epochs", 1)), 1)
+        rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        self.root_rng = rng
+        self.global_params = model.init(rng)
+        w_lr = float(getattr(args, "learning_rate", 0.025))
+        a_lr = float(getattr(args, "arch_learning_rate", 3e-3))
+
+        from ..models.darts import is_arch_param
+
+        def label_fn(params):
+            return jax.tree_util.tree_map_with_path(
+                lambda p, _: "arch" if is_arch_param(p) else "weights", params
+            )
+
+        # one optimizer tree, two schedules — reference keeps two torch
+        # optimizers (SGD for w, Adam for alpha); multi_transform is the
+        # functional equivalent
+        self.opt = optax.multi_transform(
+            {"weights": optax.sgd(w_lr, momentum=0.9),
+             "arch": optax.adam(a_lr, b1=0.5, b2=0.999)},
+            label_fn,
+        )
+
+        def ce(params, x, y, mask):
+            logits = model.apply(params, x, train=True)
+            per = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            return (per * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+        def mask_tree(grads, params, want_arch: bool):
+            return jax.tree_util.tree_map_with_path(
+                lambda p, g: g if is_arch_param(p) == want_arch
+                else jnp.zeros_like(g),
+                grads,
+            )
+
+        # steps per round ≈ the minibatch count a torch epoch would take —
+        # full-batch GD needs comparable step counts to learn, not 1/epoch
+        half = max(self.ds.cap // 2, 1)
+        self.steps_per_round = self.epochs * max(
+            half // max(int(getattr(args, "batch_size", 16)), 1), 4
+        )
+
+        def client_search(params, opt_state, xt, yt, mt, xv, yv, mv):
+            """steps x (alpha-step on valid, w-step on train) — first-order
+            DARTS (reference architect.step with unrolled=False)."""
+
+            def epoch(carry, _):
+                params, opt_state = carry
+                # arch step on the held-out half
+                al, ag = jax.value_and_grad(ce)(params, xv, yv, mv)
+                ag = mask_tree(ag, params, want_arch=True)
+                au, opt_state = self.opt.update(ag, opt_state, params)
+                params = optax.apply_updates(params, au)
+                # weight step on the train half
+                wl, wg = jax.value_and_grad(ce)(params, xt, yt, mt)
+                wg = mask_tree(wg, params, want_arch=False)
+                wu, opt_state = self.opt.update(wg, opt_state, params)
+                params = optax.apply_updates(params, wu)
+                return (params, opt_state), (wl, al)
+
+            (params, opt_state), (wls, als) = jax.lax.scan(
+                epoch, (params, opt_state), None, length=self.steps_per_round
+            )
+            return params, opt_state, wls.mean(), als.mean()
+
+        @jax.jit
+        def round_fn(stacked_params, opt_states, xt, yt, mt, xv, yv, mv,
+                     weights):
+            ps, os_, wl, al = jax.vmap(client_search)(
+                stacked_params, opt_states, xt, yt, mt, xv, yv, mv
+            )
+            avg = weighted_average(ps, weights)
+            return avg, os_, wl.mean(), al.mean()
+
+        self._round_fn = round_fn
+        self._eval = make_eval_fn(model)
+        self.history = []
+
+    def _split_halves(self):
+        """Each client's shard splits into train/valid halves (reference
+        FedNASTrainer uses train_queue/valid_queue)."""
+        x = np.asarray(self.ds.train_x)
+        y = np.asarray(self.ds.train_y)
+        counts = np.asarray(self.ds.train_counts)
+        half = self.ds.cap // 2
+        xt, xv = x[:, :half], x[:, half:2 * half]
+        yt, yv = y[:, :half], y[:, half:2 * half]
+        nt = np.minimum(counts, half)
+        nv = np.clip(counts - half, 0, half)
+        mt = (np.arange(half)[None] < nt[:, None]).astype(np.float32)
+        mv = (np.arange(half)[None] < nv[:, None]).astype(np.float32)
+        # clients whose data fits in one half still need a valid signal:
+        # fall back to the train half for alpha
+        empty_v = mv.sum(1) < 1
+        if empty_v.any():
+            xv[empty_v], yv[empty_v], mv[empty_v] = (
+                xt[empty_v], yt[empty_v], mt[empty_v],
+            )
+        return map(jnp.asarray, (xt, yt, mt, xv, yv, mv))
+
+    def train(self) -> Dict[str, float]:
+        xt, yt, mt, xv, yv, mv = self._split_halves()
+        weights = jnp.asarray(self.ds.train_counts, jnp.float32)
+        stacked = jax.tree.map(
+            lambda t: jnp.broadcast_to(t[None], (self.n,) + t.shape),
+            self.global_params,
+        )
+        opt_states = jax.vmap(self.opt.init)(stacked)
+        last: Dict[str, float] = {}
+        for r in range(int(self.args.comm_round)):
+            avg, opt_states, wl, al = self._round_fn(
+                stacked, opt_states, xt, yt, mt, xv, yv, mv, weights
+            )
+            self.global_params = avg
+            stacked = jax.tree.map(
+                lambda t: jnp.broadcast_to(t[None], (self.n,) + t.shape), avg
+            )
+            metrics = self._eval(avg, self.ds.test_x, self.ds.test_y)
+            last = {
+                "test_acc": metrics["test_acc"],
+                "train_loss": float(wl),
+                "arch_loss": float(al),
+            }
+            self.history.append({"round": r, **last})
+            logger.info(
+                "fednas round %d: wl=%.4f al=%.4f acc=%.4f",
+                r, float(wl), float(al), metrics["test_acc"],
+            )
+        last["genotype"] = genotype(self.global_params)
+        logger.info("fednas genotype: %s", last["genotype"])
+        return last

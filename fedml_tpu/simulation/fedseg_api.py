@@ -1,0 +1,79 @@
+"""FedSeg: federated semantic segmentation.
+
+reference: ``simulation/mpi/fedseg/`` (FedSegAPI.py, FedSegTrainer.py,
+utils.py Evaluator — pixel accuracy + mIoU over pascal_voc/cityscapes).
+
+TPU-first: the per-algorithm runtime collapses into the fused sp engine —
+the segmentation task enters through the loss registry
+(``ml/losses.segmentation_loss``: per-pixel CE) and the model zoo (``fcn``/
+``deeplab``), so client training IS the vmapped FedAvg kernel. This class
+only adds what is segmentation-specific: the mIoU evaluation pass
+(reference utils.py Evaluator.Mean_Intersection_over_Union).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sp_api import FedAvgAPI
+
+logger = logging.getLogger(__name__)
+
+
+def make_miou_fn(bundle, num_classes: int, batch_size: int = 64):
+    """jit'd confusion-matrix accumulation → per-class IoU."""
+
+    @jax.jit
+    def confusion_batch(params, bx, by):
+        logits = bundle.apply(params, bx, train=False)
+        pred = jnp.argmax(logits, -1).reshape(-1)
+        true = by.reshape(-1)
+        idx = true * num_classes + pred
+        return jnp.bincount(idx, length=num_classes * num_classes)
+
+    def miou(params, test_x, test_y) -> Dict[str, float]:
+        cm = np.zeros(num_classes * num_classes, np.int64)
+        for i in range(0, test_x.shape[0], batch_size):
+            cm += np.asarray(confusion_batch(
+                params,
+                jnp.asarray(test_x[i:i + batch_size]),
+                jnp.asarray(test_y[i:i + batch_size]).astype(jnp.int32),
+            ))
+        cm = cm.reshape(num_classes, num_classes)
+        inter = np.diag(cm).astype(np.float64)
+        union = cm.sum(0) + cm.sum(1) - np.diag(cm)
+        present = union > 0
+        iou = inter[present] / np.maximum(union[present], 1)
+        return {
+            "test_miou": float(iou.mean()) if present.any() else 0.0,
+            "pixel_acc": float(inter.sum() / max(cm.sum(), 1)),
+        }
+
+    return miou
+
+
+class FedSegAPI(FedAvgAPI):
+    """FedAvg over a segmentation model + mIoU evaluation."""
+
+    def __init__(self, args, device, dataset, model, client_trainer=None,
+                 server_aggregator=None):
+        if dataset.task != "segmentation":
+            raise ValueError(
+                f"FedSeg needs a segmentation dataset, got task {dataset.task!r}"
+            )
+        super().__init__(args, device, dataset, model, client_trainer,
+                         server_aggregator)
+        self._miou = make_miou_fn(model, dataset.class_num)
+
+    def train(self):
+        result = super().train()
+        extra = self._miou(self.global_params, self.ds.test_x, self.ds.test_y)
+        logger.info("fedseg final: %s", extra)
+        result = dict(result or {})
+        result.update(extra)
+        return result

@@ -58,6 +58,20 @@ class SimulatorSingleProcess:
             from .fedgkt_api import FedGKTAPI
 
             self.fl_trainer = FedGKTAPI(args, device, dataset, model)
+        elif opt == constants.FEDML_FEDERATED_OPTIMIZER_FEDSEG:
+            from .fedseg_api import FedSegAPI
+
+            self.fl_trainer = FedSegAPI(
+                args, device, dataset, model, client_trainer, server_aggregator
+            )
+        elif opt == constants.FEDML_FEDERATED_OPTIMIZER_FEDGAN:
+            from .fedgan_api import FedGanAPI
+
+            self.fl_trainer = FedGanAPI(args, device, dataset, model)
+        elif opt == constants.FEDML_FEDERATED_OPTIMIZER_FEDNAS:
+            from .fednas_api import FedNASAPI
+
+            self.fl_trainer = FedNASAPI(args, device, dataset, model)
         else:
             raise ValueError(f"unsupported federated_optimizer {opt!r}")
 

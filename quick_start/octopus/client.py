@@ -1,0 +1,14 @@
+"""Cross-silo client (reference: quick_start/octopus/client/).
+
+    python client.py --cf fedml_config.yaml --rank 1 --role client
+    python client.py --cf fedml_config.yaml --rank 2 --role client
+
+A silo with several local chips adds intra-silo data parallelism with
+`--silo_device_indices 0 1 ...` (one jit over a local mesh, per-step
+gradient psum — the torch-DDP analog on ICI).
+"""
+
+import fedml_tpu as fedml
+
+if __name__ == "__main__":
+    print(fedml.run_cross_silo_client())

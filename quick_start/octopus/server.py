@@ -1,0 +1,9 @@
+"""Cross-silo server (reference: quick_start/octopus/server/).
+
+    python server.py --cf fedml_config.yaml --rank 0 --role server
+"""
+
+import fedml_tpu as fedml
+
+if __name__ == "__main__":
+    print(fedml.run_cross_silo_server())

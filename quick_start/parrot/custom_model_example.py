@@ -1,0 +1,36 @@
+"""Custom data + model (reference:
+quick_start/parrot/torch_fedavg_mnist_lr_custum_data_and_model_example.py):
+bring your own flax module; everything else is unchanged.
+"""
+
+import fedml_tpu as fedml
+import jax.numpy as jnp
+from fedml_tpu import data as fedml_data
+from fedml_tpu.models import ModelBundle
+from fedml_tpu.runner import FedMLRunner
+from flax import linen as nn
+
+
+class TwoLayerNet(nn.Module):
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = x.reshape((x.shape[0], -1))
+        h = nn.relu(nn.Dense(128)(h))
+        return nn.Dense(self.num_classes)(h)
+
+
+if __name__ == "__main__":
+    args = fedml.init()
+    device = fedml.get_device(args)
+    dataset, output_dim = fedml_data.load(args)
+    model = ModelBundle(
+        module=TwoLayerNet(output_dim),
+        name="two_layer_net",
+        input_shape=tuple(dataset.train_x.shape[2:]),
+        input_dtype=jnp.float32,
+        task=dataset.task,
+    )
+    runner = FedMLRunner(args, device, dataset, model)
+    print(runner.run())

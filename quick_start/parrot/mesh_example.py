@@ -1,0 +1,10 @@
+"""Same federation, every local chip: the cohort shards over a `clients`
+mesh axis (replaces the reference's MPI/NCCL simulators).
+
+    python mesh_example.py --cf fedml_config.yaml
+"""
+
+import fedml_tpu as fedml
+
+if __name__ == "__main__":
+    print(fedml.run_simulation(backend="mesh"))

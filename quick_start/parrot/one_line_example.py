@@ -1,0 +1,10 @@
+"""One-line simulated FL (reference:
+quick_start/parrot/torch_fedavg_mnist_lr_one_line_example.py).
+
+    python one_line_example.py --cf fedml_config.yaml
+"""
+
+import fedml_tpu as fedml
+
+if __name__ == "__main__":
+    print(fedml.run_simulation())

@@ -1,0 +1,17 @@
+"""Step-by-step API (reference:
+quick_start/parrot/torch_fedavg_mnist_lr_step_by_step_example.py):
+init -> device -> data -> model -> runner.
+"""
+
+import fedml_tpu as fedml
+from fedml_tpu import data as fedml_data
+from fedml_tpu import models as fedml_models
+from fedml_tpu.runner import FedMLRunner
+
+if __name__ == "__main__":
+    args = fedml.init()
+    device = fedml.get_device(args)
+    dataset, output_dim = fedml_data.load(args)
+    model = fedml_models.create(args, output_dim)
+    runner = FedMLRunner(args, device, dataset, model)
+    print(runner.run())

@@ -101,3 +101,52 @@ class TestTurboAggregate:
         assert secure["test_acc"] > 0.5
         # quantized share aggregation ≈ trusted-server average
         assert abs(secure["test_acc"] - plain["test_acc"]) < 0.15
+
+
+class TestFedSeg:
+    """VERDICT missing #6: segmentation runtime (reference simulation/mpi/fedseg)."""
+
+    def test_fedseg_learns_and_reports_miou(self):
+        res = run_sim(federated_optimizer="FedSeg", dataset="pascal_voc",
+                      model="fcn", client_num_in_total=4,
+                      client_num_per_round=4, comm_round=6, epochs=3,
+                      batch_size=8, learning_rate=0.1)
+        assert "test_miou" in res and "pixel_acc" in res
+        assert res["pixel_acc"] > 0.5  # synthetic blobs are separable
+        assert res["test_miou"] > 0.05
+
+
+class TestFedGAN:
+    """VERDICT missing #6: adversarial runtime (reference simulation/mpi/fedgan)."""
+
+    def test_fedgan_trains_both_nets(self):
+        from fedml_tpu.simulation.fedgan_api import FedGanAPI
+
+        args = fedml.init(Arguments(overrides=dict(
+            dataset="synthetic", model="lr", federated_optimizer="FedGAN",
+            client_num_in_total=4, client_num_per_round=4, comm_round=6,
+            epochs=3, batch_size=16, learning_rate=2e-3,
+        )), should_init_logs=False)
+        ds, od = data_mod.load(args)
+        api = FedGanAPI(args, None, ds)
+        res = api.train()
+        assert np.isfinite(res["d_loss"]) and np.isfinite(res["g_loss"])
+        # the discriminator must not have trivially won: its confidence that
+        # generated samples are fake stays off the floor
+        assert res["d_score_on_fake"] > 0.02
+        samples = api.sample(16)
+        assert samples.shape == (16,) + tuple(ds.train_x.shape[2:])
+        assert np.all(np.isfinite(samples))
+
+
+class TestFedNAS:
+    """VERDICT missing #6: DARTS search runtime (reference simulation/mpi/fednas)."""
+
+    def test_fednas_searches_and_learns(self):
+        res = run_sim(federated_optimizer="FedNAS", model="darts",
+                      client_num_in_total=4, client_num_per_round=4,
+                      comm_round=6, epochs=2, learning_rate=0.05)
+        assert res["test_acc"] > 0.5  # synthetic is linearly separable
+        assert "genotype" in res and len(res["genotype"]) == 3
+        # alphas moved: at least one layer prefers a non-zero op
+        assert any(v != 0 for v in res["genotype"].values())
