@@ -27,7 +27,11 @@ SIMULATION_BACKENDS = (FEDML_SIMULATION_TYPE_SP, FEDML_SIMULATION_TYPE_MESH)
 COMM_BACKEND_LOOPBACK = "LOOPBACK"  # in-process test fixture (absent in reference)
 COMM_BACKEND_GRPC = "GRPC"
 COMM_BACKEND_TCP = "TCP"
-COMM_BACKENDS = (COMM_BACKEND_LOOPBACK, COMM_BACKEND_GRPC, COMM_BACKEND_TCP)
+COMM_BACKEND_MQTT = "MQTT"  # broker plane (control only; payload store = S3 split)
+COMM_BACKENDS = (
+    COMM_BACKEND_LOOPBACK, COMM_BACKEND_GRPC, COMM_BACKEND_TCP,
+    COMM_BACKEND_MQTT,
+)
 
 # Cross-silo scenarios (reference: constants.py:26-28)
 FEDML_CROSS_SILO_SCENARIO_HORIZONTAL = "horizontal"

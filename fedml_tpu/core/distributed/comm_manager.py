@@ -153,6 +153,16 @@ class FedMLCommManager(Observer):
                 ip_config_path=str(getattr(self.args, "grpc_ipconfig_path", "")),
                 base_port=base_port,
             )
+        elif self.backend == constants.COMM_BACKEND_MQTT:
+            from .mqtt_backend import MqttCommManager
+
+            self.com_manager = MqttCommManager(
+                host=str(getattr(self.args, "mqtt_host", "127.0.0.1")),
+                port=int(getattr(self.args, "mqtt_port", 1883)),
+                rank=self.rank,
+                world_size=self.size,
+                run_id=str(getattr(self.args, "run_id", "0")),
+            )
         else:
             raise ValueError(
                 f"unsupported comm backend {self.backend!r}; "

@@ -1,0 +1,123 @@
+"""MQTT comm backend: broker-mediated DCN message plane.
+
+reference: ``core/distributed/communication/mqtt/mqtt_comm_manager.py`` +
+``mqtt_s3/mqtt_s3_multi_clients_comm_manager.py:20-352`` — the production
+Octopus/Beehive transport: per-rank topics on a broker, JSON control
+messages, last-will for liveness, S3 for bulk payloads.
+
+TPU-native composition: this backend carries ONLY control traffic (the same
+no-pickle ``Message`` wire bytes, base64 over MQTT); bulk model payloads ride
+the payload-by-reference store (``payload_store.py``), which IS the S3 split
+— configure ``payload_store_dir`` and every oversized array list stays off
+the broker. Liveness: a retained last-will publishes the OFFLINE status the
+server manager already understands.
+
+``paho-mqtt`` is an optional dependency (not staged on TPU pods); importing
+this module without it raises at construction with a clear message, exactly
+like the reference degrades without its broker config.
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+import queue
+from typing import List
+
+from .base_com_manager import BaseCommunicationManager, CommunicationConstants, Observer
+from .message import Message
+
+logger = logging.getLogger(__name__)
+
+
+class MqttCommManager(BaseCommunicationManager):
+    """Per-rank topic scheme: ``fedml/<run_id>/<rank>``."""
+
+    def __init__(self, host: str, port: int, rank: int, world_size: int,
+                 run_id: str = "0", keepalive: int = 60, qos: int = 1):
+        try:
+            import paho.mqtt.client as mqtt
+        except ImportError as e:
+            raise RuntimeError(
+                "the MQTT backend needs paho-mqtt (pip install paho-mqtt); "
+                "on broker-less pods use GRPC or LOOPBACK — with "
+                "payload_store_dir they cover the MQTT+S3 design"
+            ) from e
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.run_id = str(run_id)
+        self.qos = int(qos)
+        self._queue: "queue.Queue[bytes]" = queue.Queue()
+        self._observers: List[Observer] = []
+        self._running = False
+        client_id = f"fedml-{run_id}-{rank}"
+        try:  # paho-mqtt >= 2.0 requires the callback API version up front
+            self._client = mqtt.Client(
+                mqtt.CallbackAPIVersion.VERSION1, client_id=client_id
+            )
+        except AttributeError:  # paho-mqtt 1.x
+            self._client = mqtt.Client(client_id=client_id)
+        # MQTT last-will: the broker publishes OFFLINE for us if we vanish —
+        # the server's liveness handler treats it like a graceful departure
+        will = Message(
+            "c2s_client_status", self.rank, 0
+        )
+        will.add("client_status", "OFFLINE")
+        self._client.will_set(
+            self._topic(0), base64.b64encode(will.serialize()), qos=qos,
+            retain=False,
+        )
+        self._client.on_message = self._on_mqtt_message
+        # (re)subscribe in on_connect: paho auto-reconnects after a broker
+        # blip but does NOT restore subscriptions on a clean session
+        self._client.on_connect = (
+            lambda client, userdata, flags, rc, *a:
+            client.subscribe(self._topic(self.rank), qos=self.qos)
+        )
+        self._client.connect(host, int(port), keepalive)
+        self._client.loop_start()
+        logger.info("mqtt backend: rank %d on %s:%d", rank, host, port)
+
+    def _topic(self, rank: int) -> str:
+        return f"fedml/{self.run_id}/{rank}"
+
+    def _on_mqtt_message(self, client, userdata, msg) -> None:
+        self._queue.put(base64.b64decode(msg.payload))
+
+    def send_message(self, msg: Message) -> None:
+        self._client.publish(
+            self._topic(msg.get_receiver_id()),
+            base64.b64encode(msg.serialize()), qos=self.qos,
+        )
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        if observer in self._observers:
+            self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        self._notify(
+            Message(CommunicationConstants.MSG_TYPE_CONNECTION_IS_READY,
+                    self.rank, self.rank)
+        )
+        while self._running:
+            try:
+                data = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            self._notify(Message.deserialize(data))
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        try:
+            self._client.loop_stop()
+            self._client.disconnect()
+        except Exception:
+            pass
+
+    def _notify(self, msg: Message) -> None:
+        for obs in list(self._observers):
+            obs.receive_message(msg.get_type(), msg)

@@ -104,21 +104,52 @@ def log_aggregation_status(status: str) -> None:
     _emit({"kind": "server_status", "status": status})
 
 
+def device_stats() -> list:
+    """Per-accelerator memory stats (the reference's nvidia-smi fields,
+    ``system_stats.py`` gpu_* — here from the jax backend's allocator)."""
+    out = []
+    try:
+        import jax
+
+        for d in jax.devices():
+            stats = {}
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:
+                pass
+            used = int(stats.get("bytes_in_use", 0))
+            limit = int(stats.get("bytes_limit", 0))
+            out.append({
+                "device": str(d),
+                "kind": getattr(d, "device_kind", "?"),
+                "mem_used_mb": round(used / 1e6, 1),
+                "mem_limit_mb": round(limit / 1e6, 1),
+                "mem_util": round(used / limit, 4) if limit else None,
+                "peak_mb": round(
+                    int(stats.get("peak_bytes_in_use", 0)) / 1e6, 1
+                ),
+            })
+    except Exception:
+        pass
+    return out
+
+
 def log_sys_perf() -> None:
     """reference: SysStats via psutil/nvidia (system_stats.py:8-165) —
-    CPU/mem here; device-side utilization comes from jax.profiler traces."""
+    host CPU/mem plus per-device HBM utilization."""
+    entry = {"kind": "sys_perf", "devices": device_stats()}
     try:
         import psutil
 
         p = psutil.Process()
-        _emit({
-            "kind": "sys_perf",
+        entry.update({
             "cpu_percent": psutil.cpu_percent(interval=None),
             "mem_rss_mb": p.memory_info().rss / 1e6,
             "mem_percent": psutil.virtual_memory().percent,
         })
     except ImportError:
         pass
+    _emit(entry)
 
 
 class MLOpsProfilerEvent:

@@ -13,7 +13,7 @@ jit — reference precedent ``Server.py:126-128``).
 
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -58,3 +58,94 @@ def pad_schedules(
         out[i, : len(s)] = s
         mask[i, : len(s)] = 1.0
     return out, mask
+
+
+def branch_and_bound_schedule(
+    workloads: np.ndarray,
+    speeds: np.ndarray,
+    memory_caps: Optional[np.ndarray] = None,
+    beam: int = 4096,
+) -> Tuple[np.ndarray, float]:
+    """Makespan-minimizing assignment of workloads to heterogeneous workers.
+
+    reference: ``core/schedule/scheduler.py:4-183`` — best-first
+    branch-and-bound: workloads sorted descending; the frontier expands the
+    partial assignment with the smallest current makespan; a worker whose
+    accumulated cost would exceed its memory cap is pruned. Re-design:
+    iterative heap frontier (the reference recurses, which overflows Python's
+    stack beyond ~1000 expansions) with a ``beam`` bound that falls back to
+    greedy completion if the frontier would explode — same optimum on small
+    instances, graceful degradation on big ones.
+
+    ``speeds[j]``: cost multiplier of worker j (reference's ``constraints``);
+    ``memory_caps[j]``: max accumulated cost (None = unbounded).
+    Returns (assignment [n] worker ids in the ORIGINAL workload order,
+    makespan).
+    """
+    import heapq
+
+    w = np.asarray(workloads, np.float64)
+    y = np.asarray(speeds, np.float64)
+    n, k = len(w), len(y)
+    if n == 0:
+        return np.zeros(0, np.int32), 0.0
+    caps = (
+        np.full(k, np.inf) if memory_caps is None
+        else np.asarray(memory_caps, np.float64)
+    )
+    order = np.argsort(w)[::-1]
+    ws = w[order]
+
+    # frontier entries: (makespan, tiebreak, next_idx, costs tuple, assign tuple)
+    counter = 0
+    frontier = [(0.0, 0, 0, tuple(0.0 for _ in range(k)), ())]
+    best = None
+    while frontier:
+        makespan, _, idx, costs, assign = heapq.heappop(frontier)
+        if idx == n:
+            best = (assign, makespan)
+            break
+        if len(frontier) > beam:
+            # complete greedily (LPT on remaining) from this best node
+            costs_l = list(costs)
+            assign_l = list(assign)
+            for i in range(idx, n):
+                options = [
+                    c + y[jj] * ws[i] if c + y[jj] * ws[i] <= caps[jj]
+                    else np.inf
+                    for jj, c in enumerate(costs_l)
+                ]
+                j = int(np.argmin(options))
+                if not np.isfinite(options[j]):
+                    raise ValueError(
+                        "no feasible schedule under the given memory caps "
+                        "(greedy completion hit an unplaceable workload)"
+                    )
+                costs_l[j] += y[j] * ws[i]
+                assign_l.append(j)
+            best = (tuple(assign_l), max(costs_l))
+            break
+        seen_states = set()  # symmetry breaking: identical (cost, speed,
+        # cap) workers produce identical subtrees — expand only one
+        for j in range(k):
+            sym_key = (costs[j], y[j], caps[j])
+            if sym_key in seen_states:
+                continue
+            seen_states.add(sym_key)
+            cost_j = costs[j] + y[j] * ws[idx]
+            if cost_j > caps[j]:
+                continue
+            new_costs = costs[:j] + (cost_j,) + costs[j + 1:]
+            counter += 1
+            heapq.heappush(frontier, (
+                max(makespan, cost_j), counter, idx + 1, new_costs,
+                assign + (j,),
+            ))
+    if best is None:
+        raise ValueError(
+            "no feasible schedule under the given memory caps"
+        )
+    assign_sorted, makespan = best
+    out = np.zeros(n, np.int32)
+    out[order] = np.asarray(assign_sorted, np.int32)
+    return out, float(makespan)

@@ -27,12 +27,26 @@ __all__ = ["load", "FedDataset", "REGISTRY", "DatasetSpec"]
 
 
 def _try_natural_partition(name: str, cache_dir: str, spec: DatasetSpec):
-    """LEAF-format on-disk loaders (None when files aren't staged)."""
+    """Naturally-partitioned on-disk loaders — LEAF JSON and Google-TFF h5
+    (None when files aren't staged)."""
     if name == "femnist":
         from .leaf import try_load_leaf_femnist
 
         return try_load_leaf_femnist(cache_dir)
-    if name in ("shakespeare", "fed_shakespeare"):
+    if name == "fed_cifar100":
+        from .tff_h5 import try_load_fed_cifar100
+
+        return try_load_fed_cifar100(cache_dir)
+    if name == "fed_shakespeare":
+        from .tff_h5 import try_load_fed_shakespeare
+
+        tff = try_load_fed_shakespeare(cache_dir)
+        if tff is not None:
+            return tff
+        from .leaf import try_load_leaf_shakespeare
+
+        return try_load_leaf_shakespeare(cache_dir, spec.seq_len)
+    if name == "shakespeare":
         from .leaf import try_load_leaf_shakespeare
 
         return try_load_leaf_shakespeare(cache_dir, spec.seq_len)

@@ -1,0 +1,128 @@
+"""Google-TFF-derived h5 readers: fed_cifar100 and fed_shakespeare.
+
+reference: ``data/fed_cifar100/data_loader.py`` (h5 ``examples/<client>/image``
+uint8 [n,32,32,3] + ``label``) and ``data/fed_shakespeare/data_loader.py`` +
+``utils.py`` (h5 ``examples/<client>/snippets`` byte strings; TFF's 86-char
+vocab with pad/bos/eos, 80-char windows, per-position NWP targets).
+
+Readers return NATURAL per-client partitions (same contract as the LEAF
+readers in ``leaf.py``) and activate only when the h5 files are staged under
+``data_cache_dir`` — no downloads.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_EXAMPLE = "examples"
+
+# TFF shakespeare vocab (reference data/fed_shakespeare/utils.py CHAR_VOCAB):
+# ids: 0 = pad, 1..86 chars, 87 = bos, 88 = eos — 89 total, matching the
+# registry's embedding size of 90
+CHAR_VOCAB = list(
+    "dhlptx@DHLPTX $(,048cgkoswCGKOSW[_#'/37;?bfjnrvzBFJNRVZ\"&*.26:"
+    "\naeimquyAEIMQUY]!%)-159\r"
+)
+_CHAR_TO_ID = {c: i + 1 for i, c in enumerate(CHAR_VOCAB)}
+BOS_ID = len(CHAR_VOCAB) + 1
+EOS_ID = len(CHAR_VOCAB) + 2
+SEQ_LEN = 80
+
+
+def _find(cache_dir: str, names: List[str]) -> Optional[str]:
+    for name in names:
+        for sub in ("", "fed_cifar100", "fed_shakespeare"):
+            p = os.path.join(cache_dir, sub, name)
+            if os.path.exists(p):
+                return p
+    return None
+
+
+def try_load_fed_cifar100(cache_dir: str):
+    """-> (client_xs, client_ys, test_x, test_y) or None."""
+    train_path = _find(cache_dir, ["fed_cifar100_train.h5"])
+    test_path = _find(cache_dir, ["fed_cifar100_test.h5"])
+    if train_path is None or test_path is None:
+        return None
+    import h5py
+
+    client_xs, client_ys = [], []
+    with h5py.File(train_path, "r") as h5:
+        for cid in sorted(h5[_EXAMPLE].keys()):
+            g = h5[_EXAMPLE][cid]
+            x = np.asarray(g["image"][()], np.float32) / 255.0
+            y = np.asarray(g["label"][()], np.int32)
+            if len(x):
+                client_xs.append(x)
+                client_ys.append(y)
+    if not client_xs:
+        return None
+    txs, tys = [], []
+    with h5py.File(test_path, "r") as h5:
+        for cid in sorted(h5[_EXAMPLE].keys()):
+            g = h5[_EXAMPLE][cid]
+            txs.append(np.asarray(g["image"][()], np.float32) / 255.0)
+            tys.append(np.asarray(g["label"][()], np.int32))
+    test_x = np.concatenate(txs) if txs else client_xs[0][:0]
+    test_y = np.concatenate(tys) if tys else client_ys[0][:0]
+    logger.info(
+        "fed_cifar100: %d TFF clients, %d test samples from %s",
+        len(client_xs), len(test_y), train_path,
+    )
+    return client_xs, client_ys, test_x, test_y
+
+
+def encode_snippet(text) -> np.ndarray:
+    """bos + chars + eos, split into SEQ_LEN windows with per-position
+    next-char targets (TFF preprocessing: to_ids → split → batch)."""
+    if isinstance(text, bytes):
+        text = text.decode("utf-8", errors="ignore")
+    ids = [BOS_ID] + [_CHAR_TO_ID.get(c, 0) for c in text] + [EOS_ID]
+    return np.asarray(ids, np.int32)
+
+
+def try_load_fed_shakespeare(cache_dir: str):
+    """-> (client_xs, client_ys, test_x, test_y) or None."""
+    train_path = _find(cache_dir, ["shakespeare_train.h5"])
+    test_path = _find(cache_dir, ["shakespeare_test.h5"])
+    if train_path is None or test_path is None:
+        return None
+    import h5py
+
+    def load_split(path):
+        xs, ys = [], []
+        with h5py.File(path, "r") as h5:
+            for cid in sorted(h5[_EXAMPLE].keys()):
+                stream: List[int] = []
+                for snip in h5[_EXAMPLE][cid]["snippets"][()]:
+                    stream.extend(encode_snippet(snip).tolist())
+                if len(stream) < 2:
+                    continue
+                arr = np.asarray(stream, np.int32)
+                n_win = max((len(arr) - 1) // SEQ_LEN, 1)
+                need = n_win * SEQ_LEN + 1
+                if len(arr) < need:
+                    arr = np.pad(arr, (0, need - len(arr)))
+                x = arr[: n_win * SEQ_LEN].reshape(n_win, SEQ_LEN)
+                y = arr[1: n_win * SEQ_LEN + 1].reshape(n_win, SEQ_LEN)
+                xs.append(x)
+                ys.append(y)
+        return xs, ys
+
+    client_xs, client_ys = load_split(train_path)
+    if not client_xs:
+        return None
+    txs, tys = load_split(test_path)
+    test_x = np.concatenate(txs) if txs else client_xs[0][:0]
+    test_y = np.concatenate(tys) if tys else client_ys[0][:0]
+    logger.info(
+        "fed_shakespeare: %d TFF clients, %d test windows from %s",
+        len(client_xs), len(test_x), train_path,
+    )
+    return client_xs, client_ys, test_x, test_y

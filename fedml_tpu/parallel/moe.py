@@ -1,0 +1,112 @@
+"""Mixture-of-Experts feed-forward with expert parallelism.
+
+New-capability work (SURVEY.md §2.5 "Expert parallelism / MoE" — the
+reference has no MoE at all; the ``expert`` mesh axis existed here as a
+constant only). Switch-Transformer-style design, TPU-native:
+
+- router: one [D, E] matmul → top-1 expert per token (+ optional top-2),
+  with the Switch load-balancing auxiliary loss
+- dense capacity-factor dispatch (GShard): tokens route into a
+  [E, capacity, D] buffer via one einsum with a one-hot dispatch mask —
+  static shapes, no ragged scatter, MXU end to end; over-capacity tokens
+  drop (pass through the residual unchanged)
+- expert FFNs are ONE stacked param tree [E, ...] vmapped over the expert
+  axis; the logical ``expert`` axis maps to the ``expert`` mesh axis
+  (sharding.LOGICAL_RULES), so under pjit the dispatch/combine einsums
+  lower to the all-to-alls of expert parallelism — no hand-written
+  collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .transformer import EMBED, MLP, TransformerConfig
+
+EXPERT_AXIS = "expert_dim"  # logical name for the stacked-expert axis
+
+
+class MoEFeedForward(nn.Module):
+    """Drop-in replacement for the dense FeedForward when cfg.moe_experts>1.
+
+    Returns ``(y, aux_loss)`` — the caller adds ``aux_loss`` (scaled by
+    ``cfg.moe_aux_weight``) to the task loss; without it the router
+    collapses onto one expert.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x) -> Tuple[jax.Array, jax.Array]:
+        cfg = self.cfg
+        E = cfg.moe_experts
+        D, F = cfg.d_model, cfg.d_ff
+        B, L, _ = x.shape
+        T = B * L
+        capacity = max(int(cfg.moe_capacity_factor * T / E), 1)
+        init = nn.initializers.normal(0.02)
+
+        w_router = self.param(
+            "w_router", nn.with_partitioning(init, (EMBED, None)),
+            (D, E), jnp.float32,
+        )
+        w_gate_up = self.param(
+            "w_gate_up",
+            nn.with_partitioning(init, (EXPERT_AXIS, EMBED, MLP)),
+            (E, D, 2 * F), cfg.param_dtype,
+        )
+        w_down = self.param(
+            "w_down",
+            nn.with_partitioning(init, (EXPERT_AXIS, MLP, EMBED)),
+            (E, F, D), cfg.param_dtype,
+        )
+
+        xt = x.reshape(T, D)
+        # routing in fp32 (tiny, numerically sensitive)
+        logits = xt.astype(jnp.float32) @ w_router  # [T, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)  # [T] top-1 (Switch)
+        expert_prob = jnp.take_along_axis(
+            probs, expert_idx[:, None], axis=-1
+        )[:, 0]
+
+        # Switch aux loss: E * Σ_e fraction_tokens_e * mean_prob_e
+        one_hot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [T, E]
+        frac = one_hot.mean(0)
+        mean_prob = probs.mean(0)
+        aux_loss = E * jnp.sum(frac * mean_prob)
+
+        # position of each token within its expert's capacity buffer
+        pos_in_expert = (jnp.cumsum(one_hot, axis=0) - 1.0) * one_hot  # [T, E]
+        pos = jnp.sum(pos_in_expert, axis=-1).astype(jnp.int32)  # [T]
+        keep = (pos < capacity).astype(jnp.float32)
+
+        # dispatch: [T, E, C] one-hot → expert inputs [E, C, D]
+        dispatch = (
+            one_hot[:, :, None]
+            * jax.nn.one_hot(pos, capacity, dtype=jnp.float32)[:, None, :]
+            * keep[:, None, None]
+        )
+        expert_in = jnp.einsum(
+            "tec,td->ecd", dispatch, xt.astype(jnp.float32)
+        ).astype(cfg.dtype)
+
+        def ffn(gu_w, down_w, h):
+            gu = jnp.einsum("cd,df->cf", h, gu_w.astype(cfg.dtype))
+            gate, up = jnp.split(gu, 2, axis=-1)
+            return jnp.einsum(
+                "cf,fd->cd", nn.silu(gate) * up, down_w.astype(cfg.dtype)
+            )
+
+        expert_out = jax.vmap(ffn)(w_gate_up, w_down, expert_in)  # [E, C, D]
+
+        # combine, scaled by the router prob (straight-through for dropped)
+        combine = dispatch * expert_prob[:, None, None]
+        y = jnp.einsum(
+            "tec,ecd->td", combine, expert_out.astype(jnp.float32)
+        ).astype(cfg.dtype)
+        return y.reshape(B, L, D), aux_loss

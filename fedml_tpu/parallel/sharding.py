@@ -45,8 +45,11 @@ SEQUENCE = constants.MESH_AXIS_SEQUENCE
 PIPELINE = constants.MESH_AXIS_PIPELINE
 EXPERT = constants.MESH_AXIS_EXPERT
 
+from .moe import EXPERT_AXIS  # noqa: E402  (no cycle: moe imports names only)
+
 # logical → mesh axis (t5x-style rules)
 LOGICAL_RULES = (
+    (EXPERT_AXIS, EXPERT),
     (EMBED, FSDP),
     (VOCAB, TENSOR),
     (HEADS, TENSOR),
@@ -69,7 +72,7 @@ def make_mesh(
     n = len(devices)
     if not shape:
         shape = {FSDP: n}
-    full = {DATA: 1, FSDP: 1, TENSOR: 1, SEQUENCE: 1}
+    full = {DATA: 1, FSDP: 1, TENSOR: 1, SEQUENCE: 1, EXPERT: 1}
     full.update(shape)
     if -1 in full.values():
         known = int(np.prod([s for s in full.values() if s != -1]))

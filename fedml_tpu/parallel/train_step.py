@@ -182,15 +182,30 @@ class CheetahTrainer:
 
     # -- train step ---------------------------------------------------------
     def _loss_fn(self, params, tokens, mask):
+        moe = self.cfg.moe_experts > 1
+        mutable = ["losses"] if moe else False
         if self.loss_chunk > 0:
-            hidden = self.model.apply(
-                {"params": params}, tokens, mask=None, return_hidden=True
+            out = self.model.apply(
+                {"params": params}, tokens, mask=None, return_hidden=True,
+                mutable=mutable,
             )
-            return lm_loss_chunked(
+            hidden, var_col = out if moe else (out, {})
+            loss = lm_loss_chunked(
                 hidden, params["w_lm_head"], tokens, mask, self.loss_chunk
             )
-        logits = self.model.apply({"params": params}, tokens, mask=None)
-        return lm_loss(logits, tokens, mask)
+        else:
+            out = self.model.apply(
+                {"params": params}, tokens, mask=None, mutable=mutable
+            )
+            logits, var_col = out if moe else (out, {})
+            loss = lm_loss(logits, tokens, mask)
+        if moe:
+            aux = sum(
+                jnp.sum(jnp.asarray(v))
+                for v in jax.tree.leaves(var_col.get("losses", {}))
+            )
+            loss = loss + self.cfg.moe_aux_weight * aux
+        return loss
 
     def _train_step_raw(self, state: TrainState, tokens, mask):
         """tokens/mask: [accum, micro_batch, L] when accum_steps > 1,

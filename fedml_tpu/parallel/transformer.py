@@ -53,6 +53,12 @@ class TransformerConfig:
     # "dots" saves matmul outputs and recomputes only elementwise/norm ops —
     # far cheaper backward for a modest activation-memory increase
     remat_policy: str = "full"
+    # Mixture-of-Experts FFN (parallel/moe.py): 0/1 = dense; >1 = that many
+    # experts, top-1 switch routing, stacked expert weights shardable over
+    # the `expert` mesh axis
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
     # "auto": Pallas splash attention on TPU (falls back to flash, then XLA),
     # elsewhere XLA. "splash" / "flash" / "xla" force one. The Pallas kernels
     # keep the [L, L] score matrix in VMEM tiles (never materialised in HBM)
@@ -309,6 +315,14 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, cos, sin, mask=None):
         x = x + Attention(self.cfg)(RMSNorm(self.cfg.norm_eps)(x), cos, sin, mask)
+        if self.cfg.moe_experts > 1:
+            from .moe import MoEFeedForward
+
+            y, aux = MoEFeedForward(self.cfg)(RMSNorm(self.cfg.norm_eps)(x))
+            # surfaced through the "losses" collection; the trainer adds
+            # moe_aux_weight * sum to the task loss
+            self.sow("losses", "moe_aux", aux)
+            return x + y
         x = x + FeedForward(self.cfg)(RMSNorm(self.cfg.norm_eps)(x))
         return x
 

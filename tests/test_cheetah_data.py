@@ -137,3 +137,80 @@ class TestLeafReaders:
         assert enc.shape == (5,)
         assert enc[3] == enc[4] == 0  # padding
         assert (enc[:3] > 0).all()
+
+
+def _write_tff_cifar(root):
+    import h5py
+
+    rng = np.random.RandomState(0)
+    for split, n_clients in (("train", 3), ("test", 2)):
+        path = os.path.join(root, f"fed_cifar100_{split}.h5")
+        with h5py.File(path, "w") as h5:
+            g = h5.create_group("examples")
+            for c in range(n_clients):
+                cg = g.create_group(f"client_{c}")
+                n = 5 + c
+                cg.create_dataset(
+                    "image", data=rng.randint(0, 255, (n, 32, 32, 3), np.uint8)
+                )
+                cg.create_dataset(
+                    "label", data=rng.randint(0, 100, (n,), np.int64)
+                )
+
+
+def _write_tff_shakespeare(root):
+    import h5py
+
+    for split in ("train", "test"):
+        path = os.path.join(root, f"shakespeare_{split}.h5")
+        with h5py.File(path, "w") as h5:
+            g = h5.create_group("examples")
+            for c in range(2):
+                cg = g.create_group(f"u{c}")
+                snippets = np.asarray(
+                    [b"to be or not to be that is the question " * 6], object
+                )
+                cg.create_dataset(
+                    "snippets",
+                    data=snippets.astype(h5py.string_dtype()),
+                )
+
+
+class TestTFFH5Readers:
+    def test_fed_cifar100_h5(self, tmp_path):
+        _write_tff_cifar(str(tmp_path))
+        args = fedml.init(Arguments(overrides=dict(
+            dataset="fed_cifar100", data_cache_dir=str(tmp_path),
+            client_num_in_total=3, client_num_per_round=2, batch_size=4,
+        )), should_init_logs=False)
+        ds, class_num = data_mod.load(args)
+        assert class_num == 100
+        assert ds.client_num == 3
+        assert ds.meta.get("natural_partition") is True
+        counts = [ds.client_shard(c)[2] for c in range(3)]
+        assert counts == [5, 6, 7]
+        assert ds.test_x.shape[1:] == (32, 32, 3)
+        assert float(ds.train_x.max()) <= 1.0
+
+    def test_fed_shakespeare_h5(self, tmp_path):
+        _write_tff_shakespeare(str(tmp_path))
+        args = fedml.init(Arguments(overrides=dict(
+            dataset="fed_shakespeare", data_cache_dir=str(tmp_path),
+            client_num_in_total=2, client_num_per_round=2, batch_size=2,
+        )), should_init_logs=False)
+        ds, class_num = data_mod.load(args)
+        assert class_num == 90
+        assert ds.client_num == 2
+        x, y, n = ds.client_shard(0)
+        assert n >= 3 and x.shape[1] == 80
+        # per-position next-char targets: y = x shifted by one
+        real = np.asarray(x[0], np.int32)
+        np.testing.assert_array_equal(np.asarray(y[0])[:-1], real[1:])
+        assert int(x.max()) < 90
+
+    def test_tff_vocab_ids_in_range(self):
+        from fedml_tpu.data.tff_h5 import BOS_ID, EOS_ID, encode_snippet
+
+        ids = encode_snippet("hello world")
+        assert ids[0] == BOS_ID and ids[-1] == EOS_ID
+        assert int(ids.max()) <= EOS_ID < 90

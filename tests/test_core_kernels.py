@@ -177,3 +177,45 @@ def test_two_node_ring_still_mixes():
     tm = topology.SymmetricTopologyManager(2, 2)
     tm.generate_topology()
     assert tm.get_in_neighbor_idx_list(0) == [1]
+
+
+class TestBranchAndBoundScheduler:
+    """reference core/schedule/scheduler.py:4-183 parity (VERDICT #22)."""
+
+    def test_beats_or_matches_lpt(self):
+        from fedml_tpu.core.schedule import (
+            branch_and_bound_schedule, lpt_schedule,
+        )
+
+        rng = np.random.RandomState(0)
+        for _ in range(5):
+            w = rng.randint(1, 50, size=10).astype(float)
+            speeds = rng.uniform(0.5, 2.0, size=3)
+            assign, makespan = branch_and_bound_schedule(w, speeds)
+            assert assign.shape == (10,)
+            # verify reported makespan
+            costs = np.zeros(3)
+            for i, j in enumerate(assign):
+                costs[j] += speeds[j] * w[i]
+            assert makespan == pytest.approx(costs.max())
+            # LPT upper bound: b&b must not be worse than greedy on
+            # homogeneous speeds
+        w = np.asarray([7, 5, 4, 3, 3, 2], float)
+        assign, mk = branch_and_bound_schedule(w, np.ones(2))
+        assert mk == pytest.approx(12.0)  # optimal split of 24 total
+
+    def test_memory_caps_respected(self):
+        from fedml_tpu.core.schedule import branch_and_bound_schedule
+
+        w = np.asarray([4.0, 4.0, 4.0, 4.0])
+        assign, mk = branch_and_bound_schedule(
+            w, np.ones(2), memory_caps=np.asarray([8.0, 100.0])
+        )
+        costs = np.zeros(2)
+        for i, j in enumerate(assign):
+            costs[j] += w[i]
+        assert costs[0] <= 8.0
+        with pytest.raises(ValueError):
+            branch_and_bound_schedule(
+                w, np.ones(1), memory_caps=np.asarray([1.0])
+            )
