@@ -22,6 +22,7 @@ from __future__ import annotations
 import base64
 import logging
 import queue
+import threading
 from typing import List
 
 from .base_com_manager import BaseCommunicationManager, CommunicationConstants, Observer
@@ -50,6 +51,7 @@ class MqttCommManager(BaseCommunicationManager):
         self._queue: "queue.Queue[bytes]" = queue.Queue()
         self._observers: List[Observer] = []
         self._running = False
+        self._subscribed = threading.Event()
         client_id = f"fedml-{run_id}-{rank}"
         try:  # paho-mqtt >= 2.0 requires the callback API version up front
             self._client = mqtt.Client(
@@ -70,10 +72,11 @@ class MqttCommManager(BaseCommunicationManager):
         self._client.on_message = self._on_mqtt_message
         # (re)subscribe in on_connect: paho auto-reconnects after a broker
         # blip but does NOT restore subscriptions on a clean session
-        self._client.on_connect = (
-            lambda client, userdata, flags, rc, *a:
+        def _on_connect(client, userdata, flags, rc, *a):
             client.subscribe(self._topic(self.rank), qos=self.qos)
-        )
+            self._subscribed.set()
+
+        self._client.on_connect = _on_connect
         self._client.connect(host, int(port), keepalive)
         self._client.loop_start()
         logger.info("mqtt backend: rank %d on %s:%d", rank, host, port)
@@ -99,6 +102,13 @@ class MqttCommManager(BaseCommunicationManager):
 
     def handle_receive_message(self) -> None:
         self._running = True
+        # don't declare readiness before our SUBSCRIBE is acknowledged:
+        # brokers drop publishes to subscriber-less topics, so an early
+        # ONLINE handshake from a peer would vanish
+        if not self._subscribed.wait(timeout=30.0):
+            logger.warning(
+                "mqtt backend: no CONNACK after 30s; proceeding anyway"
+            )
         self._notify(
             Message(CommunicationConstants.MSG_TYPE_CONNECTION_IS_READY,
                     self.rank, self.rank)

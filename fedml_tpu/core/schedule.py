@@ -106,9 +106,13 @@ def branch_and_bound_schedule(
             best = (assign, makespan)
             break
         if len(frontier) > beam:
-            # complete greedily (LPT on remaining) from this best node
+            # complete greedily (LPT on remaining) from this best node; if
+            # the greedy completion hits a cap, fall through to exact
+            # expansion of this node — other frontier nodes may still
+            # complete, so infeasibility here is NOT global infeasibility
             costs_l = list(costs)
             assign_l = list(assign)
+            feasible = True
             for i in range(idx, n):
                 options = [
                     c + y[jj] * ws[i] if c + y[jj] * ws[i] <= caps[jj]
@@ -117,14 +121,13 @@ def branch_and_bound_schedule(
                 ]
                 j = int(np.argmin(options))
                 if not np.isfinite(options[j]):
-                    raise ValueError(
-                        "no feasible schedule under the given memory caps "
-                        "(greedy completion hit an unplaceable workload)"
-                    )
+                    feasible = False
+                    break
                 costs_l[j] += y[j] * ws[i]
                 assign_l.append(j)
-            best = (tuple(assign_l), max(costs_l))
-            break
+            if feasible:
+                best = (tuple(assign_l), max(costs_l))
+                break
         seen_states = set()  # symmetry breaking: identical (cost, speed,
         # cap) workers produce identical subtrees — expand only one
         for j in range(k):
