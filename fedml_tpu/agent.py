@@ -1,0 +1,274 @@
+"""Edge/server agent daemons — the deployment plane.
+
+reference: ``cli/edge_deployment/client_runner.py`` (879 LoC) +
+``client_daemon.py`` / ``server_deployment/`` — ``fedml login`` binds the
+device to an account and starts a daemon that receives run requests from the
+MLOps platform (MQTT), downloads the training package, unpacks it, launches
+the user's entry point as a subprocess, and reports status transitions
+(IDLE → UPGRADING → INITIALIZING → TRAINING → FINISHED/FAILED,
+``client_constants.py:15-23``; server mirror at ``:25-31``).
+
+TPU re-grounding: pods receive work through shared storage, not a SaaS MQTT
+broker, so the job plane here is a *directory queue* on a filesystem both
+submitter and agent can see (NFS/GCS-fuse on a real pod; tmpdir in tests):
+
+- ``submit_job(package_zip, jobs_dir)`` drops the package built by
+  ``fedml_tpu build`` plus a JSON descriptor into the queue (the analog of
+  the platform's run-start MQTT message);
+- ``Agent.run_once()`` claims the oldest pending descriptor by atomic
+  rename (safe with many agents on one queue), unpacks the package, runs
+  its manifest entry point as a subprocess, and appends every status
+  transition to ``status.jsonl`` — the same observable FSM the reference
+  reports over MQTT;
+- a ``stop`` file next to the job descriptor is the kill switch (the
+  analog of the platform's stop-run message, client_runner's
+  cleanup_run_when_stopped).
+
+Login/logout keep their reference meaning — bind/unbind this host as a
+named edge device — but write a local state file instead of calling
+open.fedml.ai.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import subprocess
+import sys
+import time
+import uuid
+import zipfile
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("fedml_tpu.agent")
+
+# reference: client_constants.py:15-23 / :25-31 (shared transition names)
+STATUS_IDLE = "IDLE"
+STATUS_UPGRADING = "UPGRADING"          # unpacking the package
+STATUS_INITIALIZING = "INITIALIZING"    # entry process starting
+STATUS_RUNNING = "RUNNING"              # reference: TRAINING / RUNNING
+STATUS_STOPPING = "STOPPING"
+STATUS_FINISHED = "FINISHED"
+STATUS_FAILED = "FAILED"
+
+STATE_FILE = "agent_state.json"
+PENDING_SUFFIX = ".job.json"
+CLAIMED_SUFFIX = ".job.claimed"
+
+
+# ---------------------------------------------------------------------------
+# login / logout (reference: fedml login <account> -c|-s, fedml logout)
+# ---------------------------------------------------------------------------
+
+
+def login(account_id: str, role: str = "client",
+          state_dir: str = ".fedml_tpu_agent") -> Dict[str, Any]:
+    """Bind this host as an edge device (reference: client_login.py)."""
+    if role not in ("client", "server"):
+        raise ValueError(f"role must be client|server, got {role!r}")
+    os.makedirs(state_dir, exist_ok=True)
+    state = {
+        "account_id": str(account_id),
+        "role": role,
+        "device_id": f"{role}-{uuid.uuid4().hex[:12]}",
+        "bound_at": time.time(),
+    }
+    with open(os.path.join(state_dir, STATE_FILE), "w") as f:
+        json.dump(state, f, indent=2)
+    return state
+
+
+def logout(state_dir: str = ".fedml_tpu_agent") -> bool:
+    path = os.path.join(state_dir, STATE_FILE)
+    if os.path.exists(path):
+        os.remove(path)
+        return True
+    return False
+
+
+def agent_state(state_dir: str = ".fedml_tpu_agent") -> Optional[Dict[str, Any]]:
+    try:
+        with open(os.path.join(state_dir, STATE_FILE)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# job submission (the analog of the platform's run-start message)
+# ---------------------------------------------------------------------------
+
+
+def submit_job(package_zip: str, jobs_dir: str,
+               job_id: Optional[str] = None,
+               run_args: Optional[List[str]] = None) -> str:
+    """Queue a package built by ``fedml_tpu build`` for an agent to run."""
+    if not zipfile.is_zipfile(package_zip):
+        raise ValueError(f"{package_zip} is not a package zip")
+    os.makedirs(jobs_dir, exist_ok=True)
+    job_id = job_id or f"job-{uuid.uuid4().hex[:12]}"
+    pkg_dest = os.path.join(jobs_dir, f"{job_id}.zip")
+    shutil.copyfile(package_zip, pkg_dest)
+    desc = {
+        "job_id": job_id,
+        "package": os.path.basename(pkg_dest),
+        "run_args": run_args or [],
+        "submitted_at": time.time(),
+    }
+    tmp = os.path.join(jobs_dir, f".{job_id}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(desc, f)
+    # atomic publish: the descriptor appears only when fully written
+    os.replace(tmp, os.path.join(jobs_dir, f"{job_id}{PENDING_SUFFIX}"))
+    return job_id
+
+
+def request_stop(job_id: str, jobs_dir: str) -> None:
+    """Drop the stop file (analog of the platform's stop-run message)."""
+    with open(os.path.join(jobs_dir, f"{job_id}.stop"), "w") as f:
+        f.write(str(time.time()))
+
+
+# ---------------------------------------------------------------------------
+# the agent
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class JobResult:
+    job_id: str
+    status: str
+    returncode: Optional[int]
+    run_dir: str
+
+
+class Agent:
+    """Directory-queue job runner (reference: FedMLClientRunner FSM,
+    client_runner.py — download → unzip → bootstrap → launch → report)."""
+
+    def __init__(self, jobs_dir: str, work_dir: str, role: str = "client",
+                 python_exe: Optional[str] = None,
+                 poll_interval_s: float = 1.0):
+        self.jobs_dir = jobs_dir
+        self.work_dir = work_dir
+        self.role = role
+        self.python_exe = python_exe or sys.executable
+        self.poll_interval_s = poll_interval_s
+        os.makedirs(jobs_dir, exist_ok=True)
+        os.makedirs(work_dir, exist_ok=True)
+        self.status_path = os.path.join(work_dir, "status.jsonl")
+
+    # -- status reporting (reference: mlops_metrics report_*_status) --------
+
+    def _report(self, job_id: str, status: str, **extra) -> None:
+        rec = {"job_id": job_id, "status": status, "role": self.role,
+               "time": time.time(), **extra}
+        with open(self.status_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        logger.info("agent %s: %s -> %s", self.role, job_id, status)
+
+    def job_statuses(self, job_id: str) -> List[str]:
+        if not os.path.exists(self.status_path):
+            return []
+        out = []
+        with open(self.status_path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("job_id") == job_id:
+                    out.append(rec["status"])
+        return out
+
+    # -- queue claim --------------------------------------------------------
+
+    def _claim_next(self) -> Optional[Dict[str, Any]]:
+        pending = sorted(
+            fn for fn in os.listdir(self.jobs_dir)
+            if fn.endswith(PENDING_SUFFIX)
+        )
+        for fn in pending:
+            src = os.path.join(self.jobs_dir, fn)
+            dst = src[: -len(PENDING_SUFFIX)] + CLAIMED_SUFFIX
+            try:
+                os.rename(src, dst)  # atomic: exactly one agent wins
+            except OSError:
+                continue
+            with open(dst) as f:
+                return json.load(f)
+        return None
+
+    # -- one job ------------------------------------------------------------
+
+    def _unpack(self, desc: Dict[str, Any]) -> str:
+        pkg = os.path.join(self.jobs_dir, desc["package"])
+        run_dir = os.path.join(self.work_dir, desc["job_id"])
+        os.makedirs(run_dir, exist_ok=True)
+        with zipfile.ZipFile(pkg) as z:
+            base = os.path.realpath(run_dir)
+            for info in z.infolist():
+                target = os.path.realpath(os.path.join(run_dir, info.filename))
+                if not target.startswith(base + os.sep) and target != base:
+                    raise ValueError(
+                        f"package entry escapes run dir: {info.filename}"
+                    )
+            z.extractall(run_dir)
+        return run_dir
+
+    def _run_job(self, desc: Dict[str, Any]) -> JobResult:
+        job_id = desc["job_id"]
+        self._report(job_id, STATUS_UPGRADING)
+        try:
+            run_dir = self._unpack(desc)
+            manifest_path = os.path.join(run_dir, "fedml_package.json")
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+            entry = manifest.get("entry_point", "main.py")
+        except Exception as e:
+            self._report(job_id, STATUS_FAILED, error=str(e))
+            return JobResult(job_id, STATUS_FAILED, None, "")
+
+        self._report(job_id, STATUS_INITIALIZING, entry_point=entry)
+        stop_file = os.path.join(self.jobs_dir, f"{job_id}.stop")
+        log_path = os.path.join(run_dir, "job.log")
+        with open(log_path, "w") as log_f:
+            proc = subprocess.Popen(
+                [self.python_exe, entry, *desc.get("run_args", [])],
+                cwd=run_dir, stdout=log_f, stderr=subprocess.STDOUT,
+            )
+            self._report(job_id, STATUS_RUNNING, pid=proc.pid)
+            while proc.poll() is None:
+                if os.path.exists(stop_file):
+                    self._report(job_id, STATUS_STOPPING)
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                    break
+                time.sleep(0.1)
+            rc = proc.wait()
+        status = STATUS_FINISHED if rc == 0 else STATUS_FAILED
+        self._report(job_id, status, returncode=rc)
+        return JobResult(job_id, status, rc, run_dir)
+
+    # -- daemon loop --------------------------------------------------------
+
+    def run_once(self) -> Optional[JobResult]:
+        """Claim and run at most one pending job (test/cron entry)."""
+        desc = self._claim_next()
+        if desc is None:
+            return None
+        return self._run_job(desc)
+
+    def run_forever(self, max_jobs: Optional[int] = None) -> None:
+        """The daemon loop (reference: client_daemon.py restart loop)."""
+        self._report("-", STATUS_IDLE)
+        done = 0
+        while max_jobs is None or done < max_jobs:
+            result = self.run_once()
+            if result is None:
+                time.sleep(self.poll_interval_s)
+                continue
+            done += 1

@@ -2,11 +2,15 @@
 
 reference: ``python/fedml/cli/cli.py:29-685`` (click app: version / status /
 logs / login / logout / build / register / env). TPU re-grounding: argparse
-(no extra deps); the MLOps-platform commands (login/register against
-open.fedml.ai) are out of scope as platform glue (SURVEY.md §7 stage 8) —
-``build`` packages a training dir into a deployable zip, ``env`` collects the
+(no extra deps). ``build`` packages a training dir into a deployable zip
+(reference: build — client/server MLOps packages), ``env`` collects the
 environment report (reference: cli/env/collect_env.py:6-68), ``logs`` tails a
-run's JSONL event log.
+run's JSONL event log. The deployment surface binds to the directory-queue
+agent plane in ``fedml_tpu/agent.py``: ``login``/``logout`` bind/unbind this
+host as an edge device (reference: cli/edge_deployment/client_login.py),
+``launch`` submits a built package to a job queue, and ``agent`` runs the
+edge/server daemon that claims and executes queued jobs (reference:
+client_daemon.py / client_runner.py).
 
 Run as ``python -m fedml_tpu.cli <command>``.
 """
@@ -111,6 +115,49 @@ def cmd_build(args) -> int:
     return 0
 
 
+def cmd_login(args) -> int:
+    """Bind this host as an edge device (reference: fedml login)."""
+    from .agent import login
+
+    state = login(args.account_id, role=args.role, state_dir=args.state_dir)
+    print(f"bound as {state['role']} device {state['device_id']} "
+          f"(account {state['account_id']})")
+    return 0
+
+
+def cmd_logout(args) -> int:
+    from .agent import logout
+
+    print("unbound" if logout(state_dir=args.state_dir) else "not bound")
+    return 0
+
+
+def cmd_launch(args) -> int:
+    """Submit a built package to a job queue (reference: run-start msg)."""
+    from .agent import submit_job
+
+    job_id = submit_job(args.package, args.jobs_dir,
+                        run_args=args.run_args or [])
+    print(f"submitted {job_id} to {args.jobs_dir}")
+    return 0
+
+
+def cmd_agent(args) -> int:
+    """Run the edge/server job daemon (reference: client_daemon.py)."""
+    from .agent import Agent, agent_state
+
+    state = agent_state(state_dir=args.state_dir)
+    role = args.role or (state or {}).get("role", "client")
+    agent = Agent(args.jobs_dir, args.work_dir, role=role)
+    if args.once:
+        result = agent.run_once()
+        print("no pending jobs" if result is None
+              else f"{result.job_id}: {result.status}")
+        return 0 if result is None or result.status == "FINISHED" else 1
+    agent.run_forever(max_jobs=args.max_jobs)
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="fedml_tpu", description=__doc__)
     sub = parser.add_subparsers(dest="command")
@@ -130,6 +177,35 @@ def main(argv=None) -> int:
     p_build.add_argument("--entry_point", "-ep", default="")
     p_build.add_argument("--output", "-o", default="")
 
+    p_login = sub.add_parser("login", help="bind this host as an edge device")
+    p_login.add_argument("account_id")
+    p_login.add_argument("--role", "-r", choices=("client", "server"),
+                         default="client")
+    p_login.add_argument("--state_dir", default=".fedml_tpu_agent")
+
+    p_logout = sub.add_parser("logout", help="unbind this host")
+    p_logout.add_argument("--state_dir", default=".fedml_tpu_agent")
+
+    p_launch = sub.add_parser(
+        "launch", help="submit a package to a job queue",
+        usage="%(prog)s [--jobs_dir DIR] package [run_args ...]",
+    )
+    p_launch.add_argument("--jobs_dir", "-j", default=".fedml_tpu_jobs")
+    p_launch.add_argument("package")
+    # REMAINDER: everything after the package — flags included — goes to the
+    # job's entry point verbatim (launch options must precede the package):
+    #   fedml_tpu launch -j /queue pkg.zip --lr 0.1
+    p_launch.add_argument("run_args", nargs=argparse.REMAINDER)
+
+    p_agent = sub.add_parser("agent", help="run the edge/server job daemon")
+    p_agent.add_argument("--role", choices=("client", "server"), default="")
+    p_agent.add_argument("--jobs_dir", "-j", default=".fedml_tpu_jobs")
+    p_agent.add_argument("--work_dir", "-w", default=".fedml_tpu_work")
+    p_agent.add_argument("--state_dir", default=".fedml_tpu_agent")
+    p_agent.add_argument("--once", action="store_true",
+                         help="claim and run at most one job, then exit")
+    p_agent.add_argument("--max_jobs", type=int, default=None)
+
     args = parser.parse_args(argv)
     handlers = {
         "version": cmd_version,
@@ -137,6 +213,10 @@ def main(argv=None) -> int:
         "status": cmd_status,
         "logs": cmd_logs,
         "build": cmd_build,
+        "login": cmd_login,
+        "logout": cmd_logout,
+        "launch": cmd_launch,
+        "agent": cmd_agent,
     }
     if args.command is None:
         parser.print_help()

@@ -52,6 +52,7 @@ class MqttCommManager(BaseCommunicationManager):
         self._observers: List[Observer] = []
         self._running = False
         self._subscribed = threading.Event()
+        self._connect_error = None
         client_id = f"fedml-{run_id}-{rank}"
         try:  # paho-mqtt >= 2.0 requires the callback API version up front
             self._client = mqtt.Client(
@@ -73,6 +74,14 @@ class MqttCommManager(BaseCommunicationManager):
         # (re)subscribe in on_connect: paho auto-reconnects after a broker
         # blip but does NOT restore subscriptions on a clean session
         def _on_connect(client, userdata, flags, rc, *a):
+            # rc is an int in paho 1.x, a ReasonCode in 2.x; nonzero/failure
+            # means the broker refused us (bad auth) — surface it instead of
+            # declaring readiness on a dead connection
+            refused = (rc != 0) if isinstance(rc, int) else rc.is_failure
+            if refused:
+                self._connect_error = f"mqtt broker refused connection: {rc}"
+                logger.error(self._connect_error)
+                return
             client.subscribe(self._topic(self.rank), qos=self.qos)
             self._subscribed.set()
 
@@ -106,8 +115,11 @@ class MqttCommManager(BaseCommunicationManager):
         # brokers drop publishes to subscriber-less topics, so an early
         # ONLINE handshake from a peer would vanish
         if not self._subscribed.wait(timeout=30.0):
+            if self._connect_error is not None:
+                raise ConnectionError(self._connect_error)
             logger.warning(
-                "mqtt backend: no CONNACK after 30s; proceeding anyway"
+                "mqtt backend: subscribe not confirmed after 30s; "
+                "proceeding anyway"
             )
         self._notify(
             Message(CommunicationConstants.MSG_TYPE_CONNECTION_IS_READY,

@@ -1,0 +1,226 @@
+"""Runtime log daemon — background shipping of run logs to a sink.
+
+reference: ``core/mlops/mlops_runtime_log_daemon.py:14-362`` —
+MLOpsRuntimeLogProcessor tails ``fedml-run-{run}-edge-{edge}.log``, keeps a
+per-run uploaded-line index in ``log-config.yaml``, and POSTs batches of at
+most ``FED_LOG_LINE_NUMS_PER_UPLOADING`` lines every
+``FED_LOG_UPLOAD_FREQUENCY`` seconds to the MLOps log server;
+MLOpsRuntimeLogDaemon is the process-wide registry that starts/stops one
+processor per (run, edge).
+
+TPU re-grounding: same tail → index → batch → ship loop, but the shipping
+target is a pluggable *sink* instead of a hard-coded HTTPS endpoint, because
+a TPU pod job usually wants logs on shared storage (GCS/NFS) rather than a
+SaaS ingest. Three sinks ship built-in:
+
+- ``dir:<path>``  — append batches to ``<path>/run_<id>_edge_<id>.log``
+  (the shared-filesystem path a multi-host pod actually uses);
+- ``http(s)://…`` — POST a JSON body ``{run_id, edge_id, logs: [...]}``
+  (wire-compatible shape with the reference's uploader);
+- a Python callable ``sink(run_id, edge_id, lines) -> bool``.
+
+The daemon runs as a daemon *thread*, not a multiprocessing.Process like the
+reference: log shipping is IO-bound and the host side of a TPU program must
+not fork after the runtime initialises (fork-after-XLA-init deadlocks), so a
+thread is the correct TPU-host design. Upload state is a JSON index file, so
+a restarted process resumes where the last upload stopped — the same
+resume-by-line-index contract as the reference's ``log-config.yaml``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+logger = logging.getLogger("fedml_tpu.mlops.log_daemon")
+
+Sink = Union[str, Callable[[str, int, List[str]], bool]]
+
+# reference: FED_LOG_LINE_NUMS_PER_UPLOADING / FED_LOG_UPLOAD_FREQUENCY
+# (mlops_runtime_log_daemon.py:15-16)
+MAX_LINES_PER_BATCH = 1000
+DEFAULT_UPLOAD_INTERVAL_S = 1.0
+
+
+def _ship_to_dir(dest_dir: str, run_id: str, edge_id: int,
+                 lines: List[str]) -> bool:
+    os.makedirs(dest_dir, exist_ok=True)
+    path = os.path.join(dest_dir, f"run_{run_id}_edge_{edge_id}.log")
+    with open(path, "a") as f:
+        f.writelines(line if line.endswith("\n") else line + "\n"
+                     for line in lines)
+    return True
+
+
+def _ship_to_http(url: str, run_id: str, edge_id: int,
+                  lines: List[str]) -> bool:
+    """POST the reference uploader's body shape ({run_id, edge_id, logs})."""
+    import urllib.request
+
+    body = json.dumps(
+        {"run_id": run_id, "edge_id": edge_id, "logs": lines}
+    ).encode()
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return 200 <= resp.status < 300
+    except Exception as e:  # pragma: no cover - network-specific
+        logger.warning("log upload to %s failed: %s", url, e)
+        return False
+
+
+class LogProcessor:
+    """Tail one run's log file and ship new lines to the sink.
+
+    reference: MLOpsRuntimeLogProcessor (mlops_runtime_log_daemon.py:14-250)
+    — one instance per (run_id, edge_id), resumable via a line index.
+    """
+
+    def __init__(self, log_path: str, run_id: str, edge_id: int, sink: Sink,
+                 index_dir: Optional[str] = None,
+                 upload_interval_s: float = DEFAULT_UPLOAD_INTERVAL_S):
+        self.log_path = log_path
+        self.run_id = str(run_id)
+        self.edge_id = int(edge_id)
+        self.sink = sink
+        self.upload_interval_s = upload_interval_s
+        self.index_path = os.path.join(
+            index_dir or os.path.dirname(os.path.abspath(log_path)),
+            f".log_index_{self.run_id}_{self.edge_id}.json",
+        )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- index persistence (reference: load_log_config/save_log_config) -----
+
+    def _load_index(self) -> int:
+        """Uploaded byte offset (the reference tracks a line index; bytes
+        make resume O(new data) instead of a full re-read per cycle)."""
+        try:
+            with open(self.index_path) as f:
+                return int(json.load(f).get("uploaded_offset", 0))
+        except (OSError, ValueError):
+            return 0
+
+    def _save_index(self, offset: int) -> None:
+        tmp = self.index_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"uploaded_offset": offset}, f)
+        os.replace(tmp, self.index_path)
+
+    # -- shipping -----------------------------------------------------------
+
+    def _ship(self, lines: List[str]) -> bool:
+        if callable(self.sink):
+            return bool(self.sink(self.run_id, self.edge_id, lines))
+        if self.sink.startswith(("http://", "https://")):
+            return _ship_to_http(self.sink, self.run_id, self.edge_id, lines)
+        dest = self.sink[4:] if self.sink.startswith("dir:") else self.sink
+        return _ship_to_dir(dest, self.run_id, self.edge_id, lines)
+
+    def poll_once(self) -> int:
+        """One tail→batch→ship cycle; returns the number of lines shipped.
+
+        Only complete (newline-terminated) lines are consumed: a line the
+        writer is mid-way through stays unshipped until its newline lands,
+        so no line is ever shipped truncated. Reads seek to the uploaded
+        offset — O(new data) per cycle, not O(file).
+        """
+        if not os.path.exists(self.log_path):
+            return 0
+        offset = self._load_index()
+        with open(self.log_path, "rb") as f:
+            f.seek(offset)
+            chunk = f.read()
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return 0
+        raw_lines = chunk[: end + 1].splitlines(True)
+        shipped = 0
+        while shipped < len(raw_lines):
+            raw = raw_lines[shipped: shipped + MAX_LINES_PER_BATCH]
+            batch = [b.decode(errors="replace") for b in raw]
+            if not self._ship(batch):
+                break  # sink down: retry from the same offset next cycle
+            shipped += len(raw)
+            offset += sum(len(b) for b in raw)
+            self._save_index(offset)
+        return shipped
+
+    # -- thread lifecycle ---------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:  # keep the daemon alive on sink errors
+                logger.warning("log processor cycle failed: %s", e)
+            self._stop.wait(self.upload_interval_s)
+        self.poll_once()  # final drain
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name=f"log-daemon-{self.run_id}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+
+class MLOpsRuntimeLogDaemon:
+    """Process-wide registry of log processors.
+
+    reference: MLOpsRuntimeLogDaemon (mlops_runtime_log_daemon.py:253-362) —
+    ``get_instance(args)`` singleton with start/stop per (run, edge).
+    """
+
+    _instance: Optional["MLOpsRuntimeLogDaemon"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, sink: Sink):
+        self.sink = sink
+        self._processors: Dict[Tuple[str, int], LogProcessor] = {}
+
+    @classmethod
+    def get_instance(cls, sink: Sink) -> "MLOpsRuntimeLogDaemon":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls(sink)
+            return cls._instance
+
+    @classmethod
+    def reset_instance(cls) -> None:
+        with cls._lock:
+            if cls._instance is not None:
+                cls._instance.stop_all()
+            cls._instance = None
+
+    def start_log_processor(self, run_id: str, edge_id: int,
+                            log_path: str, **kw) -> LogProcessor:
+        key = (str(run_id), int(edge_id))
+        if key not in self._processors:
+            proc = LogProcessor(log_path, run_id, edge_id, self.sink, **kw)
+            proc.start()
+            self._processors[key] = proc
+        return self._processors[key]
+
+    def stop_log_processor(self, run_id: str, edge_id: int) -> None:
+        proc = self._processors.pop((str(run_id), int(edge_id)), None)
+        if proc is not None:
+            proc.stop()
+
+    def stop_all(self) -> None:
+        for key in list(self._processors):
+            self.stop_log_processor(*key)

@@ -1,0 +1,127 @@
+"""Remote run-config fetch with local caching.
+
+reference: ``core/mlops/mlops_configs.py:14-137`` — MLOpsConfigs singleton
+POSTs ``{"config_name": ["mqtt_config", "s3_config", ...]}`` to
+``…/fedmlOpsServer/configs/fetch`` (per config_version release/test/dev/
+local) and hands the returned transport endpoints to the agents.
+
+TPU re-grounding: the fetch contract is kept — named config sections
+resolved from a remote source at run start — but the source is a URI that
+covers how pod jobs actually receive config: ``http(s)://`` endpoints, plain
+file paths / ``file://`` URIs (shared filesystem), or an env-var override.
+Every successful fetch is cached to disk and the cache is the fallback when
+the source is unreachable, so a transient control-plane outage does not keep
+a pod from (re)starting — the failure-recovery behavior the reference's
+agents get from retrying MQTT/S3 config fetches.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("fedml_tpu.mlops.remote_config")
+
+ENV_CONFIG_URI = "FEDML_TPU_CONFIG_URI"
+DEFAULT_CACHE_DIR = ".fedml_tpu_runs"
+CACHE_FILE = "remote_config_cache.json"
+
+# reference: json_params config_name list (mlops_configs.py:79,96,113)
+DEFAULT_SECTIONS = ["mqtt_config", "s3_config", "ml_ops_config"]
+
+
+class RemoteConfig:
+    """Singleton fetch-with-cache (reference: MLOpsConfigs.get_instance)."""
+
+    _instance: Optional["RemoteConfig"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, uri: Optional[str] = None,
+                 cache_dir: str = DEFAULT_CACHE_DIR):
+        self.uri = uri or os.environ.get(ENV_CONFIG_URI, "")
+        self.cache_dir = cache_dir
+        self.cache_path = os.path.join(cache_dir, CACHE_FILE)
+
+    @classmethod
+    def get_instance(cls, uri: Optional[str] = None,
+                     cache_dir: str = DEFAULT_CACHE_DIR) -> "RemoteConfig":
+        with cls._lock:
+            if cls._instance is None or uri is not None:
+                cls._instance = cls(uri, cache_dir)
+            return cls._instance
+
+    @classmethod
+    def reset_instance(cls) -> None:
+        with cls._lock:
+            cls._instance = None
+
+    # -- sources ------------------------------------------------------------
+
+    def _fetch_raw(self) -> Dict[str, Any]:
+        uri = self.uri
+        if not uri:
+            raise FileNotFoundError("no config URI set (FEDML_TPU_CONFIG_URI)")
+        if uri.startswith(("http://", "https://")):
+            import urllib.request
+
+            req = urllib.request.Request(
+                uri, headers={"Accept": "application/json"}
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.loads(resp.read().decode())
+        path = uri[7:] if uri.startswith("file://") else uri
+        with open(path) as f:
+            return json.load(f)
+
+    # -- cache --------------------------------------------------------------
+
+    def _save_cache(self, cfg: Dict[str, Any]) -> None:
+        os.makedirs(self.cache_dir, exist_ok=True)
+        tmp = self.cache_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"fetched_at": time.time(), "config": cfg}, f)
+        os.replace(tmp, self.cache_path)
+
+    def _load_cache(self) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self.cache_path) as f:
+                return json.load(f)["config"]
+        except (OSError, ValueError, KeyError):
+            return None
+
+    # -- public API ---------------------------------------------------------
+
+    def fetch_configs(
+        self, sections: Optional[List[str]] = None
+    ) -> Dict[str, Any]:
+        """Resolve the named sections (reference: fetch_all_configs returning
+        (mqtt_config, s3_config, mlops_config, docker_config)).
+
+        Remote first; disk cache on failure; raises only when both miss.
+        """
+        sections = sections or DEFAULT_SECTIONS
+        try:
+            cfg = self._fetch_raw()
+            # the reference's endpoint nests payload under data
+            cfg = cfg.get("data", cfg) if isinstance(cfg, dict) else cfg
+            self._save_cache(cfg)
+        except Exception as e:
+            cached = self._load_cache()
+            if cached is None:
+                raise RuntimeError(
+                    f"remote config fetch failed ({e}) and no cache exists"
+                ) from e
+            logger.warning("remote config unreachable (%s); using cache", e)
+            cfg = cached
+        return {name: cfg.get(name, {}) for name in sections}
+
+
+def fetch_configs(uri: Optional[str] = None,
+                  sections: Optional[List[str]] = None,
+                  cache_dir: str = DEFAULT_CACHE_DIR) -> Dict[str, Any]:
+    """Module-level convenience mirroring MLOpsConfigs.fetch_all_configs."""
+    return RemoteConfig.get_instance(uri, cache_dir).fetch_configs(sections)
