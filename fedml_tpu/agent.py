@@ -151,12 +151,14 @@ class Agent:
 
     def __init__(self, jobs_dir: str, work_dir: str, role: str = "client",
                  python_exe: Optional[str] = None,
-                 poll_interval_s: float = 1.0):
+                 poll_interval_s: float = 1.0,
+                 stale_claim_s: float = 3600.0):
         self.jobs_dir = jobs_dir
         self.work_dir = work_dir
         self.role = role
         self.python_exe = python_exe or sys.executable
         self.poll_interval_s = poll_interval_s
+        self.stale_claim_s = stale_claim_s
         os.makedirs(jobs_dir, exist_ok=True)
         os.makedirs(work_dir, exist_ok=True)
         self.status_path = os.path.join(work_dir, "status.jsonl")
@@ -183,7 +185,31 @@ class Agent:
 
     # -- queue claim --------------------------------------------------------
 
+    def _requeue_stale_claims(self) -> None:
+        """A claim whose agent died mid-run must not strand the job: when a
+        ``.job.claimed`` file's mtime exceeds ``stale_claim_s``, rename it
+        back to pending (atomic; at most one reviver wins). The analog of
+        the reference daemon's restart-and-rerun loop (client_daemon.py)."""
+        now = time.time()
+        for fn in os.listdir(self.jobs_dir):
+            if not fn.endswith(CLAIMED_SUFFIX):
+                continue
+            path = os.path.join(self.jobs_dir, fn)
+            try:
+                age = now - os.path.getmtime(path)
+            except OSError:
+                continue  # finished and removed under us
+            if age < self.stale_claim_s:
+                continue
+            pending = path[: -len(CLAIMED_SUFFIX)] + PENDING_SUFFIX
+            try:
+                os.rename(path, pending)
+                logger.warning("requeued stale claim %s (%.0fs old)", fn, age)
+            except OSError:
+                pass
+
     def _claim_next(self) -> Optional[Dict[str, Any]]:
+        self._requeue_stale_claims()
         pending = sorted(
             fn for fn in os.listdir(self.jobs_dir)
             if fn.endswith(PENDING_SUFFIX)
@@ -231,7 +257,9 @@ class Agent:
 
         self._report(job_id, STATUS_INITIALIZING, entry_point=entry)
         stop_file = os.path.join(self.jobs_dir, f"{job_id}.stop")
+        claim_path = os.path.join(self.jobs_dir, f"{job_id}{CLAIMED_SUFFIX}")
         log_path = os.path.join(run_dir, "job.log")
+        last_heartbeat = time.time()
         with open(log_path, "w") as log_f:
             proc = subprocess.Popen(
                 [self.python_exe, entry, *desc.get("run_args", [])],
@@ -247,6 +275,13 @@ class Agent:
                     except subprocess.TimeoutExpired:
                         proc.kill()
                     break
+                now = time.time()
+                if now - last_heartbeat > 30.0:
+                    last_heartbeat = now
+                    try:  # keep the claim fresh so peers don't steal it
+                        os.utime(claim_path)
+                    except OSError:
+                        pass
                 time.sleep(0.1)
             rc = proc.wait()
         status = STATUS_FINISHED if rc == 0 else STATUS_FAILED
@@ -260,7 +295,13 @@ class Agent:
         desc = self._claim_next()
         if desc is None:
             return None
-        return self._run_job(desc)
+        result = self._run_job(desc)
+        try:  # the claim is done with — stop it looking like a stale one
+            os.remove(os.path.join(
+                self.jobs_dir, f"{desc['job_id']}{CLAIMED_SUFFIX}"))
+        except OSError:
+            pass
+        return result
 
     def run_forever(self, max_jobs: Optional[int] = None) -> None:
         """The daemon loop (reference: client_daemon.py restart loop)."""

@@ -158,6 +158,30 @@ def cmd_agent(args) -> int:
     return 0
 
 
+def cmd_multihost(args) -> int:
+    """Spawn N coordinated worker processes (analog: mpirun -np N).
+
+    reference: the MPI launch plane; here jax.distributed under one mesh —
+    see ``parallel/multihost.py``.
+    """
+    import sys as _sys
+
+    from .parallel.multihost import spawn
+
+    try:
+        results = spawn(
+            [args.script, *args.script_args],
+            n_processes=args.np, local_device_count=args.local_devices,
+            timeout_s=args.timeout,
+        )
+    except (RuntimeError, TimeoutError) as e:
+        print(e)
+        return 1
+    for r in results:
+        _sys.stdout.write(r.stdout)
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="fedml_tpu", description=__doc__)
     sub = parser.add_subparsers(dest="command")
@@ -206,6 +230,18 @@ def main(argv=None) -> int:
                          help="claim and run at most one job, then exit")
     p_agent.add_argument("--max_jobs", type=int, default=None)
 
+    p_mh = sub.add_parser(
+        "multihost", help="spawn N coordinated worker processes",
+        usage="%(prog)s [-np N] [--local_devices D] script [script_args ...]",
+    )
+    p_mh.add_argument("-np", type=int, default=2,
+                      help="number of worker processes")
+    p_mh.add_argument("--local_devices", type=int, default=1,
+                      help="virtual CPU devices per worker (emulation runs)")
+    p_mh.add_argument("--timeout", type=float, default=600.0)
+    p_mh.add_argument("script")
+    p_mh.add_argument("script_args", nargs=argparse.REMAINDER)
+
     args = parser.parse_args(argv)
     handlers = {
         "version": cmd_version,
@@ -217,6 +253,7 @@ def main(argv=None) -> int:
         "logout": cmd_logout,
         "launch": cmd_launch,
         "agent": cmd_agent,
+        "multihost": cmd_multihost,
     }
     if args.command is None:
         parser.print_help()

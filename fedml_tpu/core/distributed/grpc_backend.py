@@ -88,7 +88,10 @@ class GRPCCommManager(BaseCommunicationManager):
         )
         self._server.add_generic_rpc_handlers((handlers,))
         bind = f"{host}:{port}"
-        self._server.add_insecure_port(bind)
+        # grpc returns 0 (not an exception) when the bind fails — an
+        # unchecked 0 means a server that silently never receives
+        if self._server.add_insecure_port(bind) == 0:
+            raise OSError(f"grpc backend: could not bind {bind}")
         self._server.start()
         logger.info("grpc backend: rank %d serving at %s", rank, bind)
 

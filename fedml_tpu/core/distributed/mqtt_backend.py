@@ -52,6 +52,9 @@ class MqttCommManager(BaseCommunicationManager):
         self._observers: List[Observer] = []
         self._running = False
         self._subscribed = threading.Event()
+        # set on either outcome (subscribed OR refused) so waiters wake
+        # immediately on a definitive broker refusal
+        self._conn_resolved = threading.Event()
         self._connect_error = None
         client_id = f"fedml-{run_id}-{rank}"
         try:  # paho-mqtt >= 2.0 requires the callback API version up front
@@ -81,9 +84,11 @@ class MqttCommManager(BaseCommunicationManager):
             if refused:
                 self._connect_error = f"mqtt broker refused connection: {rc}"
                 logger.error(self._connect_error)
+                self._conn_resolved.set()
                 return
             client.subscribe(self._topic(self.rank), qos=self.qos)
             self._subscribed.set()
+            self._conn_resolved.set()
 
         self._client.on_connect = _on_connect
         self._client.connect(host, int(port), keepalive)
@@ -114,9 +119,10 @@ class MqttCommManager(BaseCommunicationManager):
         # don't declare readiness before our SUBSCRIBE is acknowledged:
         # brokers drop publishes to subscriber-less topics, so an early
         # ONLINE handshake from a peer would vanish
-        if not self._subscribed.wait(timeout=30.0):
-            if self._connect_error is not None:
-                raise ConnectionError(self._connect_error)
+        self._conn_resolved.wait(timeout=30.0)
+        if self._connect_error is not None:
+            raise ConnectionError(self._connect_error)
+        if not self._subscribed.is_set():
             logger.warning(
                 "mqtt backend: subscribe not confirmed after 30s; "
                 "proceeding anyway"

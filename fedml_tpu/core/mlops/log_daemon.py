@@ -43,6 +43,7 @@ Sink = Union[str, Callable[[str, int, List[str]], bool]]
 # reference: FED_LOG_LINE_NUMS_PER_UPLOADING / FED_LOG_UPLOAD_FREQUENCY
 # (mlops_runtime_log_daemon.py:15-16)
 MAX_LINES_PER_BATCH = 1000
+MAX_BYTES_PER_CYCLE = 8 * 1024 * 1024
 DEFAULT_UPLOAD_INTERVAL_S = 1.0
 
 
@@ -135,9 +136,17 @@ class LogProcessor:
         if not os.path.exists(self.log_path):
             return 0
         offset = self._load_index()
+        if offset > os.path.getsize(self.log_path):
+            # the file was truncated/rotated under us: start over
+            logger.warning("log %s shrank below offset %d; resetting",
+                           self.log_path, offset)
+            offset = 0
+            self._save_index(0)
         with open(self.log_path, "rb") as f:
             f.seek(offset)
-            chunk = f.read()
+            # cap per-cycle reads so attaching to a huge backlog doesn't
+            # spike host memory; the offset loop catches up next cycles
+            chunk = f.read(MAX_BYTES_PER_CYCLE)
         end = chunk.rfind(b"\n")
         if end < 0:
             return 0
@@ -162,7 +171,8 @@ class LogProcessor:
             except Exception as e:  # keep the daemon alive on sink errors
                 logger.warning("log processor cycle failed: %s", e)
             self._stop.wait(self.upload_interval_s)
-        self.poll_once()  # final drain
+        while self.poll_once():  # final drain, across read-cap cycles
+            pass
 
     def start(self) -> None:
         if self._thread is not None:

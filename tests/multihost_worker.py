@@ -1,0 +1,39 @@
+"""Worker entry for the multi-host launcher test (not collected by pytest).
+
+Joins the coordinated runtime, checks the global/local device split, and
+runs a cross-process collective: a global-sum over an array sharded across
+both processes' devices — the data path every mesh API rides multi-host.
+"""
+
+import numpy as np
+
+
+def main() -> None:
+    from fedml_tpu.parallel.multihost import initialize
+
+    initialize()  # env contract from spawn()
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.local_device_count() == 2, jax.local_device_count()
+    assert jax.device_count() == 4, jax.device_count()
+
+    from fedml_tpu.parallel.sharding import make_mesh
+
+    mesh = make_mesh({"data": 2, "fsdp": 2})
+    shard = NamedSharding(mesh, P(("data", "fsdp")))
+
+    # each device contributes its global position; the jitted sum crosses
+    # the process boundary through the coordinator-backed backend
+    x = jax.jit(lambda: jnp.arange(4.0), out_shardings=shard)()
+    total = jax.jit(jnp.sum)(x)
+    np.testing.assert_allclose(np.asarray(total), 6.0)
+
+    print(f"WORKER_OK rank={jax.process_index()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""gRPC bulk-tensor path: the role the reference assigns to TRPC.
+
+reference: ``core/distributed/communication/trpc/trpc_comm_manager.py`` —
+torch RPC exists in the reference specifically to move big model tensors
+between hosts; its gRPC manager caps messages at 1 GB. Here the single gRPC
+backend owns that role, so this proves a model-scale payload (a 64 MB
+float32 tree, bigger than any CIFAR-ResNet in the zoo) survives the wire
+bit-exact through the JSON+npz frame.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from fedml_tpu.core.distributed.grpc_backend import GRPCCommManager
+from fedml_tpu.core.distributed.message import Message
+
+
+class _Collector:
+    def __init__(self):
+        self.messages = []
+        self.got = threading.Event()
+
+    def receive_message(self, msg_type, msg):
+        if msg_type == "big_model":
+            self.messages.append(msg)
+            self.got.set()
+
+
+def _free_consecutive_ports(n: int) -> int:
+    """A base such that base..base+n-1 are all bindable right now."""
+    import socket
+
+    for _ in range(50):
+        with socket.socket() as probe:
+            probe.bind(("127.0.0.1", 0))
+            base = probe.getsockname()[1]
+        if base + n >= 65536:
+            continue
+        socks = []
+        try:
+            for i in range(n):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no consecutive free ports found")
+
+
+def test_64mb_model_payload_roundtrip():
+    base = _free_consecutive_ports(2)
+    sender = GRPCCommManager("127.0.0.1", base + 0, rank=0, world_size=2,
+                             base_port=base)
+    receiver = GRPCCommManager("127.0.0.1", base + 1, rank=1, world_size=2,
+                               base_port=base)
+    collector = _Collector()
+    receiver.add_observer(collector)
+    rx = threading.Thread(target=receiver.handle_receive_message, daemon=True)
+    rx.start()
+    try:
+        rng = np.random.default_rng(0)
+        arrays = [
+            rng.standard_normal((2048, 4096)).astype(np.float32),
+            rng.standard_normal((4096, 2048)).astype(np.float32),
+            rng.standard_normal((4096,)).astype(np.float32),
+        ]  # ≈ 64 MB
+        msg = Message("big_model", sender_id=0, receiver_id=1)
+        msg.add("num_arrays", len(arrays))
+        msg.set_arrays(arrays)
+        sender.send_message(msg)
+
+        assert collector.got.wait(timeout=120), "large payload never arrived"
+        got = collector.messages[0]
+        assert got.get("num_arrays") == len(arrays)
+        out = got.get_arrays()
+        assert len(out) == len(arrays)
+        for a, b in zip(arrays, out):
+            assert b.dtype == a.dtype
+            np.testing.assert_array_equal(b, a)
+    finally:
+        receiver.stop_receive_message()
+        sender.stop_receive_message()
+        rx.join(timeout=5)

@@ -85,6 +85,18 @@ def test_log_processor_failing_sink_keeps_index(tmp_path):
     assert calls[0] == calls[1]
 
 
+def test_log_processor_resets_on_truncation(tmp_path):
+    log = tmp_path / "run.log"
+    dest = tmp_path / "shipped"
+    _write_lines(log, ["old-1", "old-2", "old-3"])
+    proc = LogProcessor(str(log), "r4", 0, f"dir:{dest}")
+    assert proc.poll_once() == 3
+    log.write_text("new-1\n")  # rotation: file restarts smaller
+    assert proc.poll_once() == 1  # offset reset, new content ships
+    out = (dest / "run_r4_edge_0.log").read_text().splitlines()
+    assert out[-1] == "new-1"
+
+
 def test_log_daemon_registry(tmp_path):
     MLOpsRuntimeLogDaemon.reset_instance()
     log = tmp_path / "run.log"
@@ -205,6 +217,23 @@ def test_agent_rejects_zip_slip(tmp_path):
     assert result.status == STATUS_FAILED
     # '../../escape.py' relative to work/<job>/ would land in tmp_path itself
     assert not (tmp_path / "escape.py").exists()
+
+
+def test_agent_requeues_stale_claim(tmp_path):
+    pkg = _make_package(tmp_path, "ok2", "print('ran')\n")
+    jobs = str(tmp_path / "jobs")
+    job_id = submit_job(pkg, jobs)
+    # a dead agent's claim: rename pending → claimed and backdate it
+    src = os.path.join(jobs, f"{job_id}.job.json")
+    claimed = os.path.join(jobs, f"{job_id}.job.claimed")
+    os.rename(src, claimed)
+    old = 10_000.0
+    os.utime(claimed, (os.path.getmtime(claimed) - old,) * 2)
+
+    agent = Agent(jobs, str(tmp_path / "work"), stale_claim_s=3600.0)
+    result = agent.run_once()  # revives the orphan and runs it
+    assert result is not None and result.status == STATUS_FINISHED
+    assert not os.path.exists(claimed)  # finished claims are reaped
 
 
 def test_login_logout_roundtrip(tmp_path):
