@@ -1,0 +1,58 @@
+"""Application layer in one file: FedGraphNN / FedNLP / FedCV / healthcare.
+
+reference: ``python/app/`` — per-domain application dirs (fedgraphnn,
+fednlp, fedcv, healthcare; 456 files). Here every app task is the same
+five-line program with a different (dataset, model) pair, because each
+domain reduced to a (spec, model, loss) triple on the one engine.
+
+Run: ``python app_tasks.py`` (~a minute per task on one chip).
+"""
+
+import fedml_tpu as fedml
+from fedml_tpu import data as data_mod
+from fedml_tpu import models as model_mod
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.runner import FedMLRunner
+
+TASKS = [
+    # (banner, dataset, model, extra args)
+    ("FedGraphNN molecule graph clf", "moleculenet_clf", "gcn", {}),
+    ("FedGraphNN molecule graph reg", "moleculenet_reg", "gcn",
+     dict(learning_rate=0.02)),
+    ("FedGraphNN ego node clf", "ego_node_clf", "sage", {}),
+    ("FedGraphNN ego link pred", "ego_link_pred", "gcn", {}),
+    # LSTMs under plain SGD need a hot lr and a few more rounds
+    ("FedNLP sequence tagging", "fednlp_seq_tagging", "bilstm_tagger",
+     dict(learning_rate=1.0, comm_round=12, epochs=3)),
+    ("FedNLP span extraction", "fednlp_span_extraction", "span_extractor",
+     dict(learning_rate=1.0, comm_round=12, epochs=3)),
+    # reversal is a copy task: attention learns it, a small LSTM cannot
+    ("FedNLP seq2seq (prefix-LM)", "fednlp_seq2seq", "transformer",
+     dict(learning_rate=0.3, comm_round=12, epochs=3)),
+    ("FedCV detection", "coco128_det", "centernet",
+     dict(batch_size=8, learning_rate=0.05)),
+    ("Healthcare heart disease", "fed_heart_disease", "lr", {}),
+    ("Healthcare TCGA-BRCA survival", "fed_tcga_brca", "lr",
+     dict(learning_rate=0.05)),
+]
+
+
+def run_task(banner, dataset, model, extra):
+    overrides = dict(
+        dataset=dataset, model=model, client_num_in_total=8,
+        client_num_per_round=8, comm_round=8, epochs=2, batch_size=16,
+        learning_rate=0.1, frequency_of_the_test=20, backend="sp",
+    )
+    overrides.update(extra)
+    args = fedml.init(Arguments(overrides=overrides), should_init_logs=False)
+    ds, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    res = FedMLRunner(args, fedml.get_device(args), ds, bundle).run()
+    acc = res.get("test_acc")
+    print(f"{banner:34s} loss={res['test_loss']:.3f}"
+          + (f" acc={acc:.3f}" if acc == acc else ""))
+
+
+if __name__ == "__main__":
+    for task in TASKS:
+        run_task(*task)

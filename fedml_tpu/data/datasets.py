@@ -34,12 +34,15 @@ class DatasetSpec:
     name: str
     sample_shape: Tuple[int, ...]
     class_num: int
-    task: str  # classification | nwp | tagpred
+    task: str  # classification | nwp | tagpred | segmentation | regression
+    #           | node_clf | link_pred
     default_clients: int
     train_per_client: int  # synthetic samples per client
     test_total: int
     vocab_size: int = 0  # text tasks
     seq_len: int = 0
+    n_nodes: int = 0  # graph tasks: padded node count (packed dense block)
+    n_feats: int = 0  # graph tasks: node feature width
 
 
 REGISTRY = {
@@ -86,6 +89,68 @@ REGISTRY = {
     # CIFAR-10 shapes; poisoning is applied by the attack layer, not the data.
     "edge_case_examples": DatasetSpec(
         "edge_case_examples", (32, 32, 3), 10, "classification", 100, 200, 1000
+    ),
+    # FedCV detection (reference: python/app/fedcv/object_detection —
+    # YOLOv5/coco128; dense CenterNet-style targets here, see
+    # models/detection.py). classification + segmentation FedCV tasks ride
+    # the standard vision datasets above.
+    "coco128_det": DatasetSpec(
+        "coco128_det", (32, 32, 3), 6, "detection", 8, 40, 160
+    ),
+    # Healthcare / FLamby family (reference: python/app/healthcare/*) —
+    # tabular & imaging tasks mapped onto their natural task types
+    "fed_heart_disease": DatasetSpec(
+        "fed_heart_disease", (13,), 2, "classification", 4, 40, 160
+    ),
+    "fed_isic2019": DatasetSpec(
+        "fed_isic2019", (32, 32, 3), 8, "classification", 6, 60, 240
+    ),
+    "fed_tcga_brca": DatasetSpec(
+        "fed_tcga_brca", (39,), 1, "regression", 6, 40, 160
+    ),
+    # FedNLP task family (reference: python/app/fednlp/{seq_tagging,
+    # span_extraction,seq2seq}); text_classification rides the standard
+    # classification datasets
+    "fednlp_seq_tagging": DatasetSpec(
+        "fednlp_seq_tagging", (24,), 9, "seq_tagging", 8, 48, 192,
+        vocab_size=128, seq_len=24,
+    ),
+    "fednlp_span_extraction": DatasetSpec(
+        "fednlp_span_extraction", (32,), 32, "span_extraction", 8, 48, 192,
+        vocab_size=64, seq_len=32,
+    ),
+    # seq2seq as a prefix-LM: [src ; SEP ; tgt] packed, loss masked to the
+    # target region via pad id 0 (the TPU-idiomatic decoder-only framing)
+    "fednlp_seq2seq": DatasetSpec(
+        "fednlp_seq2seq", (33,), 32, "nwp", 8, 48, 192,
+        vocab_size=32, seq_len=33,
+    ),
+    # graphs — FedGraphNN family (reference: python/app/fedgraphnn/*);
+    # packed dense blocks [N, F+N+1] (models/gnn.py), generated in
+    # data/graphs.py. sample_shape = (n_nodes, n_feats + n_nodes + 1).
+    "moleculenet_clf": DatasetSpec(
+        "moleculenet_clf", (24, 8 + 24 + 1), 2, "classification", 8, 48, 192,
+        n_nodes=24, n_feats=8,
+    ),
+    "moleculenet_reg": DatasetSpec(
+        "moleculenet_reg", (24, 8 + 24 + 1), 1, "regression", 8, 48, 192,
+        n_nodes=24, n_feats=8,
+    ),
+    "social_graph_clf": DatasetSpec(
+        "social_graph_clf", (32, 4 + 32 + 1), 3, "classification", 8, 48, 192,
+        n_nodes=32, n_feats=4,
+    ),
+    "ego_node_clf": DatasetSpec(
+        "ego_node_clf", (32, 16 + 32 + 1), 5, "node_clf", 8, 32, 128,
+        n_nodes=32, n_feats=16,
+    ),
+    "ego_link_pred": DatasetSpec(
+        "ego_link_pred", (32, 16 + 32 + 1), 4, "link_pred", 8, 32, 128,
+        n_nodes=32, n_feats=16,
+    ),
+    "recsys_link_pred": DatasetSpec(
+        "recsys_link_pred", (48, 16 + 48 + 1), 6, "link_pred", 8, 24, 96,
+        n_nodes=48, n_feats=16,
     ),
 }
 
@@ -253,6 +318,83 @@ def synth_nwp(spec: DatasetSpec, n_train: int, n_test: int, seed: int):
     return tx, shift(tx), ex, shift(ex)
 
 
+def synth_seq_tagging(spec: DatasetSpec, n_train: int, n_test: int, seed: int):
+    """Per-token tags: a token's tag is its vocab block, EXCEPT after a
+    trigger token, which shifts the next tag by one — so context (the BiLSTM)
+    beats a per-token lookup. Padding tail labeled -1."""
+    rng = np.random.RandomState(seed)
+    V, L, C = spec.vocab_size, spec.seq_len, spec.class_num
+    block = max(1, V // C)
+    trigger = 0  # token id 0 is the trigger
+
+    def make(n, rng):
+        x = rng.randint(1, V, size=(n, L)).astype(np.int32)
+        x[rng.rand(n, L) < 0.15] = trigger
+        base = np.minimum(x // block, C - 1)
+        prev_trigger = np.zeros_like(x, dtype=bool)
+        prev_trigger[:, 1:] = x[:, :-1] == trigger
+        y = np.where(prev_trigger, (base + 1) % C, base).astype(np.int32)
+        # ragged lengths: tail beyond each sample's length is padding
+        lengths = rng.randint(L // 2, L + 1, size=n)
+        pad = np.arange(L)[None, :] >= lengths[:, None]
+        y[pad] = -1
+        return x, y
+
+    tx, ty = make(n_train, rng)
+    ex, ey = make(n_test, rng)
+    return tx, ty, ex, ey
+
+
+def synth_span_extraction(spec: DatasetSpec, n_train: int, n_test: int, seed: int):
+    """QA-style pointer task: context tokens come from the low half of the
+    vocab, one contiguous answer span from the high half; y = (start, end)."""
+    rng = np.random.RandomState(seed)
+    V, L = spec.vocab_size, spec.seq_len
+    half = V // 2
+
+    def make(n, rng):
+        x = rng.randint(1, half, size=(n, L)).astype(np.int32)
+        starts = rng.randint(0, L - 4, size=n)
+        lens = rng.randint(1, 5, size=n)
+        ends = np.minimum(starts + lens - 1, L - 1)
+        for i in range(n):
+            x[i, starts[i]: ends[i] + 1] = rng.randint(
+                half, V, size=ends[i] - starts[i] + 1
+            )
+        y = np.stack([starts, ends], axis=1).astype(np.int32)
+        return x, y
+
+    tx, ty = make(n_train, rng)
+    ex, ey = make(n_test, rng)
+    return tx, ty, ex, ey
+
+
+def synth_seq2seq(spec: DatasetSpec, n_train: int, n_test: int, seed: int):
+    """Prefix-LM seq2seq: src is random tokens, tgt is src reversed,
+    packed [src ; SEP ; tgt]. NWP targets are 0 (masked) everywhere except
+    the target region — the loss trains only the seq2seq mapping."""
+    rng = np.random.RandomState(seed)
+    V, L = spec.vocab_size, spec.seq_len
+    src_len = (L - 1) // 2
+    sep = V - 1
+
+    def make(n, rng):
+        src = rng.randint(1, V - 1, size=(n, src_len)).astype(np.int32)
+        tgt = src[:, ::-1]
+        x = np.concatenate(
+            [src, np.full((n, 1), sep, np.int32), tgt], axis=1
+        )
+        y = np.zeros_like(x)
+        # predict tgt tokens from the position before each (SEP predicts
+        # tgt[0]); everything else is pad-masked
+        y[:, src_len: src_len + src_len] = tgt
+        return x, y
+
+    tx, ty = make(n_train, rng)
+    ex, ey = make(n_test, rng)
+    return tx, ty, ex, ey
+
+
 def synth_segmentation(spec: DatasetSpec, n_train: int, n_test: int, seed: int):
     """Images of colored rectangles; labels = class id per pixel (background
     0). Learnable: each class has a distinct mean color."""
@@ -278,6 +420,54 @@ def synth_segmentation(spec: DatasetSpec, n_train: int, n_test: int, seed: int):
     return tx, ty, ex, ey
 
 
+def synth_regression(spec: DatasetSpec, n_train: int, n_test: int, seed: int):
+    """Tabular regression (fed_tcga_brca survival analog): y = x·w + ε."""
+    rng = np.random.RandomState(seed)
+    dim = int(np.prod(spec.sample_shape))
+    w = rng.randn(dim).astype(np.float32) / np.sqrt(dim)
+
+    def make(n, rng):
+        x = rng.randn(n, dim).astype(np.float32)
+        y = (x @ w + rng.randn(n).astype(np.float32) * 0.1).astype(np.float32)
+        return x.reshape((n,) + spec.sample_shape), y
+
+    tx, ty = make(n_train, rng)
+    ex, ey = make(n_test, rng)
+    return tx, ty, ex, ey
+
+
+def synth_detection(spec: DatasetSpec, n_train: int, n_test: int, seed: int):
+    """Images with 1-3 colored rectangles; dense stride-4 CenterNet-style
+    targets (models/detection.py layout): per-cell one-hot class heatmap ++
+    normalized (h, w) ++ center mask. Class = rectangle color prototype."""
+    rng = np.random.RandomState(seed)
+    H, W, _ = spec.sample_shape
+    C = spec.class_num
+    Hs, Ws = H // 4, W // 4
+    protos = rng.rand(C, 3).astype(np.float32) * 2 - 1
+
+    def make(n, rng):
+        x = rng.randn(n, H, W, 3).astype(np.float32) * 0.3
+        y = np.zeros((n, Hs, Ws, C + 3), np.float32)
+        for i in range(n):
+            for _ in range(rng.randint(1, 4)):
+                c = rng.randint(0, C)
+                dh, dw = rng.randint(6, 14), rng.randint(6, 14)
+                h0 = rng.randint(0, H - dh)
+                w0 = rng.randint(0, W - dw)
+                x[i, h0:h0 + dh, w0:w0 + dw] += protos[c]
+                cy, cx = (h0 + dh // 2) // 4, (w0 + dw // 2) // 4
+                y[i, cy, cx, :C] = 0.0
+                y[i, cy, cx, c] = 1.0
+                y[i, cy, cx, C:C + 2] = (dh / H, dw / W)
+                y[i, cy, cx, -1] = 1.0
+        return x, y
+
+    tx, ty = make(n_train, rng)
+    ex, ey = make(n_test, rng)
+    return tx, ty, ex, ey
+
+
 def load_raw(spec: DatasetSpec, cache_dir: str, n_train: int, n_test: int, seed: int):
     """Real data if cached on disk, else synthetic with identical shapes."""
     if spec.name == "mnist":
@@ -291,6 +481,20 @@ def load_raw(spec: DatasetSpec, cache_dir: str, n_train: int, n_test: int, seed:
             logger.info("%s: using real pickle batches from %s", spec.name, cache_dir)
             return real
     logger.info("%s: synthetic fallback (%d train / %d test)", spec.name, n_train, n_test)
+    if spec.n_nodes > 0:  # FedGraphNN family: packed dense graph blocks
+        from .graphs import synth_graph
+
+        return synth_graph(spec, n_train, n_test, seed)
+    if spec.task == "seq_tagging":
+        return synth_seq_tagging(spec, n_train, n_test, seed)
+    if spec.task == "span_extraction":
+        return synth_span_extraction(spec, n_train, n_test, seed)
+    if spec.name == "fednlp_seq2seq":
+        return synth_seq2seq(spec, n_train, n_test, seed)
+    if spec.task == "detection":
+        return synth_detection(spec, n_train, n_test, seed)
+    if spec.task == "regression":
+        return synth_regression(spec, n_train, n_test, seed)
     if spec.task == "classification":
         return synth_classification(spec, n_train, n_test, seed)
     if spec.task == "tagpred":

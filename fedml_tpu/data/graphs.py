@@ -1,0 +1,153 @@
+"""Synthetic graph datasets for the FedGraphNN application family.
+
+reference: ``python/app/fedgraphnn/`` stages MoleculeNet (graph clf/reg),
+ego-network (node clf / link pred), social-network (graph clf) and recsys
+(subgraph link pred) datasets through torch-geometric sparse loaders with
+per-client natural splits.
+
+TPU re-grounding: graphs are generated directly in the packed dense-block
+layout the models consume (``models/gnn.py``: ``[N, F+N+1]`` = features,
+dense adjacency, node mask), deterministic and *learnable* — labels are
+planted in feature prototypes, homophilous edges, and structure — so the
+"tiny-config real training" smoke pattern (SURVEY.md §4) holds for every
+graph task without torch-geometric or downloads.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _pack_np(feats: np.ndarray, adj: np.ndarray, mask: np.ndarray) -> np.ndarray:
+    return np.concatenate([feats, adj, mask[..., None]], axis=-1)
+
+
+def _random_masks(rng, n_graphs: int, n_nodes: int) -> np.ndarray:
+    """Real node counts vary (padding realism): n_i ∈ [N/2, N]."""
+    counts = rng.randint(n_nodes // 2, n_nodes + 1, size=n_graphs)
+    mask = np.zeros((n_graphs, n_nodes), np.float32)
+    for i, c in enumerate(counts):
+        mask[i, :c] = 1.0
+    return mask
+
+
+def _er_adj(rng, mask: np.ndarray, p: np.ndarray) -> np.ndarray:
+    """Symmetric Erdős–Rényi adjacency per graph; ``p`` broadcastable to
+    [G, N, N]. Padding rows/cols zeroed."""
+    g, n = mask.shape
+    up = (rng.rand(g, n, n) < p).astype(np.float32)
+    up = np.triu(up, 1)
+    adj = up + np.swapaxes(up, -1, -2)
+    pair = mask[:, :, None] * mask[:, None, :]
+    return adj * pair
+
+
+def synth_graph_clf(spec, n_train: int, n_test: int, seed: int):
+    """Graph classification (MoleculeNet clf / social-network clf analog):
+    class plants a feature prototype on every node AND an edge density."""
+    rng = np.random.RandomState(seed)
+    N, F, C = spec.n_nodes, spec.n_feats, spec.class_num
+    protos = rng.randn(C, F).astype(np.float32)
+    densities = np.linspace(0.1, 0.5, C)
+
+    def make(n, rng):
+        y = rng.randint(0, C, size=n).astype(np.int32)
+        mask = _random_masks(rng, n, N)
+        feats = (protos[y][:, None, :] * 0.6 +
+                 rng.randn(n, N, F).astype(np.float32) * 0.8)
+        feats *= mask[..., None]
+        adj = _er_adj(rng, mask, densities[y][:, None, None])
+        return _pack_np(feats, adj, mask), y
+
+    tx, ty = make(n_train, rng)
+    ex, ey = make(n_test, rng)
+    return tx, ty, ex, ey
+
+
+def synth_graph_reg(spec, n_train: int, n_test: int, seed: int):
+    """Graph regression (MoleculeNet reg analog): target is a fixed linear
+    functional of mean node features and mean degree."""
+    rng = np.random.RandomState(seed)
+    N, F = spec.n_nodes, spec.n_feats
+    w = rng.randn(F).astype(np.float32)
+
+    def make(n, rng):
+        mask = _random_masks(rng, n, N)
+        feats = rng.randn(n, N, F).astype(np.float32) * mask[..., None]
+        dens = rng.rand(n).astype(np.float32) * 0.4 + 0.1
+        adj = _er_adj(rng, mask, dens[:, None, None])
+        nodes = np.maximum(mask.sum(-1), 1.0)
+        mean_feat = feats.sum(1) / nodes[:, None]
+        mean_deg = adj.sum((1, 2)) / nodes
+        y = (mean_feat @ w + 0.5 * mean_deg).astype(np.float32)
+        y += rng.randn(n).astype(np.float32) * 0.05
+        return _pack_np(feats, adj, mask), y
+
+    tx, ty = make(n_train, rng)
+    ex, ey = make(n_test, rng)
+    return tx, ty, ex, ey
+
+
+def synth_node_clf(spec, n_train: int, n_test: int, seed: int):
+    """Node classification (ego-network analog): homophilous communities —
+    same-class nodes connect densely, features carry a noisy prototype.
+    Labels are per-node ints, padding marked -1."""
+    rng = np.random.RandomState(seed)
+    N, F, C = spec.n_nodes, spec.n_feats, spec.class_num
+    protos = rng.randn(C, F).astype(np.float32)
+
+    def make(n, rng):
+        mask = _random_masks(rng, n, N)
+        node_y = rng.randint(0, C, size=(n, N)).astype(np.int32)
+        feats = (protos[node_y] * 0.5 +
+                 rng.randn(n, N, F).astype(np.float32) * 1.0)
+        feats *= mask[..., None]
+        same = (node_y[:, :, None] == node_y[:, None, :])
+        p = np.where(same, 0.5, 0.04)
+        adj = _er_adj(rng, mask, p)
+        y = np.where(mask > 0, node_y, -1).astype(np.int32)
+        return _pack_np(feats, adj, mask), y
+
+    tx, ty = make(n_train, rng)
+    ex, ey = make(n_test, rng)
+    return tx, ty, ex, ey
+
+
+def synth_link_pred(spec, n_train: int, n_test: int, seed: int):
+    """Link prediction (ego / recsys-subgraph analog): community graphs;
+    the model sees an adjacency with 30% of edges held out and must score
+    the full one. Target y = ``[N, N+1]`` (full adjacency ++ node mask)."""
+    rng = np.random.RandomState(seed)
+    N, F = spec.n_nodes, spec.n_feats
+    K = max(2, spec.class_num)
+    protos = rng.randn(K, F).astype(np.float32)
+
+    def make(n, rng):
+        mask = _random_masks(rng, n, N)
+        comm = rng.randint(0, K, size=(n, N))
+        feats = (protos[comm] * 0.7 +
+                 rng.randn(n, N, F).astype(np.float32) * 0.6)
+        feats *= mask[..., None]
+        same = comm[:, :, None] == comm[:, None, :]
+        full = _er_adj(rng, mask, np.where(same, 0.6, 0.03))
+        keep = np.triu((rng.rand(n, N, N) >= 0.3), 1)
+        keep = keep + np.swapaxes(keep, -1, -2)
+        visible = full * keep
+        y = np.concatenate([full, mask[..., None]], axis=-1).astype(np.float32)
+        return _pack_np(feats, visible, mask), y
+
+    tx, ty = make(n_train, rng)
+    ex, ey = make(n_test, rng)
+    return tx, ty, ex, ey
+
+
+SYNTH_BY_TASK = {
+    "classification": synth_graph_clf,
+    "regression": synth_graph_reg,
+    "node_clf": synth_node_clf,
+    "link_pred": synth_link_pred,
+}
+
+
+def synth_graph(spec, n_train: int, n_test: int, seed: int):
+    return SYNTH_BY_TASK[spec.task](spec, n_train, n_test, seed)

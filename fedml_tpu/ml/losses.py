@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import Dict, Tuple
 
+import jax
 import jax.numpy as jnp
 import optax
 
@@ -54,15 +55,27 @@ def tagpred_loss(logits, y, sample_mask) -> Tuple[jnp.ndarray, Metrics]:
 
 
 def segmentation_loss(logits, y, sample_mask) -> Tuple[jnp.ndarray, Metrics]:
-    """Per-pixel CE. logits [B, H, W, C], y [B, H, W] int labels.
+    """Class-balanced per-pixel CE. logits [B, H, W, C], y [B, H, W] ints.
 
-    reference: ``simulation/mpi/fedseg/utils.py`` SegmentationLosses (CE mode)
-    + pixel-accuracy Evaluator; mIoU is computed by the FedSeg eval pass.
+    reference: ``simulation/mpi/fedseg/utils.py`` SegmentationLosses (CE /
+    focal modes with class weighting) + pixel-accuracy Evaluator; mIoU is
+    computed by the FedSeg eval pass. Weighting is inverse batch frequency:
+    background dominates segmentation labels, and plain CE converges to the
+    all-background predictor (high pixel acc, mIoU ≈ bg-IoU/C); weighting
+    keeps every present class in the gradient.
     """
+    c = logits.shape[-1]
     per_px = optax.softmax_cross_entropy_with_integer_labels(logits, y)
     px_mask = sample_mask[:, None, None] * jnp.ones_like(per_px)
-    denom = jnp.maximum(px_mask.sum(), 1.0)
-    loss = (per_px * px_mask).sum() / denom
+    counts = (jax.nn.one_hot(y, c) * px_mask[..., None]).sum((0, 1, 2))
+    present = (counts > 0).astype(jnp.float32)
+    inv = jnp.where(counts > 0, 1.0 / jnp.maximum(counts, 1.0), 0.0)
+    class_w = inv / jnp.maximum(
+        (inv * present).sum(), 1e-12
+    ) * jnp.maximum(present.sum(), 1.0)  # mean weight over present classes = 1
+    w_px = class_w[y]
+    denom = jnp.maximum((w_px * px_mask).sum(), 1.0)
+    loss = (per_px * w_px * px_mask).sum() / denom
     correct = ((jnp.argmax(logits, -1) == y) * px_mask).sum()
     return loss, {
         "loss_sum": (per_px * px_mask).sum((1, 2)),
@@ -71,11 +84,132 @@ def segmentation_loss(logits, y, sample_mask) -> Tuple[jnp.ndarray, Metrics]:
     }
 
 
+def regression_loss(logits, y, sample_mask) -> Tuple[jnp.ndarray, Metrics]:
+    """MSE. logits [B, 1] (or [B]), y [B] float targets.
+
+    reference: app/fedgraphnn/moleculenet_graph_reg trainers (MSE/RMSE).
+    "correct" counts predictions within 0.5 of the target so the uniform
+    accuracy plumbing still reads as a hit-rate.
+    """
+    pred = logits.reshape(y.shape)
+    per = (pred - y) ** 2
+    denom = jnp.maximum(sample_mask.sum(), 1.0)
+    loss = (per * sample_mask).sum() / denom
+    correct = ((jnp.abs(pred - y) < 0.5) * sample_mask).sum()
+    return loss, {"loss_sum": per * sample_mask, "correct": correct,
+                  "count": sample_mask.sum()}
+
+
+def node_clf_loss(logits, y, sample_mask) -> Tuple[jnp.ndarray, Metrics]:
+    """Per-node CE. logits [B, N, C], y [B, N] int labels, padding = -1.
+
+    reference: app/fedgraphnn/ego_networks_node_clf trainers (masked CE over
+    ego-network nodes).
+    """
+    node_mask = (y >= 0).astype(jnp.float32) * sample_mask[:, None]
+    y_safe = jnp.maximum(y, 0)
+    per = optax.softmax_cross_entropy_with_integer_labels(logits, y_safe)
+    denom = jnp.maximum(node_mask.sum(), 1.0)
+    loss = (per * node_mask).sum() / denom
+    correct = ((jnp.argmax(logits, -1) == y_safe) * node_mask).sum()
+    return loss, {"loss_sum": per * node_mask, "correct": correct,
+                  "count": node_mask.sum()}
+
+
+def link_pred_loss(logits, y, sample_mask) -> Tuple[jnp.ndarray, Metrics]:
+    """Edge-reconstruction BCE. logits [B, N, N] pair scores; y [B, N, N+1]
+    = full target adjacency ++ node-mask column (data/graphs.py layout).
+
+    reference: app/fedgraphnn/ego_networks_link_pred trainers (BCE over
+    candidate edges). Positives are up-weighted by the observed sparsity so
+    the all-zeros predictor is never a minimum.
+    """
+    n = logits.shape[-1]
+    adj = y[..., :n]
+    node_mask = y[..., -1]
+    pair = node_mask[:, :, None] * node_mask[:, None, :]
+    pair = pair * (1.0 - jnp.eye(n)[None])  # self-pairs carry no signal
+    pair = pair * sample_mask[:, None, None]
+    pos_frac = (adj * pair).sum() / jnp.maximum(pair.sum(), 1.0)
+    w = jnp.where(adj > 0, 1.0 / jnp.maximum(pos_frac, 1e-3), 1.0)
+    per = optax.sigmoid_binary_cross_entropy(logits, adj) * w
+    denom = jnp.maximum((pair * w).sum(), 1.0)
+    loss = (per * pair).sum() / denom
+    correct = (((logits > 0) == (adj > 0)) * pair).sum()
+    return loss, {"loss_sum": (per * pair).sum((1, 2)), "correct": correct,
+                  "count": pair.sum()}
+
+
+def span_extraction_loss(logits, y, sample_mask) -> Tuple[jnp.ndarray, Metrics]:
+    """Start/end pointer CE. logits [B, L, 2], y [B, 2] = (start, end).
+
+    reference: app/fednlp/span_extraction trainers (SQuAD-style QA heads).
+    "correct" counts exact-match spans.
+    """
+    start_logits, end_logits = logits[..., 0], logits[..., 1]
+    per = (optax.softmax_cross_entropy_with_integer_labels(
+               start_logits, y[:, 0]) +
+           optax.softmax_cross_entropy_with_integer_labels(
+               end_logits, y[:, 1]))
+    denom = jnp.maximum(sample_mask.sum(), 1.0)
+    loss = (per * sample_mask).sum() / denom
+    hit = ((jnp.argmax(start_logits, -1) == y[:, 0]) &
+           (jnp.argmax(end_logits, -1) == y[:, 1]))
+    correct = (hit * sample_mask).sum()
+    return loss, {"loss_sum": per * sample_mask, "correct": correct,
+                  "count": sample_mask.sum()}
+
+
+def detection_loss(logits, y, sample_mask) -> Tuple[jnp.ndarray, Metrics]:
+    """Dense anchor-free detection. logits [B, H, W, C+2] (class heatmap ++
+    size); y [B, H, W, C+3] (one-hot heatmap ++ size ++ center mask).
+
+    reference: app/fedcv/object_detection (YOLOv5 obj/cls/box terms) —
+    re-shaped to the CenterNet-style dense target (models/detection.py):
+    BCE on the heatmap everywhere, L1 on sizes at real centers. "correct"
+    counts centers whose argmax class is right.
+    """
+    c = logits.shape[-1] - 2
+    cls_logits, size_pred = logits[..., :c], logits[..., c:]
+    heat, size_t, center = y[..., :c], y[..., c:c + 2], y[..., -1]
+    sm = sample_mask[:, None, None]
+    # heatmap: per-cell BCE, positives up-weighted (centers are rare)
+    w = jnp.where(heat > 0, 20.0, 1.0)
+    bce = (optax.sigmoid_binary_cross_entropy(cls_logits, heat) * w).sum(-1)
+    heat_denom = jnp.maximum((jnp.ones_like(bce) * sm).sum(), 1.0)
+    heat_loss = (bce * sm).sum() / heat_denom
+    # sizes: L1 at centers only
+    l1 = jnp.abs(size_pred - size_t).sum(-1) * center
+    size_loss = (l1 * sm).sum() / jnp.maximum((center * sm).sum(), 1.0)
+    loss = heat_loss + 0.1 * size_loss
+    hit = (jnp.argmax(cls_logits, -1) == jnp.argmax(heat, -1)) * center
+    correct = (hit * sm).sum()
+    # evaluate() divides Σloss_sum and Σcorrect by ONE Σcount — unit here is
+    # the center: count is the raw center total (evaluate clamps the final
+    # denominator, so all-padding batches add nothing), and loss_sum is each
+    # sample's training-objective value scaled by its center count, so
+    # test_loss is the center-weighted mean of the objective being trained
+    centers_i = (center * sm).sum((1, 2))
+    per_sample = (bce * sm).mean((1, 2)) + 0.1 * (
+        (l1 * sm).sum((1, 2)) / jnp.maximum(centers_i, 1.0)
+    )
+    return loss, {"loss_sum": per_sample * centers_i, "correct": correct,
+                  "count": (center * sm).sum()}
+
+
 LOSSES = {
     "classification": classification_loss,
     "nwp": nwp_loss,
     "tagpred": tagpred_loss,
     "segmentation": segmentation_loss,
+    "regression": regression_loss,
+    "node_clf": node_clf_loss,
+    "link_pred": link_pred_loss,
+    # per-token CE with -1 padding is structurally the node task
+    # (reference: app/fednlp/seq_tagging)
+    "seq_tagging": node_clf_loss,
+    "span_extraction": span_extraction_loss,
+    "detection": detection_loss,
 }
 
 

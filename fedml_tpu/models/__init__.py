@@ -80,7 +80,7 @@ def create(args, output_dim: int) -> ModelBundle:
     spec = DATA_REGISTRY.get(dataset)
     sample_shape = spec.sample_shape if spec else (60,)
     task = spec.task if spec else "classification"
-    int_input = task == "nwp"
+    int_input = task in ("nwp", "seq_tagging", "span_extraction")
 
     if name in ("lr", "logistic_regression"):
         module: nn.Module = LogisticRegression(output_dim)
@@ -115,11 +115,61 @@ def create(args, output_dim: int) -> ModelBundle:
     elif name in ("fcn", "deeplab", "deeplabv3_plus", "unet"):
         from .segmentation import FCNSeg
 
-        module = FCNSeg(output_dim)
+        module = FCNSeg(output_dim,
+                        width=int(getattr(args, "seg_model_width", 32) or 32))
     elif name in ("darts", "darts_search"):
         from .darts import DartsNetwork
 
         module = DartsNetwork(output_dim)
+    elif name in ("centernet", "centernet_lite", "yolo", "detector"):
+        # FedCV detection (reference: app/fedcv/object_detection) —
+        # dense anchor-free head, see models/detection.py
+        from .detection import CenterNetLite
+
+        module = CenterNetLite(num_classes=output_dim)
+    elif name in ("transformer", "tiny_transformer", "transformer_lm",
+                  "bilstm_tagger", "tagger", "span_extractor", "bilstm_span"):
+        # FedNLP zoo (reference: app/fednlp/{seq_tagging,span_extraction,
+        # seq2seq}) — all need a token-vocab dataset
+        if spec is None or spec.vocab_size <= 0:
+            raise ValueError(
+                f"model {name!r} needs a text dataset with a vocab "
+                f"(got {dataset!r})"
+            )
+        if name in ("bilstm_tagger", "tagger"):
+            from .nlp import TokenTagger
+
+            module = TokenTagger(vocab_size=spec.vocab_size,
+                                 num_tags=output_dim)
+        elif name in ("span_extractor", "bilstm_span"):
+            from .nlp import SpanExtractor
+
+            module = SpanExtractor(vocab_size=spec.vocab_size)
+        else:
+            from .nlp import TinyTransformerLM
+
+            module = TinyTransformerLM(
+                vocab_size=max(spec.vocab_size, output_dim),
+                max_len=spec.seq_len if spec.seq_len > 0 else 128,
+            )
+    elif name in ("gcn", "gat", "sage", "graphsage"):
+        # FedGraphNN zoo (reference: app/fedgraphnn/*/model/) — head routed
+        # by the dataset's task, conv by the model name
+        from .gnn import GraphClassifier, LinkPredictor, NodeClassifier
+
+        conv = {"graphsage": "sage"}.get(name, name)
+        if spec is None or spec.n_nodes == 0:
+            raise ValueError(
+                f"model {name!r} needs a graph dataset (got {dataset!r})"
+            )
+        if task == "node_clf":
+            module = NodeClassifier(spec.n_feats, output_dim, conv=conv)
+        elif task == "link_pred":
+            module = LinkPredictor(spec.n_feats, conv=conv)
+        elif task == "regression":
+            module = GraphClassifier(spec.n_feats, 1, conv=conv)
+        else:
+            module = GraphClassifier(spec.n_feats, output_dim, conv=conv)
     else:
         raise ValueError(f"unknown model {name!r}")
 

@@ -1,0 +1,46 @@
+"""Object detection — anchor-free dense head (FedCV detection family).
+
+reference: ``python/app/fedcv/object_detection`` — YOLOv5 fine-tuning through
+the federated API (torch hub model, ragged NMS pipelines).
+
+TPU re-grounding: ragged per-image box lists and NMS loops are hostile to
+XLA; a CenterNet-style *dense* formulation is the TPU-shaped equivalent and
+keeps every tensor static: the network predicts, at stride 4, a per-cell
+class heatmap plus a box-size regression, and the target is the same dense
+grid (``data/datasets.py synth_detection``). Decoding to boxes (top-k over
+the heatmap) happens host-side after eval and never enters jit.
+
+Output layout: ``[H/4, W/4, C + 2]`` = class logits ++ (h, w) size
+regression. Target layout: ``[H/4, W/4, C + 3]`` = one-hot center heatmap
+++ (h, w) ++ center mask.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from flax import linen as nn
+
+from .segmentation import ConvGN
+
+
+class CenterNetLite(nn.Module):
+    """Stride-4 backbone + dense detection heads.
+
+    ``num_classes`` object categories; returns ``[B, H/4, W/4, C + 2]``.
+    """
+
+    num_classes: int
+    widths: Sequence[int] = (32, 64, 64)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = ConvGN(self.widths[0], stride=2)(x)
+        h = ConvGN(self.widths[1], stride=2)(h)
+        for w in self.widths[2:]:
+            h = ConvGN(w)(h)
+        cls = nn.Conv(self.num_classes, (1, 1))(h)
+        size = nn.Conv(2, (1, 1))(h)
+        import jax.numpy as jnp
+
+        return jnp.concatenate([cls, size], axis=-1)

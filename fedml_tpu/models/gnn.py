@@ -1,0 +1,205 @@
+"""Graph neural networks over padded dense blocks (FedGraphNN model zoo).
+
+reference: ``python/app/fedgraphnn/`` — GCN/GAT/GraphSAGE with readout for
+MoleculeNet graph classification/regression (``moleculenet_graph_clf/model/
+gcn_readout.py``, ``gat_readout.py``), node classification on ego networks
+(``ego_networks_node_clf/model/{gcn,gat,sage}.py``), and link prediction on
+ego/recsys subgraphs. Those models run on torch-geometric-style sparse
+edge lists with dynamic node counts.
+
+TPU re-grounding: sparse gather/scatter over ragged edge lists is the worst
+possible XLA program — dynamic shapes, serialized scatters, nothing on the
+MXU. Molecule/ego graphs are SMALL (tens of nodes), so every graph is packed
+into one fixed-shape dense block and message passing becomes batched
+matmuls:
+
+- sample = ``[N, F + N + 1]``: node features ``[:, :F]``, dense adjacency
+  row ``[:, F:F+N]``, node-validity mask ``[:, -1]`` (padding rows are 0);
+- one GCN layer for a whole batch is ``adj_hat @ h @ W`` — two MXU matmuls
+  under ``vmap``, no scatter anywhere;
+- attention (GAT) is a masked dense ``[N, N]`` softmax — cheap at these N.
+
+The same packing rides every federated engine unchanged (vmap cohorts,
+mesh sharding, DP, compression), because a graph client's shard is just
+another ``[clients, cap, N, F+N+1]`` array.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+# ---------------------------------------------------------------------------
+# packing
+# ---------------------------------------------------------------------------
+
+
+def pack_graph(feats: jnp.ndarray, adj: jnp.ndarray,
+               mask: jnp.ndarray) -> jnp.ndarray:
+    """[..., N, F], [..., N, N], [..., N] → one [..., N, F+N+1] block."""
+    return jnp.concatenate([feats, adj, mask[..., None]], axis=-1)
+
+
+def unpack_graph(x: jnp.ndarray, n_feats: int
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Inverse of :func:`pack_graph`; N is read off the block shape."""
+    n = x.shape[-2]
+    feats = x[..., :n_feats]
+    adj = x[..., n_feats:n_feats + n]
+    mask = x[..., -1]
+    return feats, adj, mask
+
+
+def normalize_adj(adj: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Symmetric GCN normalization D^-1/2 (A+I) D^-1/2, padding-aware."""
+    n = adj.shape[-1]
+    eye = jnp.eye(n, dtype=adj.dtype)
+    m = mask[..., :, None] * mask[..., None, :]
+    a = (adj + eye) * m  # self-loops only on real nodes (mask zeroes pads)
+    deg = a.sum(-1)
+    inv_sqrt = jnp.where(deg > 0, jax.lax.rsqrt(jnp.maximum(deg, 1e-12)), 0.0)
+    return a * inv_sqrt[..., :, None] * inv_sqrt[..., None, :]
+
+
+def masked_mean_pool(h: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """[..., N, D], [..., N] → [..., D] over real nodes only."""
+    s = (h * mask[..., None]).sum(-2)
+    return s / jnp.maximum(mask.sum(-1)[..., None], 1.0)
+
+
+# ---------------------------------------------------------------------------
+# layers
+# ---------------------------------------------------------------------------
+
+
+class GCNLayer(nn.Module):
+    """Kipf-Welling convolution: act(Â h W) (reference: gcn_readout.py)."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, h, adj_hat, mask):
+        h = nn.Dense(self.features, use_bias=True)(h)
+        h = adj_hat @ h
+        return nn.relu(h) * mask[..., None]
+
+
+class SAGELayer(nn.Module):
+    """GraphSAGE-mean: act(W_self h ++ W_neigh mean_nbr(h))
+    (reference: ego_networks_node_clf/model/sage.py)."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, h, adj_hat, mask):
+        deg = jnp.maximum(adj_hat.sum(-1, keepdims=True), 1e-12)
+        nbr = (adj_hat @ h) / deg
+        out = nn.Dense(self.features)(h) + nn.Dense(self.features)(nbr)
+        return nn.relu(out) * mask[..., None]
+
+
+class GATLayer(nn.Module):
+    """Single-head graph attention as a masked dense softmax
+    (reference: gat_readout.py; dense is the TPU-shaped formulation)."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, h, adj_hat, mask):
+        n = h.shape[-2]
+        hw = nn.Dense(self.features, use_bias=False)(h)
+        a_src = nn.Dense(1, use_bias=False)(hw)[..., 0]   # [..., N]
+        a_dst = nn.Dense(1, use_bias=False)(hw)[..., 0]
+        logits = nn.leaky_relu(
+            a_src[..., :, None] + a_dst[..., None, :], negative_slope=0.2
+        )
+        # attend only along real edges (adj_hat > 0 includes self-loops)
+        connected = (adj_hat > 0).astype(h.dtype)
+        logits = jnp.where(connected > 0, logits, -1e9)
+        att = jax.nn.softmax(logits, axis=-1) * connected
+        out = att @ hw
+        return nn.elu(out) * mask[..., None]
+
+
+_LAYERS = {"gcn": GCNLayer, "sage": SAGELayer, "gat": GATLayer}
+
+
+class GraphEncoder(nn.Module):
+    """Stacked message passing over a packed graph block."""
+
+    n_feats: int
+    hidden: Sequence[int] = (64, 64)
+    conv: str = "gcn"
+
+    @nn.compact
+    def __call__(self, x):
+        feats, adj, mask = unpack_graph(x, self.n_feats)
+        adj_hat = normalize_adj(adj, mask)
+        layer = _LAYERS[self.conv]
+        h = feats
+        for width in self.hidden:
+            h = layer(width)(h, adj_hat, mask)
+        return h, mask
+
+
+# ---------------------------------------------------------------------------
+# task heads (one per FedGraphNN application family)
+# ---------------------------------------------------------------------------
+
+
+class GraphClassifier(nn.Module):
+    """Graph-level prediction: encode → masked-mean readout → MLP.
+
+    ``num_outputs`` classes (moleculenet_graph_clf) or 1 regression target
+    (moleculenet_graph_reg / social_networks_graph_clf analogs).
+    """
+
+    n_feats: int
+    num_outputs: int
+    hidden: Sequence[int] = (64, 64)
+    conv: str = "gcn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h, mask = GraphEncoder(self.n_feats, self.hidden, self.conv)(x)
+        pooled = masked_mean_pool(h, mask)
+        pooled = nn.relu(nn.Dense(self.hidden[-1])(pooled))
+        return nn.Dense(self.num_outputs)(pooled)
+
+
+class NodeClassifier(nn.Module):
+    """Per-node prediction (ego_networks_node_clf): logits [..., N, C]."""
+
+    n_feats: int
+    num_classes: int
+    hidden: Sequence[int] = (64, 64)
+    conv: str = "gcn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h, mask = GraphEncoder(self.n_feats, self.hidden, self.conv)(x)
+        return nn.Dense(self.num_classes)(h) * mask[..., None]
+
+
+class LinkPredictor(nn.Module):
+    """Dot-product edge decoder (ego_networks_link_pred /
+    recsys_subgraph_link_pred): score[i,j] = z_i · z_j, logits [..., N, N].
+
+    Trained to reconstruct the adjacency (padding pairs masked by the loss);
+    at serving time the scores rank held-out candidate edges.
+    """
+
+    n_feats: int
+    embed_dim: int = 32
+    hidden: Sequence[int] = (64,)
+    conv: str = "gcn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h, mask = GraphEncoder(self.n_feats, self.hidden, self.conv)(x)
+        z = nn.Dense(self.embed_dim)(h) * mask[..., None]
+        return z @ jnp.swapaxes(z, -1, -2)

@@ -39,3 +39,95 @@ class RNNStackOverflow(nn.Module):
         h = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(h)
         h = nn.Dense(self.embedding_dim)(h)
         return nn.Dense(self.vocab_size)(h)
+
+
+class BiLSTMEncoder(nn.Module):
+    """Shared FedNLP encoder: embed → bidirectional LSTM → per-token states.
+
+    reference: ``python/app/fednlp`` model stacks (BiLSTM baselines for
+    seq_tagging / span_extraction). Both directions are XLA scans; the
+    reverse pass is a flip, not a dynamic loop.
+    """
+
+    vocab_size: int
+    embedding_dim: int = 32
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x)
+        fwd = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(h)
+        bwd = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(h[:, ::-1, :])[:, ::-1, :]
+        import jax.numpy as jnp
+
+        return jnp.concatenate([fwd, bwd], axis=-1)
+
+
+class TokenTagger(nn.Module):
+    """Sequence tagging (reference: app/fednlp/seq_tagging — NER-style
+    per-token labels): logits [B, L, num_tags]."""
+
+    vocab_size: int
+    num_tags: int
+    embedding_dim: int = 32
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = BiLSTMEncoder(self.vocab_size, self.embedding_dim, self.hidden)(x)
+        return nn.Dense(self.num_tags)(h)
+
+
+class TinyTransformerLM(nn.Module):
+    """Small causal-attention LM for federated NLP tasks.
+
+    reference: app/fednlp transformer baselines (distilbert/bart heads). The
+    Cheetah transformer (``parallel/transformer.py``) is the scale path; this
+    zoo model is its federated-client-sized sibling — self-contained (no mesh
+    partitioning metadata), so it drops into the vmapped cohort engines.
+    Attention makes copy/reorder seq2seq tasks learnable where a small LSTM's
+    fixed-width state cannot (prefix-LM framing, fednlp_seq2seq).
+    """
+
+    vocab_size: int
+    d_model: int = 64
+    n_layers: int = 2
+    n_heads: int = 4
+    max_len: int = 128
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        import jax.numpy as jnp
+
+        B, L = x.shape
+        pos_emb = self.param(
+            "pos_emb", nn.initializers.normal(0.02),
+            (self.max_len, self.d_model),
+        )
+        h = nn.Embed(self.vocab_size, self.d_model)(x) + pos_emb[None, :L]
+        causal = jnp.tril(jnp.ones((L, L), bool))[None, None]
+        for _ in range(self.n_layers):
+            a = nn.LayerNorm()(h)
+            a = nn.SelfAttention(num_heads=self.n_heads,
+                                 qkv_features=self.d_model)(a, mask=causal)
+            h = h + a
+            m = nn.LayerNorm()(h)
+            m = nn.Dense(4 * self.d_model)(m)
+            m = nn.gelu(m)
+            h = h + nn.Dense(self.d_model)(m)
+        h = nn.LayerNorm()(h)
+        return nn.Dense(self.vocab_size)(h)
+
+
+class SpanExtractor(nn.Module):
+    """Span extraction (reference: app/fednlp/span_extraction — QA-style
+    start/end pointers): logits [B, L, 2] (start scores, end scores)."""
+
+    vocab_size: int
+    embedding_dim: int = 32
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = BiLSTMEncoder(self.vocab_size, self.embedding_dim, self.hidden)(x)
+        return nn.Dense(2)(h)

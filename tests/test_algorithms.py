@@ -107,10 +107,12 @@ class TestFedSeg:
     """VERDICT missing #6: segmentation runtime (reference simulation/mpi/fedseg)."""
 
     def test_fedseg_learns_and_reports_miou(self):
+        # width 16 + 1 epoch: full-width FCN convs at 3 epochs x 6 rounds
+        # cost ~40 min of single-core CPU in CI — same code path, 20x less
         res = run_sim(federated_optimizer="FedSeg", dataset="pascal_voc",
                       model="fcn", client_num_in_total=4,
-                      client_num_per_round=4, comm_round=6, epochs=3,
-                      batch_size=8, learning_rate=0.1)
+                      client_num_per_round=4, comm_round=8, epochs=1,
+                      batch_size=8, learning_rate=0.15, seg_model_width=16)
         assert "test_miou" in res and "pixel_acc" in res
         assert res["pixel_acc"] > 0.5  # synthetic blobs are separable
         assert res["test_miou"] > 0.05

@@ -1,0 +1,120 @@
+"""FedGraphNN: federated GNN training on packed dense graph blocks.
+
+Mirrors the reference's app-layer coverage (``python/app/fedgraphnn/``):
+graph classification/regression (MoleculeNet analog), node classification
+(ego networks), link prediction (ego/recsys subgraphs) — each trained
+through the standard sp engine, proving graphs are just another packed
+tensor to every federated code path.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import fedml_tpu as fedml
+from fedml_tpu import data as data_mod
+from fedml_tpu import models as model_mod
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.models.gnn import normalize_adj, pack_graph, unpack_graph
+from fedml_tpu.runner import FedMLRunner
+
+
+def run_graph_sim(dataset, model="gcn", **kw):
+    base = dict(
+        dataset=dataset, model=model, client_num_in_total=8,
+        client_num_per_round=8, comm_round=8, epochs=2, batch_size=16,
+        learning_rate=0.05, frequency_of_the_test=20, backend="sp",
+    )
+    base.update(kw)
+    args = fedml.init(Arguments(overrides=base), should_init_logs=False)
+    ds, output_dim = data_mod.load(args)
+    model_bundle = model_mod.create(args, output_dim)
+    return FedMLRunner(args, fedml.get_device(args), ds, model_bundle).run()
+
+
+class TestGraphKernels:
+    def test_pack_unpack_roundtrip(self):
+        rng = np.random.default_rng(0)
+        feats = jnp.asarray(rng.standard_normal((3, 8, 4)), jnp.float32)
+        adj = jnp.asarray((rng.random((3, 8, 8)) < 0.3), jnp.float32)
+        mask = jnp.ones((3, 8), jnp.float32)
+        x = pack_graph(feats, adj, mask)
+        f2, a2, m2 = unpack_graph(x, 4)
+        np.testing.assert_array_equal(np.asarray(f2), np.asarray(feats))
+        np.testing.assert_array_equal(np.asarray(a2), np.asarray(adj))
+        np.testing.assert_array_equal(np.asarray(m2), np.asarray(mask))
+
+    def test_normalize_adj_masks_padding(self):
+        adj = jnp.ones((4, 4), jnp.float32)
+        mask = jnp.asarray([1, 1, 0, 0], jnp.float32)
+        a_hat = np.asarray(normalize_adj(adj, mask))
+        assert a_hat[2:].sum() == 0 and a_hat[:, 2:].sum() == 0
+        # real block is symmetric with unit row sums (complete 2-graph + I)
+        np.testing.assert_allclose(a_hat[:2, :2].sum(-1), 1.0, atol=1e-5)
+        np.testing.assert_allclose(a_hat, a_hat.T, atol=1e-6)
+
+    def test_normalize_adj_isolated_node(self):
+        adj = jnp.zeros((3, 3), jnp.float32)
+        mask = jnp.ones((3,), jnp.float32)
+        a_hat = np.asarray(normalize_adj(adj, mask))
+        # isolated real nodes keep their (normalized) self-loop
+        np.testing.assert_allclose(np.diag(a_hat), 1.0, atol=1e-5)
+
+
+class TestFedGraphNN:
+    def test_graph_classification_learns(self):
+        res = run_graph_sim("moleculenet_clf")
+        assert res["test_acc"] > 0.7  # 2-class chance = 0.5
+
+    def test_graph_classification_gat(self):
+        res = run_graph_sim("social_graph_clf", model="gat", comm_round=6)
+        assert res["test_acc"] > 0.5  # 3-class chance = 0.33
+
+    def test_graph_regression_fits(self):
+        res = run_graph_sim("moleculenet_reg", comm_round=20, epochs=3,
+                            learning_rate=0.03)
+        # predict-the-mean baseline sits at the target variance (≈2.7)
+        assert res["test_loss"] < 1.0
+
+    def test_node_classification_learns(self):
+        res = run_graph_sim("ego_node_clf", model="sage")
+        assert res["test_acc"] > 0.4  # 5-class chance = 0.2
+
+    def test_link_prediction_beats_chance(self):
+        res = run_graph_sim("ego_link_pred", comm_round=6)
+        # "acc" = correctly-scored node pairs; all-zeros baseline would sit
+        # near the negative rate, and the weighted loss forbids it
+        assert res["test_acc"] > 0.7
+
+    def test_graph_dataset_shapes(self):
+        args = fedml.init(
+            Arguments(overrides=dict(
+                dataset="ego_link_pred", model="gcn", client_num_in_total=4,
+                client_num_per_round=4, comm_round=1, batch_size=8,
+            )),
+            should_init_logs=False,
+        )
+        ds, _ = data_mod.load(args)
+        n = 32
+        assert ds.train_x.shape[-2:] == (n, 16 + n + 1)
+        assert ds.train_y.shape[-2:] == (n, n + 1)
+        assert ds.task == "link_pred"
+
+
+@pytest.mark.parametrize("conv", ["gcn", "gat", "sage"])
+def test_all_convs_forward(conv):
+    from fedml_tpu.models.gnn import GraphClassifier
+
+    rng = np.random.default_rng(1)
+    feats = jnp.asarray(rng.standard_normal((2, 12, 6)), jnp.float32)
+    adj = jnp.asarray((rng.random((2, 12, 12)) < 0.4), jnp.float32)
+    adj = jnp.triu(adj, 1) + jnp.swapaxes(jnp.triu(adj, 1), -1, -2)
+    mask = jnp.ones((2, 12), jnp.float32)
+    x = pack_graph(feats, adj, mask)
+    model = GraphClassifier(6, 3, conv=conv)
+    import jax
+
+    params = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(params, x)
+    assert out.shape == (2, 3)
+    assert np.isfinite(np.asarray(out)).all()
