@@ -11,10 +11,11 @@ Two measurements, one JSON line:
    (``model/cv/resnet.py:257`` — it ships no resnet20).
 
 2. **Cheetah tokens/sec/chip + MFU** (north star #2): single-chip pretraining
-   of the flagship decoder-only transformer (~350M params, seq 2048, bf16,
-   remat, flash attention, chunked fused CE). MFU = achieved model FLOPs/s
-   over chip peak bf16 FLOPs/s, with model FLOPs per token = 6·N + 12·L·layers·d_model
-   (PaLM appendix B convention).
+   of the flagship decoder-only transformer (~500M params: d2048 x 8L, GQA
+   4q/2kv head_dim 512, seq 2048, bf16, splash attention, chunked fused CE;
+   a remat ladder falls back only if no-remat doesn't fit). MFU = achieved
+   model FLOPs/s over chip peak bf16 FLOPs/s, with model FLOPs per token =
+   6·N + 12·L·layers·d_model (PaLM appendix B convention).
 
 The headline line is the FedAvg metric (reference-comparable); the Cheetah
 numbers ride along as extra keys so every round's BENCH_r{N}.json records

@@ -1,0 +1,129 @@
+"""Beehive quick start: cross-device FL server in one file.
+
+reference: ``python/quick_start/beehive/torch_server.py`` — launch the MNN
+artifact server that mobile clients federate against (``fedml.run_mnn_server``).
+
+TPU re-grounding: the artifact plane is ``.npz`` tensor files
+(``cross_device/server.py``) — the open contract a mobile client speaks:
+download ``global_model_file_path``, train locally, drop ``client_*.npz``
+(+ ``.samples`` weight sidecar) into ``device_upload_dir``. This demo plays
+both sides so it runs anywhere: background threads act as two "devices"
+that poll the published global, take a simulated local step, and upload.
+
+Run: ``python server.py``.
+"""
+
+import os
+import shutil
+import sys
+import threading
+import time
+
+import numpy as np
+
+import fedml_tpu as fedml
+from fedml_tpu import data as fedml_data
+from fedml_tpu import models as fedml_models
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.cross_device.server import (
+    ServerMNN,
+    read_artifact_as_tensor_dict,
+    write_tensor_dict_to_artifact,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORK = os.path.join(HERE, ".beehive_demo")
+GLOBAL = os.path.join(WORK, "global_model.npz")
+UPLOADS = os.path.join(WORK, "uploads")      # devices drop files here
+STAGING = os.path.join(WORK, "staging")      # server ingests from here
+
+
+def local_sgd(tensors, x, y, lr=0.1, epochs=5):
+    """A phone's local training, in plain numpy: softmax regression SGD on
+    the device's own shard — what the MNN engine does on-device."""
+    kernel_key = next(k for k, v in tensors.items()
+                      if v.ndim == 2 and "kernel" in k.lower())
+    bias_key = next(k for k, v in tensors.items()
+                    if v.ndim == 1 and "bias" in k.lower())
+    w, b = tensors[kernel_key].copy(), tensors[bias_key].copy()
+    xf = x.reshape(x.shape[0], -1).astype(np.float32)
+    onehot = np.eye(w.shape[1], dtype=np.float32)[y]
+    for _ in range(epochs):
+        logits = xf @ w + b
+        logits -= logits.max(1, keepdims=True)
+        p = np.exp(logits)
+        p /= p.sum(1, keepdims=True)
+        g = (p - onehot) / len(y)
+        w -= lr * (xf.T @ g)
+        b -= lr * g.sum(0)
+    out = dict(tensors)
+    out[kernel_key], out[bias_key] = w, b
+    return out
+
+
+def fake_device(device_id: str, rounds: int, x, y) -> None:
+    """Stands in for a phone: poll the global artifact, train, upload."""
+    seen = -1.0
+    for _ in range(rounds):
+        while True:  # wait for a (re)published global model
+            try:
+                mtime = os.path.getmtime(GLOBAL)
+            except OSError:
+                mtime = -1.0
+            if mtime > seen:
+                seen = mtime
+                break
+            time.sleep(0.1)
+        time.sleep(0.1)  # let the publish finish writing
+        tensors = read_artifact_as_tensor_dict(GLOBAL)
+        updated = local_sgd(tensors, x, y)
+        path = os.path.join(UPLOADS, f"client_{device_id}.npz")
+        write_tensor_dict_to_artifact(updated, path)
+        with open(path[:-4] + ".samples", "w") as f:
+            f.write(str(len(y)))
+
+
+def main() -> None:
+    shutil.rmtree(WORK, ignore_errors=True)
+    os.makedirs(UPLOADS, exist_ok=True)
+    os.makedirs(STAGING, exist_ok=True)
+    args = fedml.init(Arguments(overrides=dict(
+        training_type="cross_device", dataset="mnist", model="lr",
+        client_num_in_total=2, client_num_per_round=2, comm_round=3,
+        global_model_file_path=GLOBAL, device_upload_dir=STAGING,
+    )), should_init_logs=False)
+    dataset, output_dim = fedml_data.load(args)
+    model = fedml_models.create(args, output_dim)
+
+    server = ServerMNN(args, fedml.get_device(args), dataset, model)
+    server.publish_global_model()
+    for idx, device_id in enumerate(("device-a", "device-b")):
+        x, y, n = dataset.client_shard(idx)
+        threading.Thread(
+            target=fake_device,
+            args=(device_id, args.comm_round,
+                  np.asarray(x)[: int(n)], np.asarray(y)[: int(n)]),
+            daemon=True,
+        ).start()
+
+    n_devices = 2
+    for _ in range(args.comm_round):
+        # wait for BOTH devices' sidecars (written last), then move the
+        # round's uploads into staging — devices racing ahead into the next
+        # round keep writing to UPLOADS and are never clobbered
+        while len([f for f in os.listdir(UPLOADS)
+                   if f.endswith(".samples")]) < n_devices:
+            time.sleep(0.1)
+        for f in os.listdir(UPLOADS):
+            os.replace(os.path.join(UPLOADS, f), os.path.join(STAGING, f))
+        server.run_one_round()  # ingests staging, republishes the global
+        for f in os.listdir(STAGING):
+            os.remove(os.path.join(STAGING, f))
+
+    print(f"beehive quick start: {server.round_idx} cross-device rounds "
+          f"complete, final acc="
+          f"{(server.final_metrics or {}).get('test_acc', float('nan')):.3f}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

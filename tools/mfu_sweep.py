@@ -113,7 +113,7 @@ def main() -> None:
         ]
     for delta in matrix:
         cfg = {**BASE, **delta}
-        tag = json.dumps(delta) or "base"
+        tag = json.dumps(delta) if delta else "base"
         env = dict(os.environ)
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         p = subprocess.run(
