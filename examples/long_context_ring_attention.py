@@ -9,9 +9,10 @@ a context longer than one chip's HBM trains. (reference has no analog —
 SURVEY.md §2.5 lists sequence parallelism as absent upstream; new capability.)
 
 This demo self-provisions a 4-device virtual CPU mesh (sequence=4), trains a
-16k-token context — 4k tokens resident per device — and checks the loss is
-finite and decreasing. The SAME program runs on a real pod slice by removing
-the virtual-platform lines.
+4k-token context — 1k tokens resident per device (shapes sized for the
+single-core demo host; scale SEQ freely on real chips) — and checks the
+loss is finite and decreasing. The SAME program runs on a real pod slice by
+removing the virtual-platform lines.
 
 Run: ``python long_context_ring_attention.py`` (~10 min on one host core —
 almost all XLA:CPU compile; seconds per step on real chips).

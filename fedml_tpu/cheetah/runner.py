@@ -112,10 +112,14 @@ class CheetahRunner:
         if stream is None:
             while True:
                 yield rng.randint(0, V, shape).astype(np.int32)
+        from .. import native
+
         n_rows = int(np.prod(shape[:-1]))
         while True:
             starts = rng.randint(0, stream.size - self.seq_len, size=n_rows)
-            rows = np.stack([stream[s:s + self.seq_len] for s in starts])
+            # threaded C++ window gather: this slice runs on the host
+            # critical path between device steps
+            rows = native.gather_windows(stream, starts, self.seq_len)
             yield rows.reshape(shape)
 
     def run(self) -> dict:

@@ -28,9 +28,18 @@ logger = logging.getLogger(__name__)
 
 def write_tensor_dict_to_artifact(tensor_dict: Dict[str, np.ndarray],
                                   path: str) -> None:
-    """reference: write_tensor_dict_to_mnn (server_mnn/utils.py:31-50)."""
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    np.savez(path, **{k: np.asarray(v) for k, v in tensor_dict.items()})
+    """reference: write_tensor_dict_to_mnn (server_mnn/utils.py:31-50).
+
+    Atomic: written to a temp file then os.replace'd, so devices polling the
+    artifact (by existence or mtime) never observe a half-written archive —
+    the publish is a single filesystem event.
+    """
+    path = os.path.abspath(path)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    np.savez(tmp, **{k: np.asarray(v) for k, v in tensor_dict.items()})
+    # np.savez appends .npz when the target lacks it
+    os.replace(tmp if os.path.exists(tmp) else tmp + ".npz", path)
 
 
 def read_artifact_as_tensor_dict(path: str) -> Dict[str, np.ndarray]:

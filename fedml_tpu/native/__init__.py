@@ -69,6 +69,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
         ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
         ctypes.c_int,
     ]
+    lib.gather_windows_i32.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int,
+    ]
     lib.prefetcher_create.restype = ctypes.c_void_p
     lib.prefetcher_create.argtypes = [
         ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
@@ -114,6 +119,25 @@ def gather_rows(src: np.ndarray, idx: np.ndarray, threads: int = 4) -> np.ndarra
         lib.gather_rows_i32(_iptr(src), iptr, k, row, _iptr(out), threads)
     else:
         return src[idx]
+    return out
+
+
+def gather_windows(stream: np.ndarray, starts: np.ndarray, length: int,
+                   threads: int = 4) -> np.ndarray:
+    """stream[starts[i] : starts[i]+length] for every i — the LM corpus
+    batch slicer (cheetah). Threaded C++ memcpy when the lib is built;
+    vectorized numpy fancy-indexing fallback otherwise."""
+    stream = np.ascontiguousarray(stream, dtype=np.int32)
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    lib = get_lib()
+    if lib is None:
+        return stream[starts[:, None] + np.arange(length, dtype=np.int64)]
+    k = starts.shape[0]
+    out = np.empty((k, length), np.int32)
+    lib.gather_windows_i32(
+        _iptr(stream), starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        k, length, _iptr(out), threads,
+    )
     return out
 
 

@@ -136,6 +136,35 @@ void gather_rows_i32(const int32_t* src, const int64_t* idx, int64_t k,
                    reinterpret_cast<char*>(dst), threads);
 }
 
+// Gather k overlapping windows stream[starts[i] : starts[i]+len] — the LM
+// batch slicer (cheetah corpus sampling). Windows overlap arbitrarily, so
+// this cannot be expressed as a row gather over a materialized [N, len]
+// matrix without first copying the whole stream len times.
+void gather_windows_i32(const int32_t* stream, const int64_t* starts,
+                        int64_t k, int64_t len, int32_t* dst, int threads) {
+  if (threads < 1) threads = 1;
+  const int64_t bytes = len * static_cast<int64_t>(sizeof(int32_t));
+  auto copy_range = [=](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      std::memcpy(reinterpret_cast<char*>(dst) + i * bytes,
+                  reinterpret_cast<const char*>(stream + starts[i]), bytes);
+    }
+  };
+  if (threads == 1 || k < 4 * threads) {
+    copy_range(0, k);
+    return;
+  }
+  std::vector<std::thread> pool;
+  int64_t chunk = (k + threads - 1) / threads;
+  for (int t = 0; t < threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = std::min(lo + chunk, k);
+    if (lo >= hi) break;
+    pool.emplace_back([=] { copy_range(lo, hi); });
+  }
+  for (auto& th : pool) th.join();
+}
+
 void* prefetcher_create(const float* x, const int32_t* y, int64_t n,
                         int64_t row_elems, int64_t y_elems, int64_t batch,
                         uint64_t seed, int gather_threads, int depth) {

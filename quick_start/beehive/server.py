@@ -74,7 +74,8 @@ def fake_device(device_id: str, rounds: int, x, y) -> None:
                 seen = mtime
                 break
             time.sleep(0.1)
-        time.sleep(0.1)  # let the publish finish writing
+        # publish is atomic (temp-file + os.replace), so a visible mtime
+        # change means a complete archive — no grace sleep needed
         tensors = read_artifact_as_tensor_dict(GLOBAL)
         updated = local_sgd(tensors, x, y)
         path = os.path.join(UPLOADS, f"client_{device_id}.npz")

@@ -63,3 +63,15 @@ class TestPrefetcher:
         pf.next()
         pf.close()
         pf.close()
+
+
+def test_gather_windows_matches_numpy():
+    rng = np.random.default_rng(3)
+    stream = rng.integers(0, 1000, size=5000).astype(np.int32)
+    starts = rng.integers(0, 5000 - 64, size=37)
+    out = native.gather_windows(stream, starts, 64)
+    expect = stream[np.asarray(starts)[:, None] + np.arange(64)]
+    np.testing.assert_array_equal(out, expect)
+    # overlapping windows are legal (LM sampling overlaps freely)
+    out2 = native.gather_windows(stream, np.array([0, 1, 2]), 16)
+    np.testing.assert_array_equal(out2[1], stream[1:17])
