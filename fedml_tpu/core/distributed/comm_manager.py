@@ -168,3 +168,11 @@ class FedMLCommManager(Observer):
                 f"unsupported comm backend {self.backend!r}; "
                 f"known: {constants.COMM_BACKENDS}"
             )
+        # fault injection (SURVEY §5 upgrade — the reference has none):
+        # a FaultPlan on args wraps the transport so recovery paths are
+        # testable deterministically; production FSMs stay unaware
+        plan = getattr(self.args, "fault_plan", None)
+        if plan is not None:
+            from .faults import FaultyComm
+
+            self.com_manager = FaultyComm(self.com_manager, plan, self.rank)

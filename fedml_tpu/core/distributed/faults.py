@@ -1,0 +1,138 @@
+"""Deterministic fault injection for the message plane.
+
+reference: none — SURVEY.md §5 records the reference has **no fault
+injection** harness (its only failure tooling is MQTT last-will + fail-stop
+``MPI.Abort``). This module is the upgrade the blueprint calls for: system
+faults (lost messages, delays, crashed peers) injected AT THE TRANSPORT, so
+every recovery path — round deadlines, straggler revival, OFFLINE handling,
+LightSecAgg dropout tolerance — is testable deterministically, with the
+production FSMs completely unaware.
+
+``FaultyComm`` wraps any ``BaseCommunicationManager`` (loopback/gRPC/MQTT)
+and applies a ``FaultPlan``:
+
+- ``drop(sender, receiver, round)`` — a specific message class vanishes;
+- ``delay(sender, receiver, seconds)`` — link latency;
+- ``crash(rank, after_sends)`` — the wrapped node stops sending AND
+  receiving after its Nth send (0 = dead from the start), like a killed
+  process (its queue goes dark, not its python object);
+- ``loss(p, seed)`` — seeded Bernoulli message loss, reproducible.
+
+Rules match on the Message header only (sender/receiver/round), never on
+payloads, so injection composes with compression/encryption layers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from .base_com_manager import BaseCommunicationManager, Observer
+from .message import Message
+
+
+@dataclass
+class FaultPlan:
+    """Declarative fault schedule; all rules are optional and compose."""
+
+    drops: List[dict] = field(default_factory=list)
+    delays: List[dict] = field(default_factory=list)
+    crash_rank: Optional[int] = None
+    crash_after_sends: int = 0
+    loss_p: float = 0.0
+    loss_seed: int = 0
+
+    def drop(self, sender: Optional[int] = None,
+             receiver: Optional[int] = None,
+             round_idx: Optional[int] = None) -> "FaultPlan":
+        self.drops.append(
+            {"sender": sender, "receiver": receiver, "round": round_idx}
+        )
+        return self
+
+    def delay(self, seconds: float, sender: Optional[int] = None,
+              receiver: Optional[int] = None) -> "FaultPlan":
+        self.delays.append(
+            {"sender": sender, "receiver": receiver, "seconds": seconds}
+        )
+        return self
+
+    def crash(self, rank: int, after_sends: int = 0) -> "FaultPlan":
+        self.crash_rank = rank
+        self.crash_after_sends = after_sends
+        return self
+
+    def loss(self, p: float, seed: int = 0) -> "FaultPlan":
+        self.loss_p = float(p)
+        self.loss_seed = int(seed)
+        return self
+
+
+def _matches(rule: dict, msg: Message) -> bool:
+    if rule.get("sender") is not None and msg.get_sender_id() != rule["sender"]:
+        return False
+    if (rule.get("receiver") is not None
+            and msg.get_receiver_id() != rule["receiver"]):
+        return False
+    if rule.get("round") is not None:
+        msg_round = msg.get(Message.MSG_ARG_KEY_ROUND_IDX)
+        if msg_round is None or int(msg_round) != rule["round"]:
+            return False
+    return True
+
+
+class FaultyComm(BaseCommunicationManager):
+    """Transport wrapper applying a :class:`FaultPlan` on the send path."""
+
+    def __init__(self, inner: BaseCommunicationManager, plan: FaultPlan,
+                 rank: Optional[int] = None):
+        self.inner = inner
+        self.plan = plan
+        self.rank = rank if rank is not None else getattr(inner, "rank", -1)
+        self._sends = 0
+        self._crashed = False
+        self._rng = np.random.RandomState(plan.loss_seed)
+        self._lock = threading.Lock()
+
+    # -- fault logic --------------------------------------------------------
+
+    def _should_drop(self, msg: Message) -> bool:
+        with self._lock:
+            if self._crashed:
+                return True
+            # after_sends=0 means crashed-from-the-start: no send ever leaves
+            if (self.plan.crash_rank == self.rank
+                    and self._sends >= self.plan.crash_after_sends):
+                self._crashed = True
+                self.inner.stop_receive_message()  # the process is gone
+                return True
+            self._sends += 1
+            if self.plan.loss_p > 0 and self._rng.rand() < self.plan.loss_p:
+                return True
+        return any(_matches(r, msg) for r in self.plan.drops)
+
+    # -- BaseCommunicationManager -------------------------------------------
+
+    def send_message(self, msg: Message) -> None:
+        if self._should_drop(msg):
+            return
+        for rule in self.plan.delays:
+            if _matches(rule, msg):
+                time.sleep(float(rule["seconds"]))
+        self.inner.send_message(msg)
+
+    def add_observer(self, observer: Observer) -> None:
+        self.inner.add_observer(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self.inner.remove_observer(observer)
+
+    def handle_receive_message(self) -> None:
+        self.inner.handle_receive_message()
+
+    def stop_receive_message(self) -> None:
+        self.inner.stop_receive_message()

@@ -36,10 +36,9 @@ def write_tensor_dict_to_artifact(tensor_dict: Dict[str, np.ndarray],
     """
     path = os.path.abspath(path)
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    tmp = path + ".tmp"
+    tmp = path + ".tmp.npz"  # .npz suffix: np.savez writes exactly here
     np.savez(tmp, **{k: np.asarray(v) for k, v in tensor_dict.items()})
-    # np.savez appends .npz when the target lacks it
-    os.replace(tmp if os.path.exists(tmp) else tmp + ".npz", path)
+    os.replace(tmp, path)
 
 
 def read_artifact_as_tensor_dict(path: str) -> Dict[str, np.ndarray]:
