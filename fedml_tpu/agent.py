@@ -57,6 +57,7 @@ STATUS_FAILED = "FAILED"
 STATE_FILE = "agent_state.json"
 PENDING_SUFFIX = ".job.json"
 CLAIMED_SUFFIX = ".job.claimed"
+STOP_SUFFIX = ".stop"
 
 
 # ---------------------------------------------------------------------------
@@ -128,7 +129,7 @@ def submit_job(package_zip: str, jobs_dir: str,
 
 def request_stop(job_id: str, jobs_dir: str) -> None:
     """Drop the stop file (analog of the platform's stop-run message)."""
-    with open(os.path.join(jobs_dir, f"{job_id}.stop"), "w") as f:
+    with open(os.path.join(jobs_dir, f"{job_id}{STOP_SUFFIX}"), "w") as f:
         f.write(str(time.time()))
 
 
@@ -221,8 +222,17 @@ class Agent:
                 os.rename(src, dst)  # atomic: exactly one agent wins
             except OSError:
                 continue
-            with open(dst) as f:
-                return json.load(f)
+            try:
+                # rename preserves the descriptor's submit-time mtime; stamp
+                # the claim NOW so a peer's stale-claim reviver measures age
+                # from claim time, not from however long the job queued.
+                # Failure means a reviver stole the claim back in the
+                # rename→utime window — treat it as a lost claim.
+                os.utime(dst)
+                with open(dst) as f:
+                    return json.load(f)
+            except OSError:
+                continue
         return None
 
     # -- one job ------------------------------------------------------------
@@ -256,7 +266,7 @@ class Agent:
             return JobResult(job_id, STATUS_FAILED, None, "")
 
         self._report(job_id, STATUS_INITIALIZING, entry_point=entry)
-        stop_file = os.path.join(self.jobs_dir, f"{job_id}.stop")
+        stop_file = os.path.join(self.jobs_dir, f"{job_id}{STOP_SUFFIX}")
         claim_path = os.path.join(self.jobs_dir, f"{job_id}{CLAIMED_SUFFIX}")
         log_path = os.path.join(run_dir, "job.log")
         last_heartbeat = time.time()
@@ -296,11 +306,14 @@ class Agent:
         if desc is None:
             return None
         result = self._run_job(desc)
-        try:  # the claim is done with — stop it looking like a stale one
-            os.remove(os.path.join(
-                self.jobs_dir, f"{desc['job_id']}{CLAIMED_SUFFIX}"))
-        except OSError:
-            pass
+        for leftover in (f"{desc['job_id']}{CLAIMED_SUFFIX}",
+                         f"{desc['job_id']}{STOP_SUFFIX}"):
+            # drop the claim (stop it looking stale) and any stop file, so a
+            # resubmitted job_id isn't killed at startup by a stale kill switch
+            try:
+                os.remove(os.path.join(self.jobs_dir, leftover))
+            except OSError:
+                pass
         return result
 
     def run_forever(self, max_jobs: Optional[int] = None) -> None:

@@ -49,8 +49,14 @@ class RemoteConfig:
     @classmethod
     def get_instance(cls, uri: Optional[str] = None,
                      cache_dir: str = DEFAULT_CACHE_DIR) -> "RemoteConfig":
+        """Return the process-wide env-configured instance, or a fresh
+        standalone one when explicit parameters are passed — an explicit
+        ``uri``/``cache_dir`` must not silently repoint unrelated callers,
+        and must not be silently ignored because an instance already exists."""
+        if uri is not None or cache_dir != DEFAULT_CACHE_DIR:
+            return cls(uri, cache_dir)
         with cls._lock:
-            if cls._instance is None or uri is not None:
+            if cls._instance is None:
                 cls._instance = cls(uri, cache_dir)
             return cls._instance
 

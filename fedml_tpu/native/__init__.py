@@ -129,6 +129,14 @@ def gather_windows(stream: np.ndarray, starts: np.ndarray, length: int,
     vectorized numpy fancy-indexing fallback otherwise."""
     stream = np.ascontiguousarray(stream, dtype=np.int32)
     starts = np.ascontiguousarray(starts, dtype=np.int64)
+    if starts.size and (int(starts.min()) < 0
+                        or int(starts.max()) + length > stream.size):
+        # the C++ path is a raw memcpy with no bounds checks; validate here so
+        # bad input raises on both paths instead of reading garbage natively
+        raise ValueError(
+            f"window out of range: starts in [{starts.min()}, {starts.max()}]"
+            f" + length {length} vs stream size {stream.size}"
+        )
     lib = get_lib()
     if lib is None:
         return stream[starts[:, None] + np.arange(length, dtype=np.int64)]

@@ -116,6 +116,7 @@ def spawn(worker_argv: Sequence[str], n_processes: int,
     processes; raises if any exits nonzero, with its tail echoed.
     """
     import threading
+    import time
 
     port = coordinator_port or free_port()
     procs = []
@@ -145,8 +146,11 @@ def spawn(worker_argv: Sequence[str], n_processes: int,
                 for i, p in enumerate(procs)]
     for t in drainers:
         t.start()
+    # one shared deadline: n sequential joins must not stretch the documented
+    # timeout to n * timeout_s
+    deadline = time.monotonic() + timeout_s
     for t in drainers:
-        t.join(timeout=timeout_s)
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
     if any(t.is_alive() for t in drainers):
         for q in procs:
             q.kill()

@@ -75,3 +75,16 @@ def test_gather_windows_matches_numpy():
     # overlapping windows are legal (LM sampling overlaps freely)
     out2 = native.gather_windows(stream, np.array([0, 1, 2]), 16)
     np.testing.assert_array_equal(out2[1], stream[1:17])
+
+
+def test_gather_windows_rejects_out_of_range():
+    import pytest
+
+    stream = np.arange(100, dtype=np.int32)
+    with pytest.raises(ValueError):
+        native.gather_windows(stream, np.array([-1]), 10)
+    with pytest.raises(ValueError):
+        native.gather_windows(stream, np.array([95]), 10)
+    # exactly-at-the-end window is fine
+    out = native.gather_windows(stream, np.array([90]), 10)
+    np.testing.assert_array_equal(out[0], stream[90:100])

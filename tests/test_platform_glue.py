@@ -236,6 +236,65 @@ def test_agent_requeues_stale_claim(tmp_path):
     assert not os.path.exists(claimed)  # finished claims are reaped
 
 
+def test_claim_refreshes_mtime_so_queued_age_does_not_count(tmp_path):
+    # a job that sat in the queue longer than stale_claim_s must NOT look
+    # stale the instant it is claimed (ADVICE r2: rename preserves submit
+    # mtime, letting a peer steal and double-run the job)
+    pkg = _make_package(tmp_path, "aged", "print('ran')\n")
+    jobs = str(tmp_path / "jobs")
+    job_id = submit_job(pkg, jobs)
+    pending = os.path.join(jobs, f"{job_id}.job.json")
+    os.utime(pending, (os.path.getmtime(pending) - 10_000.0,) * 2)
+
+    agent = Agent(jobs, str(tmp_path / "work"), stale_claim_s=3600.0)
+    desc = agent._claim_next()
+    assert desc["job_id"] == job_id
+    claimed = os.path.join(jobs, f"{job_id}.job.claimed")
+    import time as _time
+    assert _time.time() - os.path.getmtime(claimed) < 60.0
+    # a peer's reviver pass leaves the fresh claim alone
+    peer = Agent(jobs, str(tmp_path / "work2"), stale_claim_s=3600.0)
+    peer._requeue_stale_claims()
+    assert os.path.exists(claimed)
+    assert not os.path.exists(pending)
+
+
+def test_stop_file_cleared_so_resubmitted_job_id_runs(tmp_path):
+    from fedml_tpu.agent import request_stop
+
+    pkg = _make_package(tmp_path, "stopme",
+                        "import time\n"
+                        "open('started', 'w').close()\n"
+                        "time.sleep(60)\n")
+    jobs = str(tmp_path / "jobs")
+    agent = Agent(jobs, str(tmp_path / "work"))
+    job_id = submit_job(pkg, jobs, job_id="job-fixed")
+    request_stop(job_id, jobs)  # stop lands before the job even starts
+    result = agent.run_once()
+    assert result.status in (STATUS_FINISHED, STATUS_FAILED)
+    # the kill switch must not survive to murder a resubmission of the id
+    assert not os.path.exists(os.path.join(jobs, f"{job_id}.stop"))
+    ok_pkg = _make_package(tmp_path, "ok3", "print('second life')\n")
+    submit_job(ok_pkg, jobs, job_id="job-fixed")
+    result2 = agent.run_once()
+    assert result2.status == STATUS_FINISHED
+
+
+def test_remote_config_explicit_params_do_not_hijack_singleton(tmp_path):
+    RemoteConfig.reset_instance()
+    default = RemoteConfig.get_instance()
+    src = tmp_path / "cfg.json"
+    src.write_text(json.dumps({"mqtt_config": {"host": "x"}}))
+    explicit = RemoteConfig.get_instance(str(src),
+                                         cache_dir=str(tmp_path / "c"))
+    # explicit params → standalone instance honoring BOTH params...
+    assert explicit.uri == str(src)
+    assert explicit.cache_dir == str(tmp_path / "c")
+    # ...and the process-wide default is untouched
+    assert RemoteConfig.get_instance() is default
+    RemoteConfig.reset_instance()
+
+
 def test_login_logout_roundtrip(tmp_path):
     sd = str(tmp_path / "state")
     state = login("acct-7", role="server", state_dir=sd)
