@@ -157,6 +157,10 @@ class Agent:
         self.jobs_dir = jobs_dir
         self.work_dir = work_dir
         self.role = role
+        # claims are renamed to an agent-unique filename: success of any later
+        # operation on OUR claim path then proves ownership (a same-named path
+        # recreated by a peer after a steal cannot alias ours)
+        self.agent_id = uuid.uuid4().hex[:8]
         self.python_exe = python_exe or sys.executable
         self.poll_interval_s = poll_interval_s
         self.stale_claim_s = stale_claim_s
@@ -193,7 +197,7 @@ class Agent:
         the reference daemon's restart-and-rerun loop (client_daemon.py)."""
         now = time.time()
         for fn in os.listdir(self.jobs_dir):
-            if not fn.endswith(CLAIMED_SUFFIX):
+            if CLAIMED_SUFFIX not in fn:
                 continue
             path = os.path.join(self.jobs_dir, fn)
             try:
@@ -202,7 +206,9 @@ class Agent:
                 continue  # finished and removed under us
             if age < self.stale_claim_s:
                 continue
-            pending = path[: -len(CLAIMED_SUFFIX)] + PENDING_SUFFIX
+            pending = (
+                path[: path.index(CLAIMED_SUFFIX)] + PENDING_SUFFIX
+            )
             try:
                 os.rename(path, pending)
                 logger.warning("requeued stale claim %s (%.0fs old)", fn, age)
@@ -217,7 +223,8 @@ class Agent:
         )
         for fn in pending:
             src = os.path.join(self.jobs_dir, fn)
-            dst = src[: -len(PENDING_SUFFIX)] + CLAIMED_SUFFIX
+            dst = (src[: -len(PENDING_SUFFIX)] + CLAIMED_SUFFIX
+                   + "." + self.agent_id)
             try:
                 os.rename(src, dst)  # atomic: exactly one agent wins
             except OSError:
@@ -227,10 +234,14 @@ class Agent:
                 # the claim NOW so a peer's stale-claim reviver measures age
                 # from claim time, not from however long the job queued.
                 # Failure means a reviver stole the claim back in the
-                # rename→utime window — treat it as a lost claim.
+                # rename→utime window — and because dst embeds OUR agent_id,
+                # a peer re-claiming the job can never recreate this path,
+                # so failure here is a definitive lost-claim signal.
                 os.utime(dst)
                 with open(dst) as f:
-                    return json.load(f)
+                    desc = json.load(f)
+                desc["_claim_path"] = dst
+                return desc
             except OSError:
                 continue
         return None
@@ -267,9 +278,10 @@ class Agent:
 
         self._report(job_id, STATUS_INITIALIZING, entry_point=entry)
         stop_file = os.path.join(self.jobs_dir, f"{job_id}{STOP_SUFFIX}")
-        claim_path = os.path.join(self.jobs_dir, f"{job_id}{CLAIMED_SUFFIX}")
+        claim_path = desc.get("_claim_path")
         log_path = os.path.join(run_dir, "job.log")
         last_heartbeat = time.time()
+        claim_lost = False
         with open(log_path, "w") as log_f:
             proc = subprocess.Popen(
                 [self.python_exe, entry, *desc.get("run_args", [])],
@@ -289,11 +301,25 @@ class Agent:
                 if now - last_heartbeat > 30.0:
                     last_heartbeat = now
                     try:  # keep the claim fresh so peers don't steal it
-                        os.utime(claim_path)
+                        if claim_path is not None:
+                            os.utime(claim_path)
                     except OSError:
-                        pass
+                        # our agent-unique claim file is gone: a reviver
+                        # re-pended the job (we stalled past stale_claim_s)
+                        # and a peer may be re-running it — kill our copy
+                        # rather than double-execute
+                        claim_lost = True
+                        proc.terminate()
+                        try:
+                            proc.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            proc.kill()
+                        break
                 time.sleep(0.1)
             rc = proc.wait()
+        if claim_lost:
+            self._report(job_id, STATUS_FAILED, error="claim lost to reviver")
+            return JobResult(job_id, STATUS_FAILED, rc, run_dir)
         status = STATUS_FINISHED if rc == 0 else STATUS_FAILED
         self._report(job_id, status, returncode=rc)
         return JobResult(job_id, status, rc, run_dir)
@@ -306,12 +332,21 @@ class Agent:
         if desc is None:
             return None
         result = self._run_job(desc)
-        for leftover in (f"{desc['job_id']}{CLAIMED_SUFFIX}",
-                         f"{desc['job_id']}{STOP_SUFFIX}"):
-            # drop the claim (stop it looking stale) and any stop file, so a
-            # resubmitted job_id isn't killed at startup by a stale kill switch
+        # drop our claim (stop it looking stale); only if that succeeds —
+        # ownership proof — also clear the stop file, so a resubmitted job_id
+        # isn't killed at startup by a stale kill switch. A zombie agent whose
+        # claim was stolen must NOT delete a stop aimed at the peer's re-run.
+        owned = True
+        claim = desc.get("_claim_path")
+        if claim is not None:
             try:
-                os.remove(os.path.join(self.jobs_dir, leftover))
+                os.remove(claim)
+            except OSError:
+                owned = False
+        if owned:
+            try:
+                os.remove(os.path.join(
+                    self.jobs_dir, f"{desc['job_id']}{STOP_SUFFIX}"))
             except OSError:
                 pass
         return result

@@ -290,27 +290,7 @@ class PipelineCheetah:
     def train_step(self, params, opt_state, tokens, mask):
         if self._step is None:
             p_spec, d_spec = self._specs()
-            # opt state mirrors param sharding (adam moments have the
-            # params' shapes); match specs to leaves by shape
-            import jax.tree_util as jtu
-
-            def spec_like(tree):
-                p_flat, p_def = jtu.tree_flatten(params)
-                ps_flat, _ = jtu.tree_flatten(p_spec)
-                spec_by_shape = {}
-                for leaf, sp in zip(p_flat, ps_flat):
-                    spec_by_shape.setdefault(
-                        tuple(leaf.shape), sp
-                    )
-
-                def one(x):
-                    if hasattr(x, "shape") and tuple(x.shape) in spec_by_shape:
-                        return spec_by_shape[tuple(x.shape)]
-                    return P()
-
-                return jax.tree.map(one, tree)
-
-            o_spec = spec_like(opt_state)
+            o_spec = _opt_state_specs(p_spec, opt_state)
             fn = shard_map(
                 self._train_step_device, mesh=self.mesh,
                 in_specs=(p_spec, o_spec, d_spec, d_spec),
@@ -319,6 +299,49 @@ class PipelineCheetah:
             self._step = jax.jit(fn)
         with self.mesh:
             return self._step(params, opt_state, tokens, mask)
+
+
+def _path_keys(path) -> tuple:
+    """Normalize a jax key path to plain hashable tokens."""
+    out = []
+    for k in path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(k, attr):
+                out.append(str(getattr(k, attr)))
+                break
+        else:
+            out.append(str(k))
+    return tuple(out)
+
+
+def _opt_state_specs(p_spec: PyTree, opt_state: PyTree) -> PyTree:
+    """PartitionSpecs for an optimizer state mirroring param sharding.
+
+    Optimizer moments (adam mu/nu, momentum buffers, ...) embed the param
+    tree inside wrapper structures, so an opt-state leaf's key path ENDS
+    with the corresponding param's key path — match by longest path suffix,
+    never by leaf shape (two same-shaped params with different shardings
+    would collide silently). Scalars like adam's ``count`` match nothing
+    and stay replicated.
+    """
+    import jax.tree_util as jtu
+
+    spec_by_path = {
+        _path_keys(path): sp
+        for path, sp in jtu.tree_flatten_with_path(
+            p_spec, is_leaf=lambda x: isinstance(x, P)
+        )[0]
+    }
+
+    def one(path, _x):
+        keys = _path_keys(path)
+        for start in range(len(keys)):  # longest suffix first
+            sp = spec_by_path.get(keys[start:])
+            if sp is not None:
+                return sp
+        return P()
+
+    return jtu.tree_map_with_path(one, opt_state)
 
 
 def microbatch(tokens: np.ndarray, mask: np.ndarray, m: int):

@@ -143,6 +143,17 @@ class FedAvgAPI:
         self.attacker.init(args)
         self.defender = FedMLDefender.get_instance()
         self.defender.init(args)
+        if self.custom_aggregator is not None and self.defender.is_defense_enabled():
+            # a robust defense (krum/median/...) IS the aggregation rule — it
+            # cannot compose with a user ServerAggregator override. Silently
+            # dropping either one would betray whoever configured it, so fail
+            # fast. (Model attacks DO compose: they transform client rows
+            # before whatever aggregation runs — see _aggregate.)
+            raise ValueError(
+                "enable_defense and a custom ServerAggregator are mutually "
+                f"exclusive: defense_type={self.defender.defense_type!r} "
+                "replaces the aggregation rule. Disable one of them."
+            )
         self.dp = (
             FedPrivacyMechanism.from_args(args)
             if bool(getattr(args, "enable_dp", False))
@@ -299,13 +310,7 @@ class FedAvgAPI:
         needs_flat = self.attacker.is_model_attack() or self.defender.is_defense_enabled()
         if not needs_flat:
             if self.custom_aggregator is not None:
-                raw = [
-                    (float(weights[i]), jax.tree.map(lambda x: x[i], stacked))
-                    for i in range(n)
-                ]
-                raw = self.custom_aggregator.on_before_aggregation(raw)
-                agg = self.custom_aggregator.aggregate(raw)
-                return self.custom_aggregator.on_after_aggregation(agg)
+                return self._custom_aggregate(stacked, weights, n)
             return weighted_average(stacked, weights)
 
         # flatten to [n, dim] once for the attack/defense kernels; drop
@@ -325,10 +330,27 @@ class FedAvgAPI:
             agg_vec = self.defender.defend(
                 flat, weights, gvec, jax.random.fold_in(rng, 2), client_ids=ids
             )
+        elif self.custom_aggregator is not None:
+            # model attack + custom aggregator compose: the attack transformed
+            # the client rows, the user's rule aggregates whatever arrived
+            attacked = jax.vmap(
+                lambda v: tree_unflatten_from_vector(v, treedef, shapes)
+            )(flat)
+            return self._custom_aggregate(attacked, weights, int(weights.shape[0]))
         else:
             w = weights / jnp.maximum(weights.sum(), 1e-12)
             agg_vec = (w[:, None] * flat).sum(0)
         return tree_unflatten_from_vector(agg_vec, treedef, shapes)
+
+    def _custom_aggregate(self, stacked: PyTree, weights: jax.Array, n: int) -> PyTree:
+        """Run the user ServerAggregator's hook chain on the first n rows."""
+        raw = [
+            (float(weights[i]), jax.tree.map(lambda x: x[i], stacked))
+            for i in range(n)
+        ]
+        raw = self.custom_aggregator.on_before_aggregation(raw)
+        agg = self.custom_aggregator.aggregate(raw)
+        return self.custom_aggregator.on_after_aggregation(agg)
 
     # -- the training loop (reference: fedavg_api.py:65-123) ----------------
     def train(self) -> Dict[str, float]:

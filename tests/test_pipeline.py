@@ -121,3 +121,32 @@ class TestPipelineParity:
         )
         for g, w in zip(jax.tree.leaves(got_b), jax.tree.leaves(ref_grads["blocks"])):
             np.testing.assert_allclose(g, np.asarray(w), rtol=5e-2, atol=5e-4)
+
+
+def test_opt_state_specs_match_by_path_not_shape():
+    """Two same-shaped params with DIFFERENT shardings must not collide when
+    optimizer-state specs are derived (was: matched by leaf shape)."""
+    from jax.sharding import PartitionSpec as P
+
+    from fedml_tpu.parallel.pipeline import _opt_state_specs
+
+    params = {
+        "stacked": jnp.ones((4, 8)),      # sharded over pipeline
+        "replicated": jnp.ones((4, 8)),   # same shape, replicated
+    }
+    p_spec = {"stacked": P("pipeline"), "replicated": P()}
+    opt_state = optax.adam(1e-3).init(params)
+    o_spec = _opt_state_specs(p_spec, opt_state)
+    flat = jax.tree_util.tree_flatten_with_path(
+        o_spec, is_leaf=lambda x: isinstance(x, P)
+    )[0]
+    by_path = {"/".join(map(str, [getattr(k, "key", getattr(k, "name", k))
+                                  for k in path])): sp
+               for path, sp in flat}
+    for name, sp in by_path.items():
+        if name.endswith("stacked") and ("mu" in name or "nu" in name):
+            assert sp == P("pipeline"), (name, sp)
+        elif name.endswith("replicated"):
+            assert sp == P(), (name, sp)
+        elif "count" in name:
+            assert sp == P(), (name, sp)

@@ -249,7 +249,9 @@ def test_claim_refreshes_mtime_so_queued_age_does_not_count(tmp_path):
     agent = Agent(jobs, str(tmp_path / "work"), stale_claim_s=3600.0)
     desc = agent._claim_next()
     assert desc["job_id"] == job_id
-    claimed = os.path.join(jobs, f"{job_id}.job.claimed")
+    # the claim filename is agent-unique so utime/open success proves
+    # ownership even if a reviver re-pends and a peer re-claims the job
+    claimed = os.path.join(jobs, f"{job_id}.job.claimed.{agent.agent_id}")
     import time as _time
     assert _time.time() - os.path.getmtime(claimed) < 60.0
     # a peer's reviver pass leaves the fresh claim alone

@@ -176,3 +176,46 @@ class TestCustomSeams:
         res = runner.run()
         assert calls["before"] == 2 and calls["after"] == 2
         assert res["test_acc"] > 0.3
+
+    def test_custom_aggregator_with_defense_raises(self):
+        """Defense replaces the aggregation rule — combining it with a user
+        ServerAggregator must error, not silently drop the override."""
+        from fedml_tpu.ml.aggregator import DefaultServerAggregator
+        from fedml_tpu.simulation.sp_api import FedAvgAPI
+
+        args = fedml.init(Arguments(overrides=dict(
+            dataset="synthetic", model="lr", client_num_in_total=8,
+            client_num_per_round=4, comm_round=1, epochs=1, batch_size=16,
+            learning_rate=0.1, enable_defense=True, defense_type="krum",
+            byzantine_client_num=1,
+        )), should_init_logs=False)
+        ds, od = data_mod.load(args)
+        bundle = model_mod.create(args, od)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            FedAvgAPI(args, fedml.get_device(args), ds, bundle,
+                      server_aggregator=DefaultServerAggregator(bundle, args))
+
+    def test_custom_aggregator_composes_with_model_attack(self):
+        """A model attack transforms client rows; the user's aggregation
+        rule must still run on the attacked rows (was: silently bypassed)."""
+        from fedml_tpu.ml.aggregator import DefaultServerAggregator
+
+        calls = {"agg": 0}
+
+        class MyAgg(DefaultServerAggregator):
+            def aggregate(self, raw):
+                calls["agg"] += 1
+                return super().aggregate(raw)
+
+        args = fedml.init(Arguments(overrides=dict(
+            dataset="synthetic", model="lr", client_num_in_total=8,
+            client_num_per_round=4, comm_round=2, epochs=1, batch_size=16,
+            learning_rate=0.1, enable_attack=True,
+            attack_type="byzantine_zero", byzantine_client_frac=0.25,
+        )), should_init_logs=False)
+        ds, od = data_mod.load(args)
+        bundle = model_mod.create(args, od)
+        runner = FedMLRunner(args, fedml.get_device(args), ds, bundle,
+                             server_aggregator=MyAgg(bundle, args))
+        runner.run()
+        assert calls["agg"] == 2
