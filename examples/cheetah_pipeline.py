@@ -1,6 +1,10 @@
 """GPipe pipeline parallelism: 2 stages (needs >= 2 devices; on one host
 set XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu)."""
 
+# run-from-checkout shim: make the repo importable without `pip install -e .`
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
+
 import jax
 import jax.numpy as jnp
 import numpy as np

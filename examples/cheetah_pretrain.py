@@ -1,6 +1,10 @@
 """Cheetah: sharded LLM pretraining over a dp/fsdp/tp mesh. On a 1-chip
 host the mesh collapses to single-device; same program either way."""
 
+# run-from-checkout shim: make the repo importable without `pip install -e .`
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
+
 import fedml_tpu as fedml
 from fedml_tpu.arguments import Arguments
 from fedml_tpu.runner import FedMLRunner

@@ -1,5 +1,9 @@
 """EF-TopK compressed update deltas + payload-by-reference transport."""
 
+# run-from-checkout shim: make the repo importable without `pip install -e .`
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
+
 import tempfile
 import threading
 import time

@@ -1,6 +1,10 @@
 """Hierarchical cross-silo: a 2-chip silo (per-step gradient psum over a
 local mesh) + a silo with a DCN slave (round-level averaging)."""
 
+# run-from-checkout shim: make the repo importable without `pip install -e .`
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
+
 import threading
 import time
 

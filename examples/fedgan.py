@@ -1,5 +1,9 @@
 """Federated GAN: both nets averaged every round."""
 
+# run-from-checkout shim: make the repo importable without `pip install -e .`
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
+
 import fedml_tpu as fedml
 from fedml_tpu import data as data_mod
 from fedml_tpu.arguments import Arguments

@@ -1,5 +1,9 @@
 """Federated semantic segmentation with mIoU reporting."""
 
+# run-from-checkout shim: make the repo importable without `pip install -e .`
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
+
 import fedml_tpu as fedml
 from fedml_tpu import data as data_mod, models as model_mod
 from fedml_tpu.arguments import Arguments
@@ -7,8 +11,8 @@ from fedml_tpu.runner import FedMLRunner
 
 args = fedml.init(Arguments(overrides=dict(
     dataset="pascal_voc", model="fcn", federated_optimizer="FedSeg",
-    client_num_in_total=4, client_num_per_round=4, comm_round=4, epochs=2,
-    batch_size=8, learning_rate=0.05,
+    client_num_in_total=4, client_num_per_round=4, comm_round=2, epochs=1,
+    batch_size=8, learning_rate=0.05, seg_model_width=16,
 )), should_init_logs=False)
 ds, od = data_mod.load(args)
 bundle = model_mod.create(args, od)

@@ -1,5 +1,9 @@
 """LightSecAgg: the server only ever sees masked models; dropout-tolerant."""
 
+# run-from-checkout shim: make the repo importable without `pip install -e .`
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
+
 import threading
 import time
 

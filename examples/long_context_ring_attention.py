@@ -18,6 +18,10 @@ Run: ``python long_context_ring_attention.py`` (~10 min on one host core —
 almost all XLA:CPU compile; seconds per step on real chips).
 """
 
+# run-from-checkout shim: make the repo importable without `pip install -e .`
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
+
 import os
 
 # virtual 4-device platform — must happen before jax backend init

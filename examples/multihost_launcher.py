@@ -13,6 +13,10 @@ On a real pod, skip spawn: run one process per host and call
 ``multihost.initialize()`` with no args.
 """
 
+# run-from-checkout shim: make the repo importable without `pip install -e .`
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
+
 import os
 import sys
 

@@ -50,6 +50,26 @@ def _try_natural_partition(name: str, cache_dir: str, spec: DatasetSpec):
         from .leaf import try_load_leaf_shakespeare
 
         return try_load_leaf_shakespeare(cache_dir, spec.seq_len)
+    if name == "stackoverflow_nwp":
+        from .real_readers import try_load_stackoverflow_nwp
+
+        return try_load_stackoverflow_nwp(cache_dir, seq_len=spec.seq_len)
+    if name == "stackoverflow_lr":
+        from .real_readers import try_load_stackoverflow_lr
+
+        return try_load_stackoverflow_lr(
+            cache_dir, vocab_size=spec.sample_shape[0], tag_size=spec.class_num
+        )
+    if name == "ILSVRC2012":
+        from .real_readers import try_load_imagenet
+
+        return try_load_imagenet(cache_dir, image_hw=spec.sample_shape[:2])
+    if name in ("gld23k", "gld160k"):
+        from .real_readers import try_load_landmarks
+
+        return try_load_landmarks(
+            cache_dir, name=name, image_hw=spec.sample_shape[:2]
+        )
     return None
 
 

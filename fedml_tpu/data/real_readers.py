@@ -1,0 +1,356 @@
+"""Real on-disk readers: stackoverflow lr/nwp, ImageNet folders, Landmarks.
+
+reference dispatch keys (``python/fedml/data/data_loader.py:30-330``):
+``stackoverflow_lr`` / ``stackoverflow_nwp`` (TFF h5 +
+``stackoverflow.word_count`` / ``stackoverflow.tag_count`` vocab files,
+``data/stackoverflow_nwp/dataset.py`` + ``utils.py``), ``ILSVRC2012``
+(ImageFolder layout, clients = class ranges — ``data/ImageNet/datasets.py:
+28-56`` ``make_dataset``), ``gld23k``/``gld160k`` (csv user→image→class
+mapping + image dir — ``data/Landmarks/data_loader.py:121-133``).
+
+Same contract as ``leaf.py``/``tff_h5.py``: each ``try_load_*`` returns
+``(client_xs, client_ys, test_x, test_y)`` with a NATURAL per-client
+partition when the files are staged under ``data_cache_dir``, else ``None``
+(synthetic fallback takes over). No downloads ever happen here.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import logging
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_EXAMPLE = "examples"
+
+SO_TRAIN = "stackoverflow_train.h5"
+SO_TEST = "stackoverflow_test.h5"
+SO_WORD_COUNT = "stackoverflow.word_count"
+SO_TAG_COUNT = "stackoverflow.tag_count"
+
+
+def _find(cache_dir: str, name: str, subs: Tuple[str, ...]) -> Optional[str]:
+    for sub in ("",) + subs:
+        p = os.path.join(cache_dir, sub, name)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+# ---------------------------------------------------------------------------
+# stackoverflow vocab (reference: stackoverflow_nwp/utils.py:19-50)
+# ---------------------------------------------------------------------------
+
+
+def _load_word_dict(path: str, vocab_size: int) -> Dict[str, int]:
+    """pad(0) + most-frequent words + bos + eos — ids match the reference's
+    ``get_word_dict`` ordering. Reads at most ``vocab_size`` words (the
+    reference hard-crashes on shorter files; we take what's there)."""
+    words = []
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if parts:
+                words.append(parts[0])
+            if len(words) >= vocab_size:
+                break
+    d = {"<pad>": 0}
+    for w in words:
+        d[w] = len(d)
+    d["<bos>"] = len(d)
+    d["<eos>"] = len(d)
+    return d
+
+
+def try_load_stackoverflow_nwp(cache_dir: str, seq_len: int = 20,
+                               vocab_size: int = 10000):
+    """Next-word prediction: h5 ``examples/<client>/tokens`` sentences →
+    [bos] + ids (+eos) padded rows; x = row[:-1], y = row[1:] (reference
+    ``dataset.py.__getitem__``). OOV = one hash bucket past eos."""
+    subs = ("stackoverflow", "stackoverflow_nwp")
+    train = _find(cache_dir, SO_TRAIN, subs)
+    test = _find(cache_dir, SO_TEST, subs)
+    wc = _find(cache_dir, SO_WORD_COUNT, subs)
+    if train is None or test is None or wc is None:
+        return None
+    import h5py
+
+    word_dict = _load_word_dict(wc, vocab_size)
+    bos, eos, oov = word_dict["<bos>"], word_dict["<eos>"], len(word_dict)
+
+    def encode(sentence: str) -> np.ndarray:
+        toks = sentence.split(" ")[:seq_len]
+        ids = [word_dict.get(t, oov) for t in toks]
+        if len(ids) < seq_len:
+            ids = ids + [eos]
+        ids = [bos] + ids
+        ids += [0] * (seq_len + 1 - len(ids))
+        return np.asarray(ids[: seq_len + 1], np.int32)
+
+    def load_split(path):
+        xs, ys = [], []
+        with h5py.File(path, "r") as h5:
+            for cid in sorted(h5[_EXAMPLE].keys()):
+                rows = [
+                    encode(s.decode("utf-8", errors="ignore")
+                           if isinstance(s, bytes) else str(s))
+                    for s in h5[_EXAMPLE][cid]["tokens"][()]
+                ]
+                if rows:
+                    arr = np.stack(rows)
+                    xs.append(arr[:, :-1])
+                    ys.append(arr[:, 1:])
+        return xs, ys
+
+    client_xs, client_ys = load_split(train)
+    if not client_xs:
+        return None
+    txs, tys = load_split(test)
+    test_x = np.concatenate(txs) if txs else client_xs[0][:0]
+    test_y = np.concatenate(tys) if tys else client_ys[0][:0]
+    logger.info("stackoverflow_nwp: %d clients, %d test rows from %s",
+                len(client_xs), len(test_x), train)
+    return client_xs, client_ys, test_x, test_y
+
+
+def try_load_stackoverflow_lr(cache_dir: str, vocab_size: int = 10000,
+                              tag_size: int = 500):
+    """Tag prediction: bag-of-words inputs (mean one-hot over the vocab,
+    OOV dropped — reference ``preprocess_inputs`` slices ``[:vocab_size]``)
+    and multi-hot tag targets over the ``tag_count`` JSON's top tags."""
+    subs = ("stackoverflow", "stackoverflow_lr")
+    train = _find(cache_dir, SO_TRAIN, subs)
+    test = _find(cache_dir, SO_TEST, subs)
+    wc = _find(cache_dir, SO_WORD_COUNT, subs)
+    tc = _find(cache_dir, SO_TAG_COUNT, subs)
+    if train is None or test is None or wc is None or tc is None:
+        return None
+    import h5py
+
+    word_dict = _load_word_dict(wc, vocab_size)
+    # BoW ids are the plain frequent-word ranks — the lr-side ``get_word_dict``
+    # (stackoverflow_lr/utils.py) has no pad/bos/eos specials
+    vocab = {w: i for i, w in enumerate(
+        w for w in word_dict if w not in ("<pad>", "<bos>", "<eos>")
+    )}
+    with open(tc) as f:
+        tags = list(json.load(f).keys())[:tag_size]
+    tag_dict = {t: i for i, t in enumerate(tags)}
+    V, T = len(vocab), len(tag_dict)
+
+    def bow(sentence: str) -> np.ndarray:
+        toks = sentence.split(" ")
+        out = np.zeros((V,), np.float32)
+        hits = 0
+        for t in toks:
+            i = vocab.get(t)
+            if i is not None:
+                out[i] += 1.0
+            hits += 1
+        return out / max(hits, 1)
+
+    def multihot(tagline: str) -> np.ndarray:
+        out = np.zeros((T,), np.float32)
+        for t in tagline.split("|"):
+            i = tag_dict.get(t)
+            if i is not None:
+                out[i] = 1.0
+        return out
+
+    def load_split(path):
+        xs, ys = [], []
+        with h5py.File(path, "r") as h5:
+            for cid in sorted(h5[_EXAMPLE].keys()):
+                g = h5[_EXAMPLE][cid]
+                sx = [bow(s.decode("utf-8", errors="ignore")
+                          if isinstance(s, bytes) else str(s))
+                      for s in g["tokens"][()]]
+                sy = [multihot(t.decode("utf-8", errors="ignore")
+                               if isinstance(t, bytes) else str(t))
+                      for t in g["tags"][()]]
+                if sx:
+                    xs.append(np.stack(sx))
+                    ys.append(np.stack(sy))
+        return xs, ys
+
+    client_xs, client_ys = load_split(train)
+    if not client_xs:
+        return None
+    txs, tys = load_split(test)
+    test_x = np.concatenate(txs) if txs else client_xs[0][:0]
+    test_y = np.concatenate(tys) if tys else client_ys[0][:0]
+    logger.info("stackoverflow_lr: %d clients (V=%d, T=%d) from %s",
+                len(client_xs), V, T, train)
+    return client_xs, client_ys, test_x, test_y
+
+
+# ---------------------------------------------------------------------------
+# image folders (ImageNet) and csv-mapped images (Landmarks)
+# ---------------------------------------------------------------------------
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif")
+
+
+def _read_image(path: str, hw: Tuple[int, int]) -> np.ndarray:
+    from PIL import Image
+
+    with Image.open(path) as im:
+        im = im.convert("RGB").resize((hw[1], hw[0]))
+        return np.asarray(im, np.float32) / 255.0
+
+
+def try_load_imagenet(cache_dir: str, image_hw: Tuple[int, int] = (224, 224),
+                      max_per_client: int = 256, max_test: int = 10_000):
+    """ImageFolder layout ``<root>/train/<class>/*`` + ``<root>/val/...``;
+    natural partition = one client per class directory (the reference's
+    ``net_dataidx_map`` is exactly the per-class index ranges).
+
+    Decoding is bounded (``max_per_client`` images per class,
+    ``max_test`` total val images): the packed [clients, cap, H, W, 3]
+    float32 layout cannot hold full ILSVRC2012 (~770 GB) — a full-scale run
+    needs the host-streaming path, not this eager reader. Bounds hit are
+    logged, never silent."""
+    root = None
+    for sub in ("ILSVRC2012", "imagenet", "ImageNet"):
+        p = os.path.join(cache_dir, sub)
+        if os.path.isdir(os.path.join(p, "train")):
+            root = p
+            break
+    if root is None:
+        return None
+
+    def class_dirs(split):
+        d = os.path.join(root, split)
+        if not os.path.isdir(d):
+            return []
+        return sorted(
+            c for c in os.listdir(d) if os.path.isdir(os.path.join(d, c))
+        )
+
+    classes = class_dirs("train")
+    if not classes:
+        return None
+    class_to_idx = {c: i for i, c in enumerate(classes)}
+
+    def load_split(split, per_dir) -> Tuple[List[np.ndarray], List[np.ndarray]]:
+        xs, ys = [], []
+        truncated = 0
+        for c in class_dirs(split):
+            d = os.path.join(root, split, c)
+            files = sorted(
+                f for f in os.listdir(d)
+                if f.lower().endswith(IMG_EXTENSIONS)
+            )
+            if len(files) > per_dir:
+                truncated += 1
+                files = files[:per_dir]
+            imgs = [_read_image(os.path.join(d, f), image_hw) for f in files]
+            if imgs:
+                xs.append(np.stack(imgs))
+                ys.append(np.full((len(imgs),), class_to_idx[c], np.int32))
+        if truncated:
+            logger.warning(
+                "ILSVRC2012 %s: truncated %d class dirs to %d images each "
+                "(packed-layout bound; full-scale runs need host streaming)",
+                split, truncated, per_dir,
+            )
+        return xs, ys
+
+    client_xs, client_ys = load_split("train", max_per_client)
+    if not client_xs:
+        return None
+    n_val_classes = max(len(class_dirs("val")), 1)
+    txs, tys = load_split("val", max(max_test // n_val_classes, 1))
+    test_x = np.concatenate(txs) if txs else client_xs[0][:0]
+    test_y = np.concatenate(tys) if tys else client_ys[0][:0]
+    logger.info("ILSVRC2012: %d class-clients, %d val images from %s",
+                len(client_xs), len(test_x), root)
+    return client_xs, client_ys, test_x, test_y
+
+
+def try_load_landmarks(cache_dir: str, name: str = "gld23k",
+                       image_hw: Tuple[int, int] = (224, 224),
+                       max_per_client: int = 256, max_test: int = 10_000):
+    """Google Landmarks federated split: ``data_user_dict/
+    <name>_user_dict_train.csv`` rows ``user_id,image_id,class`` + an image
+    dir; natural partition = one client per user_id (reference
+    ``get_mapping_per_user``). Decoding bounded like
+    :func:`try_load_imagenet` (logged, never silent)."""
+    mapping_dir = None
+    for sub in ("", "gld", "landmarks"):
+        p = os.path.join(cache_dir, sub, "data_user_dict")
+        if os.path.isdir(p):
+            mapping_dir = p
+            break
+    if mapping_dir is None:
+        return None
+    train_csv = os.path.join(mapping_dir, f"{name}_user_dict_train.csv")
+    test_csv = os.path.join(mapping_dir, f"{name}_user_dict_test.csv")
+    if not os.path.exists(train_csv):
+        return None
+    base = os.path.dirname(mapping_dir)
+    img_dir = None
+    for cand in ("images", "image", "."):
+        p = os.path.join(base, cand)
+        if os.path.isdir(p):
+            img_dir = p
+            break
+    if img_dir is None:
+        return None
+
+    def find_image(image_id: str) -> Optional[str]:
+        for ext in ("",) + IMG_EXTENSIONS:
+            p = os.path.join(img_dir, image_id + ext)
+            if os.path.isfile(p):
+                return p
+        return None
+
+    def read_rows(path):
+        with open(path, newline="") as f:
+            return list(csv.DictReader(f))
+
+    per_user: Dict[str, List[Tuple[str, int]]] = {}
+    for row in read_rows(train_csv):
+        p = find_image(row["image_id"])
+        if p is not None:
+            per_user.setdefault(row["user_id"], []).append(
+                (p, int(row["class"]))
+            )
+    if not per_user:
+        return None
+    client_xs, client_ys = [], []
+    truncated = 0
+    for uid in sorted(per_user):
+        pairs = per_user[uid]
+        if len(pairs) > max_per_client:
+            truncated += 1
+            pairs = pairs[:max_per_client]
+        client_xs.append(np.stack([_read_image(p, image_hw) for p, _ in pairs]))
+        client_ys.append(np.asarray([c for _, c in pairs], np.int32))
+    if truncated:
+        logger.warning(
+            "%s: truncated %d users to %d images each (packed-layout bound)",
+            name, truncated, max_per_client,
+        )
+
+    txs, tys = [], []
+    if os.path.exists(test_csv):
+        for row in read_rows(test_csv):
+            if len(txs) >= max_test:
+                logger.warning("%s: test set capped at %d images", name,
+                               max_test)
+                break
+            p = find_image(row["image_id"])
+            if p is not None:
+                txs.append(_read_image(p, image_hw))
+                tys.append(int(row["class"]))
+    test_x = np.stack(txs) if txs else client_xs[0][:0]
+    test_y = np.asarray(tys, np.int32) if tys else client_ys[0][:0]
+    logger.info("%s: %d user-clients, %d test images from %s",
+                name, len(client_xs), len(test_x), base)
+    return client_xs, client_ys, test_x, test_y

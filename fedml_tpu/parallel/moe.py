@@ -4,8 +4,10 @@ New-capability work (SURVEY.md §2.5 "Expert parallelism / MoE" — the
 reference has no MoE at all; the ``expert`` mesh axis existed here as a
 constant only). Switch-Transformer-style design, TPU-native:
 
-- router: one [D, E] matmul → top-1 expert per token (+ optional top-2),
-  with the Switch load-balancing auxiliary loss
+- router: one [D, E] matmul → top-1 (Switch) or top-2 (GShard/Mixtral,
+  ``cfg.moe_top_k=2``) experts per token, with the Switch load-balancing
+  auxiliary loss; top-2 gates renormalised over the chosen pair, second
+  choices fill whatever capacity first choices left
 - dense capacity-factor dispatch (GShard): tokens route into a
   [E, capacity, D] buffer via one einsum with a one-hot dispatch mask —
   static shapes, no ragged scatter, MXU end to end; over-capacity tokens
@@ -47,7 +49,13 @@ class MoEFeedForward(nn.Module):
         D, F = cfg.d_model, cfg.d_ff
         B, L, _ = x.shape
         T = B * L
-        capacity = max(int(cfg.moe_capacity_factor * T / E), 1)
+        top_k = int(getattr(cfg, "moe_top_k", 1))
+        if top_k not in (1, 2):
+            raise ValueError(f"moe_top_k must be 1 or 2, got {top_k}")
+        # capacity scales with k (GShard/Mixtral): top-2 makes 2T route
+        # assignments, so unscaled capacity would drop most second choices
+        # even under a perfectly balanced router
+        capacity = max(int(cfg.moe_capacity_factor * top_k * T / E), 1)
         init = nn.initializers.normal(0.02)
 
         w_router = self.param(
@@ -69,28 +77,52 @@ class MoEFeedForward(nn.Module):
         # routing in fp32 (tiny, numerically sensitive)
         logits = xt.astype(jnp.float32) @ w_router  # [T, E]
         probs = jax.nn.softmax(logits, axis=-1)
-        expert_idx = jnp.argmax(probs, axis=-1)  # [T] top-1 (Switch)
+        expert_idx = jnp.argmax(probs, axis=-1)  # [T] first choice
         expert_prob = jnp.take_along_axis(
             probs, expert_idx[:, None], axis=-1
         )[:, 0]
 
-        # Switch aux loss: E * Σ_e fraction_tokens_e * mean_prob_e
+        # Switch aux loss over FIRST choices: E * Σ_e frac_e * mean_prob_e
         one_hot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [T, E]
         frac = one_hot.mean(0)
         mean_prob = probs.mean(0)
         aux_loss = E * jnp.sum(frac * mean_prob)
 
-        # position of each token within its expert's capacity buffer
-        pos_in_expert = (jnp.cumsum(one_hot, axis=0) - 1.0) * one_hot  # [T, E]
-        pos = jnp.sum(pos_in_expert, axis=-1).astype(jnp.int32)  # [T]
-        keep = (pos < capacity).astype(jnp.float32)
+        def positions(oh, offset_per_expert):
+            """Per-token slot index within its expert's capacity buffer."""
+            pos_in = (jnp.cumsum(oh, axis=0) - 1.0) * oh  # [T, E]
+            off = jnp.sum(oh * offset_per_expert[None, :], axis=-1)
+            pos = (jnp.sum(pos_in, axis=-1) + off).astype(jnp.int32)
+            keep = (pos < capacity).astype(jnp.float32)
+            return (
+                oh[:, :, None]
+                * jax.nn.one_hot(pos, capacity, dtype=jnp.float32)[:, None, :]
+                * keep[:, None, None]
+            )  # [T, E, C]
 
-        # dispatch: [T, E, C] one-hot → expert inputs [E, C, D]
-        dispatch = (
-            one_hot[:, :, None]
-            * jax.nn.one_hot(pos, capacity, dtype=jnp.float32)[:, None, :]
-            * keep[:, None, None]
-        )
+        dispatch1 = positions(one_hot, jnp.zeros((E,), jnp.float32))
+        if top_k == 2:
+            # second choice: argmax with the first masked out; its slots start
+            # after ALL first-choice claims on that expert (GShard ordering:
+            # first choices never lose capacity to second choices)
+            probs2 = probs * (1.0 - one_hot)
+            idx2 = jnp.argmax(probs2, axis=-1)
+            prob2 = jnp.take_along_axis(probs2, idx2[:, None], axis=-1)[:, 0]
+            one_hot2 = jax.nn.one_hot(idx2, E, dtype=jnp.float32)
+            dispatch2 = positions(one_hot2, one_hot.sum(0))
+            # renormalised pair gates (Mixtral: softmax over the chosen two)
+            denom = jnp.maximum(expert_prob + prob2, 1e-9)
+            gate1 = expert_prob / denom
+            gate2 = prob2 / denom
+            dispatch = dispatch1 + dispatch2
+            combine = (
+                dispatch1 * gate1[:, None, None]
+                + dispatch2 * gate2[:, None, None]
+            )
+        else:
+            dispatch = dispatch1
+            combine = dispatch1 * expert_prob[:, None, None]
+
         expert_in = jnp.einsum(
             "tec,td->ecd", dispatch, xt.astype(jnp.float32)
         ).astype(cfg.dtype)
@@ -104,8 +136,8 @@ class MoEFeedForward(nn.Module):
 
         expert_out = jax.vmap(ffn)(w_gate_up, w_down, expert_in)  # [E, C, D]
 
-        # combine, scaled by the router prob (straight-through for dropped)
-        combine = dispatch * expert_prob[:, None, None]
+        # combine, scaled by the (re)normalised router gates; dropped tokens
+        # contribute nothing and pass through the residual unchanged
         y = jnp.einsum(
             "tec,ecd->td", combine, expert_out.astype(jnp.float32)
         ).astype(cfg.dtype)

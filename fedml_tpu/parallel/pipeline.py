@@ -71,9 +71,22 @@ PIPELINE = constants.MESH_AXIS_PIPELINE
 class PipelineCheetah:
     """Pipeline-parallel trainer for the Cheetah transformer.
 
-    ``mesh`` must carry a ``pipeline`` axis of size S >= 2 (a ``data`` axis
-    composes; tensor/sequence inside a stage are future work) and
+    ``mesh`` must carry a ``pipeline`` axis of size S >= 2 and
     ``cfg.n_layers`` must divide evenly into S stages.
+
+    Capabilities (explicit, so nobody infers more than is here):
+
+    - schedule: plain GPipe — M microbatches through S stages over
+      ``M + S - 1`` ticks; bubble fraction = (S-1)/(M+S-1) (measured by
+      ``tests/test_pipeline.py::test_bubble_fraction_measured``); no 1F1B,
+      no interleaved stages
+    - backward: ``jax.grad`` through the scan (ppermute's transpose is the
+      reverse rotation) — exact, rematerialised per stage
+    - composes with a ``data`` mesh axis (pp x dp); tensor/sequence axes
+      INSIDE a stage are not supported — use ``CheetahTrainer`` for tp/sp
+    - embedding/norm/head replicated across stages; every stage computes the
+      stage-0 embedding gather each tick (SPMD-uniform program; the waste is
+      one [mb, L, D] gather per tick per stage, accepted for uniformity)
     """
 
     def __init__(
@@ -100,6 +113,11 @@ class PipelineCheetah:
         self._step = None
         self._loss_jit = None
         self._blocks_struct = None  # computed once, reused everywhere
+
+    def bubble_fraction(self) -> float:
+        """GPipe idle fraction: (S-1)/(M+S-1) of each device's schedule."""
+        S, M = self.n_stages, self.microbatches
+        return (S - 1) / (M + S - 1)
 
     # -- params -------------------------------------------------------------
     def init_params(self, rng: jax.Array) -> PyTree:

@@ -54,9 +54,11 @@ class TransformerConfig:
     # far cheaper backward for a modest activation-memory increase
     remat_policy: str = "full"
     # Mixture-of-Experts FFN (parallel/moe.py): 0/1 = dense; >1 = that many
-    # experts, top-1 switch routing, stacked expert weights shardable over
-    # the `expert` mesh axis
+    # experts, stacked expert weights shardable over the `expert` mesh axis
     moe_experts: int = 0
+    # 1 = Switch top-1 routing; 2 = GShard/Mixtral top-2 (renormalised gates,
+    # second choice fills capacity left by first choices)
+    moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
     # "auto": Pallas splash attention on TPU (falls back to flash, then XLA),

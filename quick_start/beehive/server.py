@@ -13,6 +13,10 @@ that poll the published global, take a simulated local step, and upload.
 Run: ``python server.py``.
 """
 
+# run-from-checkout shim: make the repo importable without `pip install -e .`
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
 import os
 import shutil
 import sys

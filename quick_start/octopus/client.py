@@ -8,6 +8,10 @@ A silo with several local chips adds intra-silo data parallelism with
 gradient psum — the torch-DDP analog on ICI).
 """
 
+# run-from-checkout shim: make the repo importable without `pip install -e .`
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
 import fedml_tpu as fedml
 
 if __name__ == "__main__":

@@ -3,6 +3,10 @@
     python server.py --cf fedml_config.yaml --rank 0 --role server
 """
 
+# run-from-checkout shim: make the repo importable without `pip install -e .`
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
 import fedml_tpu as fedml
 
 if __name__ == "__main__":

@@ -3,6 +3,10 @@ quick_start/parrot/torch_fedavg_mnist_lr_custum_data_and_model_example.py):
 bring your own flax module; everything else is unchanged.
 """
 
+# run-from-checkout shim: make the repo importable without `pip install -e .`
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
 import fedml_tpu as fedml
 import jax.numpy as jnp
 from fedml_tpu import data as fedml_data

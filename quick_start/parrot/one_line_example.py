@@ -4,6 +4,10 @@ quick_start/parrot/torch_fedavg_mnist_lr_one_line_example.py).
     python one_line_example.py --cf fedml_config.yaml
 """
 
+# run-from-checkout shim: make the repo importable without `pip install -e .`
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
 import fedml_tpu as fedml
 
 if __name__ == "__main__":

@@ -1,0 +1,169 @@
+"""Every example and quick_start script must run from a fresh checkout.
+
+VERDICT r2 weak #1: the example surface rotted silently because nothing
+executed it — ``python examples/<any>.py`` failed with ModuleNotFoundError.
+These tests run each script exactly the way the README tells a user to
+(``python <script>.py`` from the repo, NO install, NO PYTHONPATH help), so a
+broken run-from-checkout path or a rotted example fails CI.
+
+The whole module is in the ``examples`` tier (each case pays a fresh
+interpreter + jax import); the smoke tier runs one representative script.
+"""
+
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+# decentralized_dsgd is covered by the smoke-tier canary below — don't pay
+# the same subprocess twice in the full gate
+EXAMPLES = sorted(
+    p for p in (REPO / "examples").glob("*.py")
+    if p.stem != "decentralized_dsgd"
+)
+# cold-cache XLA:CPU compiles dominate some scripts; give the known-heavy
+# ones headroom (long_context's header documents ~10 min cold)
+TIMEOUTS = {"long_context_ring_attention": 1500, "fedseg_miou": 900,
+            "app_tasks": 900}
+PARROT = REPO / "quick_start" / "parrot"
+OCTOPUS = REPO / "quick_start" / "octopus"
+BEEHIVE = REPO / "quick_start" / "beehive"
+
+SMOKE_YAML = """\
+common_args:
+  training_type: "simulation"
+  random_seed: 0
+data_args:
+  dataset: "synthetic"
+model_args:
+  model: "lr"
+train_args:
+  federated_optimizer: "FedAvg"
+  client_num_in_total: 8
+  client_num_per_round: 4
+  comm_round: 3
+  epochs: 1
+  batch_size: 16
+  learning_rate: 0.1
+validation_args:
+  frequency_of_the_test: 1
+"""
+
+
+def _env():
+    """The subprocess environment a user would have — crucially, the repo is
+    NOT on PYTHONPATH (the in-file shim must do that) — on the virtual CPU
+    mesh with the shared compile cache."""
+    env = dict(os.environ)
+    # the axon sitecustomize registers the TPU plugin (and overrides
+    # jax_platforms) whenever PALLAS_AXON_POOL_IPS is set — drop it so the
+    # subprocess really runs on the virtual CPU mesh
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    env["XLA_FLAGS"] = flags
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/fedml_tpu_jax_cache")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and Path(p).resolve() != REPO
+    )
+    return env
+
+
+def run_script(path: Path, args=(), timeout=None, cwd=None):
+    timeout = timeout or TIMEOUTS.get(path.stem, 600)
+    proc = subprocess.run(
+        [sys.executable, str(path), *args],
+        env=_env(), cwd=str(cwd or path.parent),
+        capture_output=True, text=True, timeout=timeout,
+    )
+    assert proc.returncode == 0, (
+        f"{path.name} exited {proc.returncode}\n"
+        f"--- stdout tail ---\n{proc.stdout[-3000:]}\n"
+        f"--- stderr tail ---\n{proc.stderr[-3000:]}"
+    )
+    return proc
+
+
+@pytest.mark.examples
+@pytest.mark.parametrize("script", EXAMPLES, ids=lambda p: p.stem)
+def test_example_runs(script, tmp_path):
+    run_script(script, cwd=tmp_path)
+
+
+@pytest.mark.examples
+@pytest.mark.parametrize(
+    "script",
+    sorted(PARROT.glob("*.py")),
+    ids=lambda p: f"parrot-{p.stem}",
+)
+def test_quick_start_parrot(script, tmp_path):
+    """Parrot quick starts with a tiny --cf override (the shipped YAML is the
+    full 1000-client benchmark config)."""
+    cf = tmp_path / "smoke.yaml"
+    cf.write_text(SMOKE_YAML)
+    run_script(script, args=("--cf", str(cf)), cwd=tmp_path)
+
+
+@pytest.mark.examples
+def test_quick_start_octopus(tmp_path):
+    """Server + 2 clients as 3 local processes over gRPC loopback — the
+    reference's cross-silo smoke shape (tests/smoke_test/cross_silo/)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    cf = tmp_path / "octopus.yaml"
+    cf.write_text(SMOKE_YAML.replace(
+        'training_type: "simulation"', 'training_type: "cross_silo"'
+    ).replace("client_num_in_total: 8", "client_num_in_total: 2")
+     .replace("client_num_per_round: 4", "client_num_per_round: 2")
+     + f'comm_args:\n  backend: "GRPC"\n  comm_host: "127.0.0.1"\n'
+       f"  comm_port: {port}\n")
+    env = _env()
+    server = subprocess.Popen(
+        [sys.executable, str(OCTOPUS / "server.py"),
+         "--cf", str(cf), "--rank", "0", "--role", "server"],
+        env=env, cwd=str(tmp_path),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    time.sleep(2.0)
+    clients = [
+        subprocess.Popen(
+            [sys.executable, str(OCTOPUS / "client.py"),
+             "--cf", str(cf), "--rank", str(rank), "--role", "client"],
+            env=env, cwd=str(tmp_path),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for rank in (1, 2)
+    ]
+    procs = [server, *clients]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"rc={p.returncode}\n{out[-3000:]}"
+
+
+@pytest.mark.examples
+def test_quick_start_beehive(tmp_path):
+    run_script(BEEHIVE / "server.py", cwd=tmp_path, timeout=420)
+
+
+def test_one_example_runs_in_smoke_tier(tmp_path):
+    """The smoke tier keeps one end-to-end run-from-checkout canary."""
+    run_script(REPO / "examples" / "decentralized_dsgd.py", cwd=tmp_path)

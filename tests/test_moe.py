@@ -49,6 +49,83 @@ class TestMoELayer:
         assert p["w_down"].shape == (4, 128, 64)
 
 
+class TestTop2Routing:
+    def test_top2_matches_dense_oracle_with_ample_capacity(self):
+        """With capacity >= T every token reaches both chosen experts, so the
+        layer must equal g1*FFN_e1(x) + g2*FFN_e2(x) computed densely."""
+        from fedml_tpu.parallel.moe import MoEFeedForward
+
+        cfg = moe_cfg(moe_top_k=2, moe_capacity_factor=float(4))  # C = 2T
+        layer = MoEFeedForward(cfg)
+        x = jax.random.normal(jax.random.PRNGKey(5), (1, 8, 64), jnp.float32)
+        variables = layer.init(jax.random.PRNGKey(6), x)
+        (y, _aux), _ = layer.apply(variables, x, mutable=["intermediates"])
+
+        p = jax.tree.map(
+            lambda t: t.value if hasattr(t, "value") else t,
+            variables["params"], is_leaf=lambda t: hasattr(t, "value"),
+        )
+        xt = np.asarray(x.reshape(8, 64), np.float32)
+        probs = np.asarray(
+            jax.nn.softmax(jnp.asarray(xt) @ p["w_router"], axis=-1)
+        )
+        want = np.zeros_like(xt)
+        for t in range(8):
+            order = np.argsort(-probs[t])
+            e1, e2 = int(order[0]), int(order[1])
+            g = probs[t, [e1, e2]] / probs[t, [e1, e2]].sum()
+            for gate, e in zip(g, (e1, e2)):
+                gu = xt[t] @ np.asarray(p["w_gate_up"][e], np.float32)
+                gate_h, up = np.split(gu, 2)
+                h = (gate_h / (1 + np.exp(-gate_h))) * up  # silu(gate)*up
+                want[t] += gate * (h @ np.asarray(p["w_down"][e], np.float32))
+        np.testing.assert_allclose(
+            np.asarray(y.reshape(8, 64), np.float32), want,
+            rtol=2e-2, atol=2e-3,
+        )
+
+    def test_top2_second_choice_respects_leftover_capacity(self):
+        """Dropped second choices pass through silently: with tight capacity
+        (C = 2 slots/expert for 8 tokens x 2 routes), overflow must not
+        corrupt the output."""
+        from fedml_tpu.parallel.moe import MoEFeedForward
+
+        cfg = moe_cfg(moe_top_k=2, moe_capacity_factor=float(0.5))  # C=2
+        layer = MoEFeedForward(cfg)
+        x = jax.random.normal(jax.random.PRNGKey(7), (1, 8, 64), jnp.float32)
+        variables = layer.init(jax.random.PRNGKey(8), x)
+        (y, aux), _ = layer.apply(variables, x, mutable=["intermediates"])
+        assert y.shape == x.shape and np.isfinite(float(aux))
+        assert np.isfinite(np.asarray(y, np.float32)).all()
+
+    def test_top2_trains(self):
+        cfg = moe_cfg(moe_top_k=2)
+        mesh = make_mesh({"fsdp": 1}, devices=jax.devices()[:1])
+        tr = CheetahTrainer(cfg, mesh, optimizer=make_optimizer(
+            3e-3, warmup_steps=2, total_steps=50))
+        state = tr.init_state(jax.random.PRNGKey(3))
+        rng = np.random.RandomState(3)
+        tok = jnp.asarray(rng.randint(0, 128, (4, 64)).astype(np.int32))
+        m = jnp.ones((4, 64), jnp.int32)
+        first = None
+        for _ in range(15):
+            state, metrics = tr.train_step(state, tok, m)
+            if first is None:
+                first = float(metrics["loss"])
+        assert float(metrics["loss"]) < first - 0.5
+
+    def test_top2_expert_parallel_mesh(self):
+        cfg = moe_cfg(moe_top_k=2)
+        mesh = make_mesh({"data": 2, "expert": 2, "fsdp": 2})
+        tr = CheetahTrainer(cfg, mesh, optimizer=make_optimizer(1e-3))
+        state = tr.init_state(jax.random.PRNGKey(4))
+        rng = np.random.RandomState(4)
+        tok = jnp.asarray(rng.randint(0, 128, (4, 64)).astype(np.int32))
+        m = jnp.ones((4, 64), jnp.int32)
+        state, metrics = tr.train_step(state, tok, m)
+        assert np.isfinite(float(metrics["loss"]))
+
+
 class TestMoETraining:
     def test_moe_transformer_trains_single_device(self):
         cfg = moe_cfg()

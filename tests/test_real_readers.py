@@ -1,0 +1,122 @@
+"""Real-format readers (VERDICT r2 next #5): stackoverflow lr/nwp, ImageNet
+folders, Landmarks csv — parsed from tiny checked-in fixtures that mirror the
+reference's on-disk layouts (``data/stackoverflow_nwp/``, ``data/ImageNet/
+datasets.py``, ``data/Landmarks/data_loader.py``)."""
+
+import os
+import shutil
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from fedml_tpu import data as data_mod
+
+# the readers themselves degrade to synthetic without these; the fixture
+# tests need them (declared in pyproject's [readers]/[test] extras)
+h5py = pytest.importorskip("h5py")
+PIL = pytest.importorskip("PIL")
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _args(dataset, cache_dir, **kw):
+    base = dict(dataset=dataset, data_cache_dir=cache_dir,
+                client_num_in_total=0, batch_size=4, random_seed=0,
+                partition_method="hetero", partition_alpha=0.5)
+    base.update(kw)
+    return SimpleNamespace(**base)
+
+
+@pytest.fixture
+def staged(tmp_path):
+    """Copy fixtures into a data_cache_dir the way a user would stage files."""
+    def stage(sub):
+        src = os.path.join(FIXTURES, sub)
+        dst = tmp_path / "cache"
+        shutil.copytree(src, dst, dirs_exist_ok=True)
+        return str(dst)
+
+    return stage
+
+
+def test_stackoverflow_nwp_reader(staged):
+    cache = staged("stackoverflow")
+    ds, class_num = data_mod.load(_args("stackoverflow_nwp", cache))
+    # 3 h5 clients, natural partition
+    assert ds.client_num == 3
+    assert ds.task == "nwp"
+    # seq_len windows: x is the row shifted against y
+    assert ds.train_x.shape[-1] == 20 and ds.train_y.shape[-1] == 20
+    # vocab fixture has 12 words: pad=0, words 1..12, bos=13, eos=14, oov=15
+    x0 = ds.train_x[0][: ds.train_counts[0]]
+    assert x0[:, 0].max() == 13 and x0[:, 0].min() == 13  # every row starts bos
+    # "how to fix the error" → ids for how,to,fix,the,error all in 1..12
+    row = x0[0]
+    assert set(row[1:6].tolist()) <= set(range(1, 13))
+    # y is x shifted: y[t] == next token
+    y0 = ds.train_y[0][: ds.train_counts[0]]
+    np.testing.assert_array_equal(x0[0][1:], y0[0][:-1])
+    # the unknown word in user_b's sentence maps to the oov bucket (15)
+    ub = 1 if ds.train_counts[1] else None
+    assert ub is not None
+    assert (ds.train_x[1][: ds.train_counts[1]] == 15).any()
+    # test split comes from the test h5
+    assert ds.test_x.shape[0] == 2
+
+
+def test_stackoverflow_lr_reader(staged):
+    cache = staged("stackoverflow")
+    ds, class_num = data_mod.load(_args("stackoverflow_lr", cache))
+    assert ds.client_num == 3 and ds.task == "tagpred"
+    V = ds.train_x.shape[-1]  # fixture vocab: 12 words
+    assert V == 12
+    # "print the list": 3 known words → BoW sums to 1 (all tokens known)
+    c0 = ds.train_x[0][: ds.train_counts[0]]
+    sums = c0.sum(-1)
+    assert np.isclose(sums[1], 1.0)  # print/the/list all in vocab
+    # user_b's "the code zzzunknown data": 3/4 known → mass 0.75
+    c1 = ds.train_x[1][: ds.train_counts[1]]
+    assert np.isclose(c1[0].sum(), 0.75)
+    # tags: fixture has 4 tags; "python|list" → two-hot
+    t0 = ds.train_y[0][: ds.train_counts[0]]
+    assert t0.shape[-1] == 4 and t0[0].sum() == 2.0
+    # unknown tag ("mystery") dropped
+    t1 = ds.train_y[1][: ds.train_counts[1]]
+    assert t1[0].sum() == 1.0
+
+
+def test_imagenet_folder_reader(staged):
+    cache = staged("imagenet")
+    ds, class_num = data_mod.load(_args("ILSVRC2012", cache))
+    # natural partition: one client per class dir
+    assert ds.client_num == 2
+    assert class_num == 1000  # registry class space
+    assert tuple(ds.train_x.shape[2:]) == (224, 224, 3)
+    assert int(ds.train_counts.sum()) == 6  # 2 classes x 3 train images
+    # labels: client i holds only class i
+    for ci in range(2):
+        y = ds.train_y[ci][: ds.train_counts[ci]]
+        assert (y == ci).all()
+    assert ds.test_x.shape[0] == 4  # 2 classes x 2 val images
+    assert 0.0 <= float(ds.train_x.max()) <= 1.0
+
+
+def test_landmarks_reader(staged):
+    cache = staged("gld")
+    ds, class_num = data_mod.load(_args("gld23k", cache))
+    # natural partition: one client per user_id (u1: 2 imgs, u2: 3)
+    assert ds.client_num == 2
+    assert sorted(ds.train_counts.tolist()) == [2, 3]
+    assert tuple(ds.train_x.shape[2:]) == (224, 224, 3)
+    u2 = ds.train_y[1][: ds.train_counts[1]]
+    assert sorted(u2.tolist()) == [0, 1, 2]
+    assert ds.test_x.shape[0] == 2
+
+
+def test_unstaged_falls_back_to_synthetic(tmp_path):
+    """No files staged → every key still loads (synthetic fallback)."""
+    for name in ("stackoverflow_nwp", "stackoverflow_lr", "gld23k"):
+        ds, _ = data_mod.load(_args(name, str(tmp_path / "empty"),
+                                    client_num_in_total=4))
+        assert ds.client_num == 4 and ds.train_data_num > 0

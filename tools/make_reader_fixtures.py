@@ -1,0 +1,108 @@
+"""Generate the tiny checked-in fixtures for tests/test_real_readers.py.
+
+Each fixture is a minimal but format-faithful instance of the real on-disk
+layout the reference consumes (stackoverflow TFF h5 + vocab files, ImageNet
+ImageFolder, Landmarks csv+images). Deterministic; a few KB total. Re-run
+after changing the formats:  python tools/make_reader_fixtures.py
+"""
+
+import json
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIX = os.path.join(HERE, "..", "tests", "fixtures")
+
+
+def make_stackoverflow():
+    import h5py
+
+    d = os.path.join(FIX, "stackoverflow")
+    os.makedirs(d, exist_ok=True)
+    # vocab: 12 frequent words with fake counts
+    words = ["the", "code", "python", "error", "how", "to", "fix", "list",
+             "file", "data", "print", "loop"]
+    with open(os.path.join(d, "stackoverflow.word_count"), "w") as f:
+        for i, w in enumerate(words):
+            f.write(f"{w} {1000 - i}\n")
+    with open(os.path.join(d, "stackoverflow.tag_count"), "w") as f:
+        json.dump({"python": 500, "list": 300, "file": 200, "loop": 100}, f)
+
+    clients = {
+        "user_a": {
+            "tokens": [b"how to fix the error", b"print the list"],
+            "tags": [b"python|list", b"python"],
+        },
+        "user_b": {
+            "tokens": [b"the code zzzunknown data"],
+            "tags": [b"file|mystery"],
+        },
+        "user_c": {
+            "tokens": [b"loop the loop", b"data file error", b"to print"],
+            "tags": [b"loop", b"file", b"python|loop"],
+        },
+    }
+    test_clients = {
+        "user_t": {
+            "tokens": [b"fix the code", b"the data loop"],
+            "tags": [b"python", b"loop"],
+        },
+    }
+    for fname, cc in (("stackoverflow_train.h5", clients),
+                      ("stackoverflow_test.h5", test_clients)):
+        with h5py.File(os.path.join(d, fname), "w") as h5:
+            for cid, g in cc.items():
+                grp = h5.create_group(f"examples/{cid}")
+                grp.create_dataset("tokens", data=g["tokens"])
+                grp.create_dataset("tags", data=g["tags"])
+
+
+def _write_img(path, seed, size=(8, 8)):
+    from PIL import Image
+
+    rng = np.random.RandomState(seed)
+    arr = rng.randint(0, 255, size + (3,), dtype=np.uint8)
+    Image.fromarray(arr).save(path)
+
+
+def make_imagenet():
+    root = os.path.join(FIX, "imagenet", "ILSVRC2012")
+    for split, n in (("train", 3), ("val", 2)):
+        for ci, cls in enumerate(("n01440764", "n01443537")):
+            d = os.path.join(root, split, cls)
+            os.makedirs(d, exist_ok=True)
+            for i in range(n):
+                _write_img(os.path.join(d, f"img_{i}.png"),
+                           seed=hash((split, ci, i)) % 2**31)
+
+
+def make_landmarks():
+    root = os.path.join(FIX, "gld")
+    os.makedirs(os.path.join(root, "data_user_dict"), exist_ok=True)
+    os.makedirs(os.path.join(root, "images"), exist_ok=True)
+    rows_train = [
+        ("u1", "img001", 0), ("u1", "img002", 1),
+        ("u2", "img003", 1), ("u2", "img004", 2), ("u2", "img005", 0),
+    ]
+    rows_test = [("u1", "img101", 0), ("u2", "img102", 2)]
+    for fname, rows in (("gld23k_user_dict_train.csv", rows_train),
+                        ("gld23k_user_dict_test.csv", rows_test)):
+        with open(os.path.join(root, "data_user_dict", fname), "w") as f:
+            f.write("user_id,image_id,class\n")
+            for u, im, c in rows:
+                f.write(f"{u},{im},{c}\n")
+    for _, im, _ in rows_train + rows_test:
+        _write_img(os.path.join(root, "images", im + ".jpg"),
+                   seed=hash(im) % 2**31)
+
+
+if __name__ == "__main__":
+    make_stackoverflow()
+    make_imagenet()
+    make_landmarks()
+    total = sum(
+        os.path.getsize(os.path.join(r, f))
+        for r, _, fs in os.walk(FIX) for f in fs
+    )
+    print(f"fixtures written to {os.path.normpath(FIX)} ({total} bytes)")

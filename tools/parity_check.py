@@ -1,0 +1,693 @@
+"""Head-to-head convergence parity: fedml_tpu vs the reference stack.
+
+VERDICT r2 next #1 — "perf is measured, learning outcomes are not". This tool
+feeds IDENTICAL synthetic data, partition, per-round cohorts (both stacks
+seed client sampling with the round index — ``fedavg_api.py:125-133`` and
+``sp_api.py._client_sampling``), learning rate, epochs, and initial weights
+into both stacks and compares the resulting global-model trajectories.
+
+Three parity grades, strongest applicable used per experiment:
+
+1. **Exact trajectory parity vs the reference** (MNIST-shape LR, FedAvg and
+   FedProx@mu=0): full-batch local steps make batch order irrelevant, so the
+   two stacks compute the same math and the per-round global parameter
+   vectors must agree to float32 accumulation error (rel L2 < 1e-3).
+   The reference's own ``FedAvgAPI`` runs in-process (torch CPU), exactly as
+   ``tools/measure_ref_baseline.py`` drives it. NOTE: as shipped, the
+   reference's sp loop is NOT textbook FedAvg — ``get_model_params()``
+   (``ml/trainer/my_model_trainer_classification.py:10``) returns live
+   tensor references and ``load_state_dict`` writes through them, so each
+   client's "copy of w_global" is really the previous client's trained
+   weights (sequential chain). The head-to-head therefore runs twice: once
+   against the reference with that one getter wrapped to snapshot (textbook
+   semantics restored → exact parity required), and once proving the
+   as-shipped behavior equals a sequential-chain oracle (so the deviation
+   is characterised, not hand-waved).
+2. **Exact trajectory parity vs an independent numpy oracle** (FedProx mu>0,
+   SCAFFOLD): the reference CANNOT be the oracle here — its FedProx
+   (``simulation/mpi/fedprox/``) contains NO proximal term (grep ``mu`` —
+   it is FedAvg with renamed classes), and it has no SCAFFOLD at all. The
+   oracle is a from-scratch numpy implementation of the published update
+   rules (FedProx: Li et al. 2020 eq. 2; SCAFFOLD: Karimireddy et al. 2020,
+   option II), written against the papers, not against fedml_tpu's code.
+3. **Curve parity** (CIFAR-shape ResNet-56 FedAvg): architectures
+   intentionally differ (reference: BatchNorm torch; ours: GroupNorm NHWC —
+   a documented TPU re-design), so parameter-level equality is impossible;
+   instead both stacks train on the identical federation and must converge
+   to the same regime (final accuracy within a stated band).
+
+Model note: the reference's shipped LR (``model/linear/lr.py``) applies a
+*sigmoid before CrossEntropyLoss* — an idiosyncrasy, not FedAvg semantics.
+Both stacks here use the standard linear-logits + CE model (the reference's
+``FedAvgAPI`` accepts any ``torch.nn.Module``), so the parity statement is
+about the FL algorithm math, not that quirk.
+
+Usage:
+    python tools/parity_check.py [--rounds 20] [--out PARITY.json]   # LR legs (CPU)
+    python tools/parity_check.py --resnet-only                       # curve leg (TPU)
+
+Writes PARITY.json (the second invocation merges) and prints one JSON line
+per experiment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import sys
+import time
+import types
+from unittest import mock
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF = "/root/reference/python"
+sys.path.insert(0, REPO)
+
+# ---------------------------------------------------------------------------
+# shared federation: deterministic synthetic data both stacks consume
+# ---------------------------------------------------------------------------
+
+
+def make_federation(seed=0, n_clients=20, per_client=32, n_test=512,
+                    shape=(28, 28, 1), n_classes=10, lowfreq=False):
+    """Class-conditional Gaussians in the given image shape; per-client
+    shards ARE the partition (generated per client, fixed seed).
+
+    ``lowfreq``: class means are coarse 4x4 patterns upsampled to the image
+    size instead of iid per-pixel noise — iid-pixel signal is invisible to a
+    conv net with global average pooling (the pool averages it to ~0), so
+    the ResNet curve leg needs spatially-coherent class structure."""
+    rng = np.random.RandomState(seed)
+    dim = int(np.prod(shape))
+    if lowfreq and len(shape) == 3:
+        h, w, c = shape
+        coarse = rng.randn(n_classes, 4, 4, c).astype(np.float32)
+        up = coarse.repeat(h // 4, axis=1).repeat(w // 4, axis=2)
+        means = up.reshape(n_classes, dim) * 0.7
+    else:
+        means = rng.randn(n_classes, dim).astype(np.float32) * 0.7
+
+    def draw(n, r):
+        y = r.randint(0, n_classes, size=n)
+        x = means[y] + r.randn(n, dim).astype(np.float32)
+        return x.reshape((n,) + shape).astype(np.float32), y.astype(np.int32)
+
+    xs, ys = [], []
+    for c in range(n_clients):
+        x, y = draw(per_client, np.random.RandomState(seed * 1000 + c + 1))
+        xs.append(x)
+        ys.append(y)
+    test_x, test_y = draw(n_test, np.random.RandomState(seed * 1000 + 999))
+    return (np.stack(xs), np.stack(ys),
+            np.full((n_clients,), per_client, np.int32), test_x, test_y)
+
+
+def sample_cohort(round_idx, n_total, per_round):
+    """The sampling rule BOTH stacks implement (reference fedavg_api.py:131)."""
+    if n_total == per_round:
+        return np.arange(n_total)
+    rs = np.random.RandomState(round_idx)
+    return rs.choice(n_total, per_round, replace=False)
+
+
+def np_eval(W, b, test_x, test_y):
+    """Shared numpy evaluator: CE loss + accuracy of (W [D,C], b [C])."""
+    x = test_x.reshape(test_x.shape[0], -1)
+    logits = x @ W + b
+    logits = logits - logits.max(1, keepdims=True)
+    logp = logits - np.log(np.exp(logits).sum(1, keepdims=True))
+    loss = float(-logp[np.arange(len(test_y)), test_y].mean())
+    acc = float((logits.argmax(1) == test_y).mean())
+    return loss, acc
+
+
+# ---------------------------------------------------------------------------
+# stack A: fedml_tpu (CPU platform for float comparability with torch CPU)
+# ---------------------------------------------------------------------------
+
+
+def run_ours_lr(fed, rounds, lr, epochs, per_round, optimizer="FedAvg",
+                mu=0.0, init=None):
+    """Drive the real sp engine; return [rounds, D*C + C] param trajectory."""
+    import jax
+
+    import fedml_tpu as fedml
+    from fedml_tpu import models as model_mod
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.data.fed_dataset import FedDataset
+    from fedml_tpu.simulation.sp_api import FedAvgAPI
+
+    train_x, train_y, counts, test_x, test_y = fed
+    overrides = dict(
+        dataset="mnist", model="lr",
+        client_num_in_total=int(train_x.shape[0]),
+        client_num_per_round=per_round, comm_round=rounds,
+        epochs=epochs, batch_size=int(train_x.shape[1]),  # full-batch steps
+        learning_rate=lr, client_optimizer="sgd",
+        federated_optimizer=optimizer,
+    )
+    if optimizer == "FedProx":
+        # always explicit: the Arguments schema defaults fedprox_mu to 0.1
+        overrides["fedprox_mu"] = mu
+    args = fedml.init(Arguments(overrides=overrides), should_init_logs=False)
+    ds = FedDataset(train_x, train_y, counts, test_x, test_y, class_num=10)
+    bundle = model_mod.create(args, 10)
+    api = FedAvgAPI(args, fedml.get_device(args), ds, bundle)
+    if init is not None:
+        W0, b0 = init
+        api.global_params = _set_lr_params(api.global_params, W0, b0)
+
+    traj = []
+    for r in range(rounds):
+        api._train_round(r)
+        W, b = _get_lr_params(api.global_params)
+        traj.append(np.concatenate([W.ravel(), b.ravel()]))
+    return np.stack(traj)
+
+
+def _lr_leaf_paths(params):
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    kernel = [(p, v) for p, v in flat if v.ndim == 2]
+    bias = [(p, v) for p, v in flat if v.ndim == 1]
+    assert len(kernel) == 1 and len(bias) == 1, "not an LR param tree"
+    return kernel[0][0], bias[0][0]
+
+
+def _get_lr_params(params):
+    import jax
+
+    kpath, bpath = _lr_leaf_paths(params)
+    flat = dict(jax.tree_util.tree_flatten_with_path(params)[0])
+    return np.asarray(flat[kpath], np.float32), np.asarray(flat[bpath], np.float32)
+
+
+def _set_lr_params(params, W, b):
+    import jax
+
+    kpath, bpath = _lr_leaf_paths(params)
+
+    def setter(path, leaf):
+        if path == kpath:
+            return np.asarray(W, np.float32)
+        if path == bpath:
+            return np.asarray(b, np.float32)
+        return leaf
+
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map_with_path(
+        lambda p, x: jnp.asarray(setter(p, x)), params
+    )
+
+
+# ---------------------------------------------------------------------------
+# stack B: the reference (torch CPU), driven exactly like measure_ref_baseline
+# ---------------------------------------------------------------------------
+
+
+def _import_with_stubs(name, max_stubs=60):
+    stubbed = []
+    for _ in range(max_stubs):
+        try:
+            return __import__(name, fromlist=["_"]), stubbed
+        except ModuleNotFoundError as e:
+            missing = e.name
+            if missing is None or missing in sys.modules:
+                raise
+            stub = mock.MagicMock(name=f"stub:{missing}")
+            stub.__spec__ = types.SimpleNamespace(name=missing)
+            stub.__path__ = []
+            sys.modules[missing] = stub
+            stubbed.append(missing)
+    raise RuntimeError(f"too many stubs: {stubbed}")
+
+
+def _ref_setup():
+    if REF not in sys.path:
+        sys.path.insert(0, REF)
+    import logging
+
+    logging.disable(logging.INFO)
+    _import_with_stubs("fedml")
+
+
+def _torch_linear_init(seed, in_dim=784, out_dim=10):
+    """torch's default Linear init under a fixed seed — the shared W0, b0."""
+    import torch
+
+    torch.manual_seed(seed)
+    lin = torch.nn.Linear(in_dim, out_dim)
+    return (lin.weight.detach().numpy().T.copy(),  # ours stores [in, out]
+            lin.bias.detach().numpy().copy())
+
+
+def run_reference_lr(fed, rounds, lr, epochs, per_round, init, model=None,
+                     fix_aliasing=False):
+    """The reference's own FedAvgAPI on the shared federation; returns the
+    per-round [D*C + C] trajectory (torch Linear stores weight [out, in]).
+
+    ``fix_aliasing``: the reference's sp loop has a state-aliasing defect —
+    ``w_global = self.model_trainer.get_model_params()`` (fedavg_api.py:67)
+    returns LIVE references into the shared trainer's model, and
+    ``set_model_params``'s ``load_state_dict`` writes THROUGH those
+    references, so ``copy.deepcopy(w_global)`` for client k actually copies
+    client k-1's trained weights: as shipped, "FedAvg" is sequential chained
+    local training with a mean over the chain's snapshots (verified: a
+    sequential-chain oracle matches it to 1e-7, the textbook oracle differs
+    by ~0.25 rel L2). With ``fix_aliasing=True`` the getter is wrapped to
+    snapshot, which restores textbook FedAvg without touching anything else.
+    """
+    _ref_setup()
+    import torch
+    from fedml.simulation.sp.fedavg.fedavg_api import FedAvgAPI
+
+    train_x, train_y, counts, test_x, test_y = fed
+    n_clients, per_client = train_x.shape[0], train_x.shape[1]
+
+    def loader(x, y):
+        return torch.utils.data.DataLoader(
+            torch.utils.data.TensorDataset(
+                torch.from_numpy(x.reshape(len(x), -1)),
+                torch.from_numpy(y.astype(np.int64)),
+            ),
+            batch_size=per_client, shuffle=False,
+        )
+
+    train_local = {i: loader(train_x[i], train_y[i]) for i in range(n_clients)}
+    test_local = {i: loader(test_x[:8], test_y[:8]) for i in range(n_clients)}
+    train_num = {i: int(counts[i]) for i in range(n_clients)}
+    dataset = [
+        int(counts.sum()), len(test_x), None, None,
+        train_num, train_local, test_local, 10,
+    ]
+    ref_args = argparse.Namespace(
+        dataset="parity", model="lr", client_num_in_total=n_clients,
+        client_num_per_round=per_round, comm_round=rounds, epochs=epochs,
+        batch_size=per_client, learning_rate=lr, client_optimizer="sgd",
+        weight_decay=0.0, frequency_of_the_test=1, enable_wandb=False,
+    )
+
+    if model is None:
+        class LinearLogits(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.linear = torch.nn.Linear(784, 10)
+
+            def forward(self, x):
+                return self.linear(x)
+
+        model = LinearLogits()
+        W0, b0 = init
+        with torch.no_grad():
+            model.linear.weight.copy_(torch.from_numpy(W0.T))
+            model.linear.bias.copy_(torch.from_numpy(b0))
+
+    api = FedAvgAPI(ref_args, torch.device("cpu"), dataset, model)
+    if fix_aliasing:
+        orig_get = api.model_trainer.get_model_params
+        api.model_trainer.get_model_params = lambda: copy.deepcopy(orig_get())
+    traj = []
+
+    def record(round_idx):
+        sd = api.model_trainer.get_model_params()
+        W = sd["linear.weight"].numpy().T
+        b = sd["linear.bias"].numpy()
+        traj.append(np.concatenate([W.ravel(), b.ravel()]))
+
+    api._local_test_on_all_clients = record  # capture w_global each round
+    api.train()
+    return np.stack(traj[:rounds])
+
+
+# ---------------------------------------------------------------------------
+# numpy oracles (published update rules, independent of both stacks)
+# ---------------------------------------------------------------------------
+
+
+def _softmax_grads(W, b, x, y):
+    """CE-mean gradients for logits = x@W + b."""
+    B = len(y)
+    logits = x @ W + b
+    logits = logits - logits.max(1, keepdims=True)
+    p = np.exp(logits)
+    p /= p.sum(1, keepdims=True)
+    p[np.arange(B), y] -= 1.0
+    p /= B
+    return x.T @ p, p.sum(0)
+
+
+def oracle_as_shipped(fed, rounds, lr, epochs, per_round, init):
+    """Oracle of the reference's AS-SHIPPED sp behavior (the aliasing defect
+    documented in :func:`run_reference_lr`), pinned empirically:
+
+    - ROUND 0: ``w_global`` aliases the live model, so client k trains from
+      client k-1's result (sequential chain); global = mean of snapshots.
+    - ROUNDS >= 1: ``w_global`` is rebound to the detached ``_aggregate``
+      dict (fedavg_api.py:105), so the aliasing is gone and the update is
+      textbook FedAvg — the round-0 contamination just persists in the
+      trajectory forever.
+    """
+    train_x, train_y, counts, _, _ = fed
+    K = train_x.shape[0]
+    W, b = np.array(init[0], np.float32), np.array(init[1], np.float32)
+    traj = []
+    for r in range(rounds):
+        cohort = sample_cohort(r, K, per_round)
+        snaps = []
+        curW, curb = W, b
+        for ci in cohort:
+            x = train_x[ci].reshape(counts[ci], -1)
+            y = train_y[ci]
+            Wi, bi = (curW.copy(), curb.copy()) if r == 0 else (W.copy(), b.copy())
+            for _ in range(epochs):
+                gW, gb = _softmax_grads(Wi, bi, x, y)
+                Wi -= lr * gW
+                bi -= lr * gb
+            snaps.append((Wi, bi))
+            curW, curb = Wi, bi  # round 0 only: next client starts here
+        W = np.mean([s[0] for s in snaps], 0).astype(np.float32)
+        b = np.mean([s[1] for s in snaps], 0).astype(np.float32)
+        traj.append(np.concatenate([W.ravel(), b.ravel()]))
+    return np.stack(traj)
+
+
+def oracle_lr(fed, rounds, lr, epochs, per_round, init, mu=0.0,
+              scaffold=False):
+    """FedProx (Li et al. eq.2: +mu/2 ||w - w_t||^2) / SCAFFOLD (Karimireddy
+    et al., option II) / FedAvg, full-batch local steps, in plain numpy."""
+    train_x, train_y, counts, test_x, test_y = fed
+    K = train_x.shape[0]
+    W, b = np.array(init[0], np.float32), np.array(init[1], np.float32)
+    cW = np.zeros_like(W)
+    cb = np.zeros_like(b)
+    cWs = np.zeros((K,) + W.shape, np.float32)
+    cbs = np.zeros((K,) + b.shape, np.float32)
+    traj = []
+    for r in range(rounds):
+        cohort = sample_cohort(r, K, per_round)
+        newWs, newbs, weights = [], [], []
+        newcW, newcb = [], []
+        for ci in cohort:
+            x = train_x[ci].reshape(counts[ci], -1)
+            y = train_y[ci]
+            Wi, bi = W.copy(), b.copy()
+            steps = 0
+            for _ in range(epochs):
+                gW, gb = _softmax_grads(Wi, bi, x, y)
+                if mu > 0.0:
+                    gW = gW + mu * (Wi - W)
+                    gb = gb + mu * (bi - b)
+                if scaffold:
+                    gW = gW + cW - cWs[ci]
+                    gb = gb + cb - cbs[ci]
+                Wi -= lr * gW
+                bi -= lr * gb
+                steps += 1
+            newWs.append(Wi)
+            newbs.append(bi)
+            weights.append(float(counts[ci]))
+            if scaffold:
+                tau = float(steps)
+                newcW.append(cWs[ci] - cW + (W - Wi) / (tau * lr))
+                newcb.append(cbs[ci] - cb + (b - bi) / (tau * lr))
+        w = np.asarray(weights, np.float32)
+        w /= w.sum()
+        W = sum(wi * Wi for wi, Wi in zip(w, newWs)).astype(np.float32)
+        b = sum(wi * bi for wi, bi in zip(w, newbs)).astype(np.float32)
+        if scaffold:
+            dW = np.mean([nc - cWs[ci] for nc, ci in zip(newcW, cohort)], 0)
+            db = np.mean([nc - cbs[ci] for nc, ci in zip(newcb, cohort)], 0)
+            scale = len(cohort) / K
+            cW = cW + scale * dW
+            cb = cb + scale * db
+            for nc, nb, ci in zip(newcW, newcb, cohort):
+                cWs[ci] = nc
+                cbs[ci] = nb
+        traj.append(np.concatenate([W.ravel(), b.ravel()]))
+    return np.stack(traj)
+
+
+# ---------------------------------------------------------------------------
+# ResNet-56 curve parity (architectures differ by design: BN vs GN)
+# ---------------------------------------------------------------------------
+
+
+def run_resnet_curves(rounds, lr, per_round, n_clients, per_client, seed=0):
+    fed = make_federation(seed=seed, n_clients=n_clients,
+                          per_client=per_client, n_test=256,
+                          shape=(32, 32, 3), n_classes=10, lowfreq=True)
+    train_x, train_y, counts, test_x, test_y = fed
+
+    # ours -------------------------------------------------------------
+    import fedml_tpu as fedml
+    from fedml_tpu import models as model_mod
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.data.fed_dataset import FedDataset
+    from fedml_tpu.simulation.sp_api import FedAvgAPI
+
+    args = fedml.init(Arguments(overrides=dict(
+        dataset="cifar10", model="resnet56",
+        client_num_in_total=n_clients, client_num_per_round=per_round,
+        comm_round=rounds, epochs=1, batch_size=32, learning_rate=lr,
+        client_optimizer="sgd", frequency_of_the_test=1,
+    )), should_init_logs=False)
+    ds = FedDataset(train_x, train_y, counts, test_x, test_y, class_num=10)
+    bundle = model_mod.create(args, 10)
+    api = FedAvgAPI(args, fedml.get_device(args), ds, bundle)
+    ours = api.train()
+
+    # reference --------------------------------------------------------
+    _ref_setup()
+    import torch
+    from fedml.model.cv.resnet import resnet56
+    from fedml.simulation.sp.fedavg.fedavg_api import FedAvgAPI as RefAPI
+
+    torch.manual_seed(seed)
+
+    def loader(x, y, bs=32):
+        return torch.utils.data.DataLoader(
+            torch.utils.data.TensorDataset(
+                torch.from_numpy(np.transpose(x, (0, 3, 1, 2)).copy()),
+                torch.from_numpy(y.astype(np.int64)),
+            ), batch_size=bs, shuffle=False,
+        )
+
+    train_local = {i: loader(train_x[i], train_y[i]) for i in range(n_clients)}
+    test_local = {i: loader(test_x, test_y) for i in range(n_clients)}
+    train_num = {i: int(counts[i]) for i in range(n_clients)}
+    dataset = [int(counts.sum()), len(test_x), None, None,
+               train_num, train_local, test_local, 10]
+    ref_args = argparse.Namespace(
+        dataset="parity", model="resnet56", client_num_in_total=n_clients,
+        client_num_per_round=per_round, comm_round=rounds, epochs=1,
+        batch_size=32, learning_rate=lr, client_optimizer="sgd",
+        weight_decay=0.0, frequency_of_the_test=10_000, enable_wandb=False,
+    )
+    ref_api = RefAPI(ref_args, torch.device("cpu"), dataset, resnet56(class_num=10))
+    ref_api._local_test_on_all_clients = lambda *_: None
+    ref_api.train()
+
+    # shared evaluation of the reference's final global model
+    model = ref_api.model_trainer.model
+    model.eval()
+    with torch.no_grad():
+        logits = model(torch.from_numpy(np.transpose(test_x, (0, 3, 1, 2)).copy()))
+        ref_acc = float((logits.argmax(1).numpy() == test_y).mean())
+    return float(ours["test_acc"]), ref_acc
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+
+
+def rel_err(a, b):
+    return float(np.linalg.norm(a - b) / max(np.linalg.norm(b), 1e-12))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=20)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--per-round", type=int, default=5)
+    ap.add_argument("--clients", type=int, default=20)
+    ap.add_argument("--skip-resnet", action="store_true")
+    ap.add_argument("--resnet-only", action="store_true",
+                    help="run ONLY the ResNet-56 curve leg and merge into an "
+                         "existing PARITY.json. Run this one under the TPU "
+                         "env: ResNet-56's XLA:CPU compile takes >35 min on "
+                         "this host's single core, while the TPU compiles "
+                         "it in seconds — curve parity does not need a "
+                         "shared substrate (the LR legs prove exact math "
+                         "CPU-vs-CPU).")
+    ap.add_argument("--resnet-rounds", type=int, default=50)
+    ap.add_argument("--out", default=os.path.join(REPO, "PARITY.json"))
+    a = ap.parse_args()
+
+    import jax
+
+    if not a.resnet_only:
+        # float-comparable to torch CPU for the exact-trajectory legs
+        jax.config.update("jax_platforms", "cpu")
+    # persistent compile cache: the ResNet-56 leg's XLA:CPU compile is many
+    # minutes on one core; pay it once (same cache the test suite uses)
+    cache = os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                           "/tmp/fedml_tpu_jax_cache")
+    os.makedirs(cache, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    fed = make_federation(n_clients=a.clients)
+    init = _torch_linear_init(seed=0)
+    _, _, counts, test_x, test_y = fed
+    results = {}
+    if a.resnet_only and os.path.exists(a.out):
+        with open(a.out) as f:
+            results = json.load(f).get("results", {})
+
+    def report(name, ours_traj, other_traj, tol, oracle_name):
+        per_round = [rel_err(o, r) for o, r in zip(ours_traj, other_traj)]
+        W_last = ours_traj[-1][:-10].reshape(784, 10)
+        b_last = ours_traj[-1][-10:]
+        loss, acc = np_eval(W_last, b_last, test_x, test_y)
+        entry = {
+            "oracle": oracle_name,
+            "rounds": len(per_round),
+            "rel_l2_final": per_round[-1],
+            "rel_l2_max": max(per_round),
+            "tolerance": tol,
+            "ok": max(per_round) < tol,
+            "final_test_loss": round(loss, 4),
+            "final_test_acc": round(acc, 4),
+        }
+        results[name] = entry
+        print(json.dumps({"experiment": name, **entry}))
+        return entry
+
+    t0 = time.time()
+    common = dict(rounds=a.rounds, lr=a.lr, epochs=a.epochs,
+                  per_round=a.per_round)
+    if a.resnet_only:
+        _run_resnet_leg(a, results)
+        _finish(a, results, t0)
+        return
+
+    # 1a. FedAvg: ours vs the REFERENCE with its aliasing defect fixed --
+    # (one wrapped getter restores textbook FedAvg; see run_reference_lr)
+    ours = run_ours_lr(fed, init=init, **common)
+    ref_fixed = run_reference_lr(fed, init=init, fix_aliasing=True, **common)
+    report("fedavg_lr_vs_reference_aliasing_fixed", ours, ref_fixed, 1e-3,
+           "reference FedAvgAPI (torch CPU, in-process; get_model_params "
+           "wrapped to snapshot — repairs fedavg_api.py:67's live-reference "
+           "aliasing, changing nothing else)")
+
+    # 1b. The as-shipped reference is NOT textbook FedAvg: demonstrate we
+    # understand exactly what it does instead (round-0 chain oracle)
+    ref_shipped = run_reference_lr(fed, init=init, **common)
+    chain = oracle_as_shipped(fed, init=init, **common)
+    report("reference_as_shipped_semantics_pinned", chain, ref_shipped,
+           1e-3,
+           "numpy oracle of the reference's ACTUAL as-shipped semantics: in "
+           "round 0, get_model_params() returns live tensor refs, so client "
+           "k trains from client k-1's result (sequential chain); from "
+           "round 1 w_global is the detached aggregate and updates are "
+           "textbook — the as-shipped sp 'FedAvg' is textbook FedAvg from "
+           "a chain-contaminated round 0")
+
+    # 2. FedProx@mu=0 degenerates to FedAvg: ours vs the fixed reference
+    ours_p0 = run_ours_lr(fed, init=init, optimizer="FedProx", mu=0.0, **common)
+    report("fedprox_mu0_lr_vs_reference", ours_p0, ref_fixed, 1e-3,
+           "reference FedAvgAPI, aliasing fixed (the reference's FedProx "
+           "has no proximal term — simulation/mpi/fedprox carries none; "
+           "mu=0 makes the correct algorithm coincide with it)")
+
+    # 3. FedProx@mu>0: ours vs the numpy oracle -------------------------
+    mu = 0.5
+    ours_p = run_ours_lr(fed, init=init, optimizer="FedProx", mu=mu, **common)
+    orac_p = oracle_lr(fed, init=init, mu=mu, **common)
+    report("fedprox_mu0.5_lr_vs_oracle", ours_p, orac_p, 1e-3,
+           "numpy oracle of Li et al. 2020 eq.2 (reference has no proximal "
+           "term to compare against)")
+
+    # 4. SCAFFOLD: ours vs the numpy oracle -----------------------------
+    ours_s = run_ours_lr(fed, init=init, optimizer="SCAFFOLD", **common)
+    orac_s = oracle_lr(fed, init=init, scaffold=True, **common)
+    report("scaffold_lr_vs_oracle", ours_s, orac_s, 1e-3,
+           "numpy oracle of Karimireddy et al. 2020 option II (reference "
+           "has no SCAFFOLD)")
+
+    # 5. sanity: the trajectories actually LEARN (not parity of no-ops)
+    W_last = ours[-1][:-10].reshape(784, 10)
+    loss0, acc0 = np_eval(init[0], init[1], test_x, test_y)
+    lossN, accN = np_eval(W_last, ours[-1][-10:], test_x, test_y)
+    results["learning_sanity"] = {
+        "init_acc": round(acc0, 4), "final_acc": round(accN, 4),
+        "ok": accN > acc0 + 0.3,
+    }
+    print(json.dumps({"experiment": "learning_sanity",
+                      **results["learning_sanity"]}))
+
+    if not a.skip_resnet:
+        print("note: the ResNet-56 curve leg runs as a separate invocation "
+              "(--resnet-only, under the TPU env) — see its flag help")
+    _finish(a, results, t0)
+
+
+def _run_resnet_leg(a, results):
+    ours_acc, ref_acc = run_resnet_curves(
+        rounds=a.resnet_rounds, lr=0.1, per_round=4, n_clients=8,
+        per_client=96)
+    import jax
+
+    results["resnet56_fedavg_curve"] = {
+        "oracle": "reference FedAvgAPI + torch resnet56 (BatchNorm, CPU) — "
+                  "curve-level only: ours is the documented GroupNorm NHWC "
+                  "redesign, run on "
+                  f"{jax.devices()[0].platform} "
+                  "(substrate does not enter a learning-outcome comparison)",
+        "rounds": a.resnet_rounds,
+        "ours_final_acc": round(ours_acc, 4),
+        "ref_final_acc": round(ref_acc, 4),
+        "abs_gap": round(abs(ours_acc - ref_acc), 4),
+        # asymmetric on purpose: ours must MATCH OR BEAT the reference's
+        # learning outcome. GroupNorm legitimately converges faster than
+        # BatchNorm under FedAvg (running-stats averaging is the known BN
+        # pathology in FL — the reference's own benchmark switched to
+        # ResNet-18-GN for fed_cifar100 for the same reason), and faster
+        # convergence is not a parity failure.
+        "criterion": "ours_final_acc >= ref_final_acc - 0.05 and > 0.5",
+        "ok": ours_acc >= ref_acc - 0.05 and ours_acc > 0.5,
+    }
+    print(json.dumps({"experiment": "resnet56_fedavg_curve",
+                      **results["resnet56_fedavg_curve"]}))
+
+
+def _finish(a, results, t0):
+    out = {
+        "config": {
+            "clients": a.clients, "per_round": a.per_round,
+            "rounds": a.rounds, "epochs": a.epochs, "lr": a.lr,
+            "data": "class-conditional Gaussians, MNIST/CIFAR shapes, seed 0",
+            "substrate": "LR legs: both stacks on CPU (torch CPU vs XLA "
+                         "CPU); ResNet curve leg: see its oracle note",
+        },
+        "all_ok": all(v.get("ok") for v in results.values()),
+        "results": results,
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+    with open(a.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps({"parity": "done", "all_ok": out["all_ok"],
+                      "out": a.out, "elapsed_s": out["elapsed_s"]}))
+    sys.exit(0 if out["all_ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()
