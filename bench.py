@@ -128,15 +128,22 @@ def bench_cheetah() -> dict:
 
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu:
-        # wide-shallow beats deep-narrow on the MXU: at equal budget the
-        # d2048 x 8-layer shape measured 2.1x the MFU of d1024 x 24
-        # (tools/mfu_sweep.py — bigger matmuls, fewer kernel launches).
-        # Head dim is the second big lever: hd 512 with GQA (4 q / 2 kv
-        # heads) measured 67% MFU vs 42% at hd 128 (16 heads) — fewer,
-        # larger attention matmuls tile the MXU far better at this scale
+        # The flagship is the PRODUCT shape: Llama-standard head_dim 128
+        # with GQA 16q/4kv on a wide-shallow d2048 x 8L body — chosen
+        # product-shape-first, not max-MFU-first. Two levers got it to
+        # 75.7% MFU on the v5e (tools/mfu_sweep.py):
+        # - wide-shallow beats deep-narrow (d2048x8L ~2.1x the MFU of
+        #   d1024x24) — bigger matmuls, fewer kernel launches;
+        # - native-GQA splash attention (make_splash_mqa — K/V never
+        #   repeated to 16 heads) with explicit (512, 512) kernel blocks:
+        #   42% -> 75.7% for this shape, past the r2 bench-tuned hd512
+        #   flagship's 67%. (With the same block tuning hd512 reaches
+        #   79.4% — measured as the secondary datapoint below — but the
+        #   headline stays the shape people actually train.)
         base = dict(
-            vocab_size=32000, d_model=2048, n_layers=8, n_heads=4,
-            n_kv_heads=2, d_ff=5632, max_seq_len=2048,
+            vocab_size=32000, d_model=2048, n_layers=8, n_heads=16,
+            n_kv_heads=4, d_ff=5632, max_seq_len=2048,
+            attn_block_q=512, attn_block_kv=512,
         )
         # memory/recompute ladder, fastest first (tools/mfu_sweep.py):
         # no-remat needs the most HBM; "dots" saves matmul outputs only;
@@ -225,6 +232,12 @@ def bench_cheetah() -> dict:
 
 
 def main() -> None:
+    # subprocess measurement FIRST — before this process owns the TPU
+    hd512 = {}
+    try:
+        hd512 = bench_cheetah_hd512()
+    except Exception as e:
+        hd512 = {"cheetah_hd512_error": f"{type(e).__name__}: {e}"}
     fed = bench_fedavg()
     value = fed["rounds_per_sec"]
     ref = _ref_rounds_per_sec()
@@ -247,7 +260,46 @@ def main() -> None:
         line.update(bench_cheetah())
     except Exception as e:  # cheetah bench must never hide the headline
         line["cheetah_error"] = f"{type(e).__name__}: {e}"
+    line.update(hd512)
     print(json.dumps(line))
+
+
+def bench_cheetah_hd512() -> dict:
+    """Secondary shape (the r2 wide-head flagship, GQA 4q/2kv hd512) so both
+    datapoints stay measured round over round.
+
+    Runs as a SUBPROCESS and must be called BEFORE this process touches the
+    TPU: stock libtpu grants exclusive per-process device ownership, so a
+    child spawned after the parent initializes jax could never open the
+    chip (tools/mfu_sweep.py's parent never imports jax for this reason).
+    """
+    import subprocess
+    import sys
+
+    cfg = dict(
+        vocab_size=32000, d_model=2048, n_layers=8, n_heads=4,
+        n_kv_heads=2, d_ff=5632, max_seq_len=2048, remat=False,
+        remat_policy="full", attn_impl="auto", batch=8, seq=2048,
+        steps=10, loss_chunk=256, mu_bf16=True,
+        attn_block_q=512, attn_block_kv=512,  # clamped; 79.4% measured
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = HERE + os.pathsep + env.get("PYTHONPATH", "")
+    p = subprocess.run(
+        [sys.executable, os.path.join(HERE, "tools", "mfu_sweep.py"),
+         "--one", json.dumps(cfg)],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    out = (p.stdout.strip().splitlines() or ["<no output>"])[-1]
+    if p.returncode != 0:
+        err = (p.stderr.strip().splitlines() or [""])[-1]
+        return {"cheetah_hd512_error":
+                f"rc={p.returncode} {out[:120]} {err[:200]}"}
+    alt = json.loads(out)
+    return {
+        "cheetah_hd512_mfu": alt["mfu"],
+        "cheetah_hd512_tokens_per_sec_per_chip": alt["tok_s"],
+    }
 
 
 if __name__ == "__main__":

@@ -19,11 +19,14 @@ TPU-first choices:
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+
+logger = logging.getLogger(__name__)
 
 # Logical axis names (mapped to mesh axes by sharding.LOGICAL_RULES)
 EMBED = "embed"
@@ -68,6 +71,11 @@ class TransformerConfig:
     # fwd+bwd and its backward avoids flash's f32 [B,H,L,128] broadcasts,
     # which is what keeps the no-remat memory rung viable.
     attn_impl: str = "auto"
+    # splash kernel tile sizes (None = kernel defaults). The q/kv block pair
+    # is the main lever for small head_dim: at hd 128 the defaults leave the
+    # MXU underfed (tools/mfu_sweep.py sweeps these)
+    attn_block_q: int = 0
+    attn_block_kv: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -144,10 +152,48 @@ def _attn_backend(impl: str) -> str:
         return "flash"
 
 
-def splash_attention_tpu(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+def _splash_blocks(L: int, block_q: int, block_kv: int, head_dim: int):
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk,
+    )
+
+    if not block_q and not block_kv:
+        return None
+    bq = min(block_q or 512, L)
+    bkv = min(block_kv or 1024, L)
+
+    # clamp to the ~16 MB scoped-VMEM budget: the dkv kernel holds q/k/v/do
+    # tiles plus fp32 [bq, bkv] score/dscore buffers; estimate with a 2x
+    # margin and halve the larger block until it fits (hd512 at (512,1024)
+    # measures 17 MB and aborts compilation without this)
+    def est(q_, kv_):
+        return 2 * (4 * head_dim * (q_ + 2 * kv_) + 8 * q_ * kv_)
+
+    budget = 16 * 1024 * 1024
+    while est(bq, bkv) > budget and max(bq, bkv) > 128:
+        if bkv >= bq:
+            bkv //= 2
+        else:
+            bq //= 2
+    if (bq, bkv) != (min(block_q or 512, L), min(block_kv or 1024, L)):
+        logger.info("splash blocks clamped to (%d, %d) for head_dim %d",
+                    bq, bkv, head_dim)
+    return sk.BlockSizes(
+        block_q=bq, block_kv=bkv, block_kv_compute=bkv,
+        block_q_dkv=bq, block_kv_dkv=bkv, block_kv_dkv_compute=bkv,
+        block_q_dq=bq, block_kv_dq=bkv,
+    )
+
+
+def splash_attention_tpu(q: jax.Array, k: jax.Array, v: jax.Array,
+                         block_q: int = 0, block_kv: int = 0) -> jax.Array:
     """Causal splash attention (the current-generation Pallas TPU kernel).
 
-    q/k/v: [B, L, H, D] (Hkv already expanded for GQA) → out [B, L, H, D].
+    q: [B, L, H, D]; k/v: [B, L, Hkv, D] → out [B, L, H, D]. GQA/MQA run
+    NATIVELY (``make_splash_mqa`` vmapped over kv groups) — K/V are never
+    repeated to H heads, cutting both the repeat's HBM traffic and the
+    kernel's K/V block loads by H/Hkv.
+
     The kernel is built per trace — make_splash_mha captures trace-local
     mask arrays, so caching it across jit traces leaks tracers.
     """
@@ -157,12 +203,24 @@ def splash_attention_tpu(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     )
 
     B, L, H, D = q.shape
-    mask = sm.MultiHeadMask([sm.CausalMask((L, L))] * H)
-    kernel = sk.make_splash_mha(mask=mask, head_shards=1, q_seq_shards=1)
+    Hkv = k.shape[2]
     scale = float(1.0 / D ** 0.5)
-    qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))  # [B, H, L, D]
-    out = jax.vmap(kernel)(qt * scale, kt, vt)
-    return out.swapaxes(1, 2)
+    blocks = _splash_blocks(L, block_q, block_kv, D)
+    qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))  # [B, H(kv), L, D]
+    if Hkv == H:
+        mask = sm.MultiHeadMask([sm.CausalMask((L, L))] * H)
+        kernel = sk.make_splash_mha(mask=mask, block_sizes=blocks,
+                                    head_shards=1, q_seq_shards=1)
+        out = jax.vmap(kernel)(qt * scale, kt, vt)
+        return out.swapaxes(1, 2)
+    # grouped-query: per kv group g, rep = H/Hkv query heads share k/v[g]
+    rep = H // Hkv
+    mask = sm.MultiHeadMask([sm.CausalMask((L, L))] * rep)
+    kernel = sk.make_splash_mqa(mask=mask, block_sizes=blocks,
+                                head_shards=1, q_seq_shards=1)
+    qg = (qt * scale).reshape(B, Hkv, rep, L, D)
+    out = jax.vmap(jax.vmap(kernel))(qg, kt, vt)  # [B, Hkv, rep, L, D]
+    return out.reshape(B, H, L, D).swapaxes(1, 2)
 
 
 def flash_attention_tpu(
@@ -274,10 +332,14 @@ class Attention(nn.Module):
             mask is None and L >= 128 and L % 128 == 0
             and _attn_backend(cfg.attn_impl) != "xla"
         ):
-            k, v = expand_gqa(k, v, H)
             if _attn_backend(cfg.attn_impl) == "splash":
-                out = splash_attention_tpu(q, k, v)
+                # GQA handled natively by the kernel — no K/V expand
+                out = splash_attention_tpu(
+                    q, k, v,
+                    block_q=cfg.attn_block_q, block_kv=cfg.attn_block_kv,
+                )
             else:
+                k, v = expand_gqa(k, v, H)
                 out = flash_attention_tpu(q, k, v)
         else:
             out = attention_scores(q, k, v, mask)

@@ -34,6 +34,8 @@ def main() -> None:
     ap.add_argument("--batch-per-shard", type=int, default=1)
     ap.add_argument("--seq-len", type=int, default=2048)
     ap.add_argument("--skip-compile", action="store_true")
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "SEVENB_READINESS.json"))
     a = ap.parse_args()
 
     os.environ["XLA_FLAGS"] = (
@@ -158,8 +160,10 @@ def main() -> None:
     for r in rows:
         print("  " + "  ".join(f"{str(r[h]):>18}" for h in hdr))
     if temp_bytes_per_chip is not None:
-        print(f"  (XLA-compiled temp buffer per chip at fsdp={a.devices}: "
-              f"{temp_bytes_per_chip / GiB:.2f} GiB)")
+        print(f"  (XLA temp buffer per chip at fsdp={a.devices}: "
+              f"{temp_bytes_per_chip / GiB:.2f} GiB — CPU-backend layout "
+              f"with different fusion/remat decisions than TPU; NOT an HBM "
+              f"prediction, use the analytic rows)")
 
     out = {
         "params_b": round(n_params / 1e9, 3),
@@ -173,6 +177,9 @@ def main() -> None:
         ),
     }
     print(json.dumps(out))
+    if not a.skip_compile:
+        with open(a.out, "w") as f:
+            json.dump(out, f, indent=2)
     ok = (compile_ok is not False) and rows[-1]["fits_v5p"]
     sys.exit(0 if ok else 1)
 
