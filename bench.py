@@ -68,16 +68,19 @@ def _ref_rounds_per_sec() -> float | None:
         return None
 
 
-def _self_cpu_rounds_per_sec() -> float | None:
-    """Our sp engine measured on CPU (tools/measure_same_substrate.py) — the
-    same substrate as the reference measurement, isolating architecture from
-    hardware in the baseline ratio."""
+def _same_substrate() -> dict:
+    """Both-stacks-on-CPU measurement (tools/measure_same_substrate.py):
+    the ratio isolating architecture from hardware."""
     path = os.path.join(HERE, "SELF_CPU_BASELINE.json")
     try:
         with open(path) as f:
-            return float(json.load(f)["self_cpu_rounds_per_sec"])
-    except (OSError, KeyError, ValueError):
-        return None
+            d = json.load(f)
+        return {
+            "vs_baseline_same_substrate": d.get("same_substrate_ratio"),
+            "same_substrate_config": d.get("config"),
+        }
+    except (OSError, ValueError):
+        return {"vs_baseline_same_substrate": None}
 
 
 def bench_fedavg() -> dict:
@@ -241,7 +244,6 @@ def main() -> None:
     fed = bench_fedavg()
     value = fed["rounds_per_sec"]
     ref = _ref_rounds_per_sec()
-    self_cpu = _self_cpu_rounds_per_sec()
     line = {
         "metric": "fedavg_rounds_per_sec_100clients_cifar10_resnet56",
         "value": round(value, 4),
@@ -251,10 +253,7 @@ def main() -> None:
         "vs_baseline": round(value / ref, 2) if ref else None,
         "ref_rounds_per_sec_measured": ref,
         # ours-on-CPU / reference-on-CPU: the architectural win alone
-        "vs_baseline_same_substrate": (
-            round(self_cpu / ref, 2) if (ref and self_cpu) else None
-        ),
-        "self_cpu_rounds_per_sec_measured": self_cpu,
+        **_same_substrate(),
     }
     try:
         line.update(bench_cheetah())
@@ -296,6 +295,8 @@ def bench_cheetah_hd512() -> dict:
         return {"cheetah_hd512_error":
                 f"rc={p.returncode} {out[:120]} {err[:200]}"}
     alt = json.loads(out)
+    if "skipped" in alt:  # CPU-only host: the child declined the TPU shape
+        return {}
     return {
         "cheetah_hd512_mfu": alt["mfu"],
         "cheetah_hd512_tokens_per_sec_per_chip": alt["tok_s"],

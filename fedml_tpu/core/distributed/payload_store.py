@@ -121,6 +121,102 @@ class PayloadStore:
             pass
 
 
+class HttpPayloadStore(PayloadStore):
+    """Object-store backend: the same PayloadStore contract over HTTP
+    PUT/GET/DELETE against a base URL.
+
+    reference: ``communication/s3/remote_storage.py:18-183`` (boto3
+    put_object/get_object) — the role here is the same bulk channel for
+    cross-org Octopus where no shared filesystem exists. Any object gateway
+    that accepts ``PUT <base>/<key>`` / ``GET`` / ``DELETE`` works: S3/GCS
+    presigned-URL proxies, nginx with dav_methods, MinIO, a plain WebDAV
+    share. Auth rides in ``headers`` (e.g. a bearer token); TTL/sweeping is
+    the store's lifecycle policy, so :meth:`sweep` is a logged no-op.
+    """
+
+    def __init__(self, base_url: str, headers: Optional[dict] = None,
+                 timeout_s: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.headers = dict(headers or {})
+        self.timeout_s = float(timeout_s)
+
+    _KEY_RE = None  # compiled lazily (class-level cache)
+
+    def _url(self, key: str) -> str:
+        import re
+
+        if HttpPayloadStore._KEY_RE is None:
+            # URL-safe only: '?', '#', '%', '/' etc. would address a
+            # DIFFERENT object than the same key in the directory store
+            HttpPayloadStore._KEY_RE = re.compile(r"[A-Za-z0-9_\-][A-Za-z0-9._\-]*\Z")
+        if not HttpPayloadStore._KEY_RE.match(key):
+            raise ValueError(f"bad payload key: {key!r}")
+        return f"{self.base_url}/{key}"
+
+    def _request(self, method: str, key: str, body: Optional[bytes] = None):
+        import urllib.request
+
+        req = urllib.request.Request(
+            self._url(key), data=body, method=method,
+            headers={"Content-Type": "application/octet-stream",
+                     **self.headers},
+        )
+        return urllib.request.urlopen(req, timeout=self.timeout_s)
+
+    def _serialize(self, arrays: List[np.ndarray]) -> bytes:
+        buf = io.BytesIO()
+        np.savez(buf, *[np.asarray(a) for a in arrays])
+        return buf.getvalue()
+
+    def put(self, key: str, arrays: List[np.ndarray]) -> str:
+        with self._request("PUT", key, self._serialize(arrays)):
+            pass
+        return key
+
+    def put_dedup(self, arrays: List[np.ndarray]) -> str:
+        data = self._serialize(arrays)
+        key = f"cas-{hashlib.sha256(data).hexdigest()}.npz"
+        # HEAD probe: a broadcast of one model to N peers uploads once
+        import urllib.error
+
+        try:
+            with self._request("HEAD", key):
+                return key
+        except urllib.error.HTTPError as e:
+            if e.code not in (404, 405):  # 405: gateway without HEAD
+                raise
+        with self._request("PUT", key, data):
+            pass
+        return key
+
+    def get(self, key: str, delete: bool = False) -> List[np.ndarray]:
+        with self._request("GET", key) as resp:
+            data = resp.read()
+        with np.load(io.BytesIO(data)) as z:
+            arrays = [z[k] for k in z.files]
+        if delete:
+            self.delete(key)
+        return arrays
+
+    def delete(self, key: str) -> None:
+        import urllib.error
+
+        try:
+            with self._request("DELETE", key):
+                pass
+        except urllib.error.HTTPError:
+            pass
+
+    def sweep(self, max_age_seconds: float = 3600.0) -> int:
+        logger.info("HttpPayloadStore.sweep: no-op (object-store TTL is the "
+                    "gateway's lifecycle policy)")
+        return 0
+
+
 def store_from_args(args) -> Optional[PayloadStore]:
     root = str(getattr(args, "payload_store_dir", "") or "")
-    return PayloadStore(root) if root else None
+    if not root:
+        return None
+    if root.startswith(("http://", "https://")):
+        return HttpPayloadStore(root)
+    return PayloadStore(root)

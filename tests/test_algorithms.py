@@ -84,6 +84,7 @@ class TestSplitNN:
         assert res["test_acc"] > 0.6
 
 
+@pytest.mark.slow
 class TestFedGKT:
     def test_knowledge_transfer_learns(self):
         res = run_sim(federated_optimizer="FedGKT", client_num_in_total=4,
@@ -103,6 +104,7 @@ class TestTurboAggregate:
         assert abs(secure["test_acc"] - plain["test_acc"]) < 0.15
 
 
+@pytest.mark.slow
 class TestFedSeg:
     """VERDICT missing #6: segmentation runtime (reference simulation/mpi/fedseg)."""
 
@@ -118,6 +120,7 @@ class TestFedSeg:
         assert res["test_miou"] > 0.05
 
 
+@pytest.mark.slow
 class TestFedGAN:
     """VERDICT missing #6: adversarial runtime (reference simulation/mpi/fedgan)."""
 
@@ -141,6 +144,7 @@ class TestFedGAN:
         assert np.all(np.isfinite(samples))
 
 
+@pytest.mark.slow
 class TestFedNAS:
     """VERDICT missing #6: DARTS search runtime (reference simulation/mpi/fednas)."""
 

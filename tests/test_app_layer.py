@@ -8,6 +8,9 @@ prefix-LM seq2seq, dense detection, tabular healthcare.
 """
 
 import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
 
 import fedml_tpu as fedml
 from fedml_tpu import data as data_mod

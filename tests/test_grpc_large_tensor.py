@@ -13,6 +13,8 @@ import threading
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 grpc = pytest.importorskip("grpc")
 
 from fedml_tpu.core.distributed.grpc_backend import GRPCCommManager

@@ -50,12 +50,14 @@ class TestSPFedAvg:
                       server_lr=0.03)
         assert res["test_acc"] > 0.5
 
+    @pytest.mark.slow
     def test_cnn_on_mnist(self):
         res = run_sim(dataset="mnist", model="cnn", client_num_in_total=8,
                       client_num_per_round=8, comm_round=6, epochs=2,
                       batch_size=8, learning_rate=0.05)
         assert res["test_acc"] > 0.8
 
+    @pytest.mark.slow
     def test_rnn_nwp_learns(self):
         res = run_sim(dataset="shakespeare", model="rnn",
                       client_num_in_total=4, client_num_per_round=4,

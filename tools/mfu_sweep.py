@@ -44,6 +44,11 @@ def run_one(cfg: dict) -> None:
     B, L, steps = cfg.pop("batch"), cfg.pop("seq"), cfg.pop("steps")
     loss_chunk = cfg.pop("loss_chunk")
     mu_bf16 = cfg.pop("mu_bf16", False)
+    if jax.devices()[0].platform != "tpu":
+        # the matrix shapes are TPU-sized; grinding them on CPU just burns
+        # the caller's timeout (bench.py's hd512 secondary relies on this)
+        print(json.dumps({"skipped": "not a tpu host"}))
+        return
     tc = TransformerConfig(**cfg)
     mesh = make_mesh()
     tr = CheetahTrainer(
