@@ -31,6 +31,12 @@ logger = logging.getLogger(__name__)
 # message param carrying the reference (absent = inline payload)
 PAYLOAD_REF_KEY = "__payload_ref__"
 
+# URL-safe object keys only: '?', '#', '%', '/' etc. would address a
+# DIFFERENT object over HTTP than the same key in the directory store
+import re  # noqa: E402
+
+HTTP_KEY_RE = re.compile(r"[A-Za-z0-9_\-][A-Za-z0-9._\-]*\Z")
+
 
 class PayloadStore:
     """npz blobs under a shared directory, addressed by opaque keys."""
@@ -140,16 +146,8 @@ class HttpPayloadStore(PayloadStore):
         self.headers = dict(headers or {})
         self.timeout_s = float(timeout_s)
 
-    _KEY_RE = None  # compiled lazily (class-level cache)
-
     def _url(self, key: str) -> str:
-        import re
-
-        if HttpPayloadStore._KEY_RE is None:
-            # URL-safe only: '?', '#', '%', '/' etc. would address a
-            # DIFFERENT object than the same key in the directory store
-            HttpPayloadStore._KEY_RE = re.compile(r"[A-Za-z0-9_\-][A-Za-z0-9._\-]*\Z")
-        if not HttpPayloadStore._KEY_RE.match(key):
+        if not HTTP_KEY_RE.match(key):
             raise ValueError(f"bad payload key: {key!r}")
         return f"{self.base_url}/{key}"
 
@@ -176,24 +174,34 @@ class HttpPayloadStore(PayloadStore):
     def put_dedup(self, arrays: List[np.ndarray]) -> str:
         data = self._serialize(arrays)
         key = f"cas-{hashlib.sha256(data).hexdigest()}.npz"
-        # HEAD probe: a broadcast of one model to N peers uploads once
+        # HEAD probe: a broadcast of one model to N peers uploads once. Any
+        # HTTP error (404, 405/501 no-HEAD gateways, 403 PUT-scoped auth)
+        # just means "can't confirm it exists" — fall through to PUT, whose
+        # own failure is the one that matters.
         import urllib.error
 
         try:
             with self._request("HEAD", key):
                 return key
-        except urllib.error.HTTPError as e:
-            if e.code not in (404, 405):  # 405: gateway without HEAD
-                raise
+        except urllib.error.HTTPError:
+            pass
         with self._request("PUT", key, data):
             pass
         return key
 
     def get(self, key: str, delete: bool = False) -> List[np.ndarray]:
-        with self._request("GET", key) as resp:
-            data = resp.read()
-        with np.load(io.BytesIO(data)) as z:
-            arrays = [z[k] for k in z.files]
+        # normalise transport/decode failures to OSError: callers (the comm
+        # managers' receive loops) drop a message on OSError instead of
+        # dying, and the directory store's failures are all OSError already
+        try:
+            with self._request("GET", key) as resp:
+                data = resp.read()
+            with np.load(io.BytesIO(data)) as z:
+                arrays = [z[k] for k in z.files]
+        except OSError:
+            raise
+        except Exception as e:
+            raise OSError(f"payload fetch/decode failed for {key}: {e}") from e
         if delete:
             self.delete(key)
         return arrays
@@ -208,8 +216,9 @@ class HttpPayloadStore(PayloadStore):
             pass
 
     def sweep(self, max_age_seconds: float = 3600.0) -> int:
-        logger.info("HttpPayloadStore.sweep: no-op (object-store TTL is the "
-                    "gateway's lifecycle policy)")
+        # called per over-limit send by the comm managers — debug, not info
+        logger.debug("HttpPayloadStore.sweep: no-op (object-store TTL is "
+                     "the gateway's lifecycle policy)")
         return 0
 
 

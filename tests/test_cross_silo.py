@@ -204,6 +204,52 @@ class TestHierarchicalSilo:
             assert slave.done.wait(timeout=30)
 
 
+
+def make_object_gateway():
+    """In-process HTTP object gateway (PUT/GET/HEAD/DELETE over a dict) for
+    the HttpPayloadStore tests. Returns (httpd, blobs, puts)."""
+    import http.server
+
+    blobs = {}
+    puts = []
+
+    class Gateway(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _key(self):
+            return self.path.lstrip("/")
+
+        def do_PUT(self):
+            n = int(self.headers.get("Content-Length", 0))
+            blobs[self._key()] = self.rfile.read(n)
+            puts.append(self._key())
+            self.send_response(201)
+            self.end_headers()
+
+        def do_GET(self):
+            data = blobs.get(self._key())
+            if data is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_HEAD(self):
+            self.send_response(200 if self._key() in blobs else 404)
+            self.end_headers()
+
+        def do_DELETE(self):
+            blobs.pop(self._key(), None)
+            self.send_response(204)
+            self.end_headers()
+
+    return http.server.ThreadingHTTPServer(("127.0.0.1", 0), Gateway), blobs, puts
+
+
 class TestLivenessAndPayloadRef:
     """VERDICT next #6: dropout tolerance + payload-by-reference transport
     (reference MQTT last-will + MQTT+S3 split)."""
@@ -228,7 +274,6 @@ class TestLivenessAndPayloadRef:
         """Object-store backend (reference: S3 remote_storage role): same
         PayloadStore contract over HTTP PUT/GET/DELETE, exercised against an
         in-process object gateway; put_dedup uploads a repeated payload once."""
-        import http.server
         import threading
 
         from fedml_tpu.core.distributed.payload_store import (
@@ -236,44 +281,7 @@ class TestLivenessAndPayloadRef:
             store_from_args,
         )
 
-        blobs = {}
-        puts = []
-
-        class Gateway(http.server.BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def _key(self):
-                return self.path.lstrip("/")
-
-            def do_PUT(self):
-                n = int(self.headers.get("Content-Length", 0))
-                blobs[self._key()] = self.rfile.read(n)
-                puts.append(self._key())
-                self.send_response(201)
-                self.end_headers()
-
-            def do_GET(self):
-                data = blobs.get(self._key())
-                if data is None:
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                self.send_response(200)
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-            def do_HEAD(self):
-                self.send_response(200 if self._key() in blobs else 404)
-                self.end_headers()
-
-            def do_DELETE(self):
-                blobs.pop(self._key(), None)
-                self.send_response(204)
-                self.end_headers()
-
-        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Gateway)
+        httpd, blobs, puts = make_object_gateway()
         threading.Thread(target=httpd.serve_forever, daemon=True).start()
         try:
             url = f"http://127.0.0.1:{httpd.server_address[1]}"
@@ -294,6 +302,13 @@ class TestLivenessAndPayloadRef:
             assert k1 == k2 and puts.count(k1) == 1
             with pytest.raises(ValueError):
                 store.put("../escape", arrays)
+            # missing blob and corrupt blob both surface as OSError (the
+            # receive loops' drop-message contract)
+            with pytest.raises(OSError):
+                store.get("missing-blob.npz")
+            blobs["corrupt.npz"] = b"not an npz"
+            with pytest.raises(OSError):
+                store.get("corrupt.npz")
         finally:
             httpd.shutdown()
 
@@ -321,6 +336,29 @@ class TestLivenessAndPayloadRef:
         # every wire message is control-sized; the lr model inline would be
         # ~25 KB (3x65x4B x2 leaves + header)
         assert max(sizes) < 4096, f"bulk payload leaked onto the wire: {max(sizes)}"
+
+    def test_cross_silo_fsm_over_http_object_store(self):
+        """The full cross-silo FSM with bulk payloads riding the HTTP object
+        backend (payload_store_dir = an http:// URL): cross-org Octopus with
+        no shared filesystem."""
+        import threading
+
+        httpd, blobs, puts = make_object_gateway()
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            result, server, clients = run_world(
+                "httpstore1",
+                payload_store_dir=(
+                    f"http://127.0.0.1:{httpd.server_address[1]}"
+                ),
+                payload_inline_limit_bytes=64,
+            )
+            assert result["test_acc"] > 0.5
+            # the bulk channel REALLY rode the gateway (uploads happened;
+            # inline fallback would leave it untouched)
+            assert puts, "no payload ever reached the object gateway"
+        finally:
+            httpd.shutdown()
 
     def test_round_timeout_drops_dead_client(self):
         """4 clients; 1 dies after reporting ONLINE (never trains). With

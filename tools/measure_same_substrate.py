@@ -89,7 +89,7 @@ def measure_reference(model: str, rounds: int) -> float:
     )
     mrb = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mrb)
-    sys.path.insert(0, "/root/reference/python")
+    sys.path.insert(0, mrb.REF)
     logging.disable(logging.INFO)
     mrb._import_with_stubs("fedml")
 
