@@ -24,6 +24,19 @@ from ..parallel.transformer import TransformerConfig
 logger = logging.getLogger(__name__)
 
 
+def _parse_bool(v) -> bool:
+    """YAML-robust bool: unregistered keys reach us as raw strings, and
+    bool(\"false\") would silently mean True."""
+    if isinstance(v, str):
+        lowered = v.strip().lower()
+        if lowered in ("1", "true", "yes", "on"):
+            return True
+        if lowered in ("0", "false", "no", "off", ""):
+            return False
+        raise ValueError(f"not a boolean: {v!r}")
+    return bool(v)
+
+
 def config_from_args(args) -> TransformerConfig:
     size = str(getattr(args, "model_size", "tiny")).lower()
     if size in ("7b", "llama2_7b"):
@@ -32,6 +45,17 @@ def config_from_args(args) -> TransformerConfig:
         return TransformerConfig.tiny(
             vocab_size=int(getattr(args, "vocab_size", 256))
         )
+    # knobs beyond the shape: splash kernel blocks (the hd128 MFU lever —
+    # tools/mfu_sweep.py), MoE routing, remat — all YAML-reachable. Only
+    # keys the config actually carries are passed through, so the
+    # TransformerConfig dataclass defaults stay the single source of truth.
+    extra = {}
+    for name, cast in (("attn_block_q", int), ("attn_block_kv", int),
+                       ("moe_experts", int), ("moe_top_k", int),
+                       ("moe_capacity_factor", float),
+                       ("remat", _parse_bool), ("remat_policy", str)):
+        if hasattr(args, name):
+            extra[name] = cast(getattr(args, name))
     return TransformerConfig(
         vocab_size=int(getattr(args, "vocab_size", 32000)),
         d_model=int(getattr(args, "d_model", 1024)),
@@ -40,6 +64,7 @@ def config_from_args(args) -> TransformerConfig:
         n_kv_heads=int(getattr(args, "n_kv_heads", 8)),
         d_ff=int(getattr(args, "d_ff", 2816)),
         max_seq_len=int(getattr(args, "seq_len", 1024)),
+        **extra,
     )
 
 

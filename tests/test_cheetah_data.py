@@ -50,6 +50,39 @@ class TestCheetahRealTokens:
         res = runner.run()
         assert res["steps"] == 2
 
+    def test_custom_size_yaml_knobs_reach_config(self):
+        """attn blocks / MoE routing / remat are YAML-reachable through
+        model_size=custom (cheetah/runner.config_from_args)."""
+        args = fedml.init(Arguments(overrides=dict(
+            training_type="distributed", dataset="synthetic",
+            model="transformer", model_size="custom", vocab_size=128,
+            d_model=64, n_layers=2, n_heads=4, n_kv_heads=4, d_ff=128,
+            seq_len=64, batch_size=4, total_steps=2,
+            moe_experts=4, moe_top_k=2, moe_capacity_factor=2.0,
+            attn_block_q=256, attn_block_kv=256, remat=False,
+            mesh_shape="data:2,expert:2,fsdp:2",
+        )), should_init_logs=False)
+        runner = FedMLRunner(args, fedml.get_device(args), None, None)
+        cfg = runner.runner.cfg
+        assert cfg.moe_experts == 4 and cfg.moe_top_k == 2
+        assert cfg.attn_block_q == 256 and cfg.remat is False
+        res = runner.run()
+        assert res["steps"] == 2 and np.isfinite(res["final_loss"])
+        # YAML string booleans must not silently truthy ("false" -> True)
+        from fedml_tpu.cheetah.runner import config_from_args
+
+        args.remat = "false"
+        assert config_from_args(args).remat is False
+        # unset knobs inherit the dataclass defaults (single source of truth)
+        bare = fedml.init(Arguments(overrides=dict(
+            training_type="distributed", dataset="synthetic",
+            model="transformer", model_size="custom", vocab_size=64,
+            d_model=32, n_layers=2, n_heads=2, n_kv_heads=2, d_ff=64,
+            seq_len=32,
+        )), should_init_logs=False)
+        cfg2 = config_from_args(bare)
+        assert cfg2.moe_experts == 0 and cfg2.remat is True
+
 
 def _write_leaf_shakespeare(root):
     os.makedirs(os.path.join(root, "shakespeare", "train"))
