@@ -141,10 +141,14 @@ class HttpPayloadStore(PayloadStore):
     """
 
     def __init__(self, base_url: str, headers: Optional[dict] = None,
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0, dedup_refresh_age_s: float = 300.0):
         self.base_url = base_url.rstrip("/")
         self.headers = dict(headers or {})
         self.timeout_s = float(timeout_s)
+        # dedup HEAD hits on blobs older than this re-PUT to refresh the
+        # gateway's lifecycle clock (see put_dedup)
+        self.dedup_refresh_age_s = float(dedup_refresh_age_s)
+        self._warned_no_age = False
 
     def _url(self, key: str) -> str:
         if not HTTP_KEY_RE.match(key):
@@ -181,13 +185,41 @@ class HttpPayloadStore(PayloadStore):
         import urllib.error
 
         try:
-            with self._request("HEAD", key):
-                return key
+            with self._request("HEAD", key) as resp:
+                # TTL refresh on dedup hit (directory store utimes here): if
+                # the gateway runs an age-based lifecycle and the blob is
+                # already old — or its age is unknowable (no Last-Modified)
+                # — re-PUT to reset its clock, otherwise a just-sent message
+                # could reference a sweep target. Fresh blobs skip the upload.
+                age = self._age_seconds(resp)
+                if age is not None and age < self.dedup_refresh_age_s:
+                    return key
+                if age is None and not self._warned_no_age:
+                    # correctness over bandwidth, but never silently: a
+                    # gateway that omits Last-Modified re-uploads every
+                    # dedup hit
+                    self._warned_no_age = True
+                    logger.warning(
+                        "object gateway sends no Last-Modified on HEAD: "
+                        "put_dedup re-uploads on every hit (dedup degraded)")
         except urllib.error.HTTPError:
             pass
         with self._request("PUT", key, data):
             pass
         return key
+
+    @staticmethod
+    def _age_seconds(resp) -> Optional[float]:
+        """Blob age from a HEAD response's Last-Modified, None if absent."""
+        lm = resp.headers.get("Last-Modified") if resp.headers else None
+        if not lm:
+            return None
+        from email.utils import parsedate_to_datetime
+
+        try:
+            return max(0.0, time.time() - parsedate_to_datetime(lm).timestamp())
+        except (TypeError, ValueError):
+            return None
 
     def get(self, key: str, delete: bool = False) -> List[np.ndarray]:
         # normalise transport/decode failures to OSError: callers (the comm
@@ -223,9 +255,27 @@ class HttpPayloadStore(PayloadStore):
 
 
 def store_from_args(args) -> Optional[PayloadStore]:
+    """YAML/args surface:
+
+    - ``payload_store_dir``: directory path, or an http(s) base URL for the
+      object-gateway backend
+    - ``payload_store_timeout_s``: HTTP request timeout (default 30)
+    - ``payload_store_headers``: dict of extra request headers (auth etc.)
+    - ``payload_store_auth_token``: shorthand for a bearer token; the
+      ``FEDML_TPU_PAYLOAD_TOKEN`` env var works too (env wins, so secrets
+      can stay out of the YAML)
+    """
     root = str(getattr(args, "payload_store_dir", "") or "")
     if not root:
         return None
     if root.startswith(("http://", "https://")):
-        return HttpPayloadStore(root)
+        headers = dict(getattr(args, "payload_store_headers", None) or {})
+        token = (os.environ.get("FEDML_TPU_PAYLOAD_TOKEN")
+                 or getattr(args, "payload_store_auth_token", None))
+        if token:
+            headers.setdefault("Authorization", f"Bearer {token}")
+        return HttpPayloadStore(
+            root, headers=headers,
+            timeout_s=float(getattr(args, "payload_store_timeout_s", 30.0)),
+        )
     return PayloadStore(root)

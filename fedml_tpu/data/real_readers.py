@@ -162,17 +162,32 @@ def try_load_stackoverflow_lr(cache_dir: str, vocab_size: int = 10000,
                 out[i] = 1.0
         return out
 
+    def _s(v) -> str:
+        return v.decode("utf-8", errors="ignore") if isinstance(v, bytes) else str(v)
+
     def load_split(path):
         xs, ys = [], []
         with h5py.File(path, "r") as h5:
             for cid in sorted(h5[_EXAMPLE].keys()):
                 g = h5[_EXAMPLE][cid]
-                sx = [bow(s.decode("utf-8", errors="ignore")
-                          if isinstance(s, bytes) else str(s))
-                      for s in g["tokens"][()]]
-                sy = [multihot(t.decode("utf-8", errors="ignore")
-                               if isinstance(t, bytes) else str(t))
-                      for t in g["tags"][()]]
+                toks = g["tokens"][()]
+                if "title" in g:
+                    # reference joins tokens + " " + title per sample
+                    # (stackoverflow_lr/dataset.py:64-67) — the title's words
+                    # count toward both the BoW mass and the token count
+                    titles = g["title"][()]
+                    if len(titles) != len(toks):
+                        raise ValueError(
+                            f"stackoverflow_lr client {cid}: "
+                            f"{len(toks)} tokens vs {len(titles)} titles "
+                            f"(corrupt h5 — features would misalign with tags)"
+                        )
+                    sents = [" ".join([_s(s), _s(t)])
+                             for s, t in zip(toks, titles)]
+                else:
+                    sents = [_s(s) for s in toks]
+                sx = [bow(s) for s in sents]
+                sy = [multihot(_s(t)) for t in g["tags"][()]]
                 if sx:
                     xs.append(np.stack(sx))
                     ys.append(np.stack(sy))

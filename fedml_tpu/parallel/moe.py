@@ -82,9 +82,22 @@ class MoEFeedForward(nn.Module):
             probs, expert_idx[:, None], axis=-1
         )[:, 0]
 
-        # Switch aux loss over FIRST choices: E * Σ_e frac_e * mean_prob_e
         one_hot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [T, E]
-        frac = one_hot.mean(0)
+        if top_k == 2:
+            # second choice: argmax with the first masked out
+            probs2 = probs * (1.0 - one_hot)
+            idx2 = jnp.argmax(probs2, axis=-1)
+            prob2 = jnp.take_along_axis(probs2, idx2[:, None], axis=-1)[:, 0]
+            one_hot2 = jax.nn.one_hot(idx2, E, dtype=jnp.float32)
+            # GShard/Mixtral-style aux loss: load fraction over ALL k
+            # assignments (second-choice hot-spotting is visible to the
+            # regularizer), normalised by k so a balanced router still
+            # scores 1.0
+            frac = (one_hot + one_hot2).mean(0) / top_k
+        else:
+            one_hot2 = None
+            # Switch aux loss: E * Σ_e frac_e * mean_prob_e over first choices
+            frac = one_hot.mean(0)
         mean_prob = probs.mean(0)
         aux_loss = E * jnp.sum(frac * mean_prob)
 
@@ -102,13 +115,9 @@ class MoEFeedForward(nn.Module):
 
         dispatch1 = positions(one_hot, jnp.zeros((E,), jnp.float32))
         if top_k == 2:
-            # second choice: argmax with the first masked out; its slots start
-            # after ALL first-choice claims on that expert (GShard ordering:
-            # first choices never lose capacity to second choices)
-            probs2 = probs * (1.0 - one_hot)
-            idx2 = jnp.argmax(probs2, axis=-1)
-            prob2 = jnp.take_along_axis(probs2, idx2[:, None], axis=-1)[:, 0]
-            one_hot2 = jax.nn.one_hot(idx2, E, dtype=jnp.float32)
+            # second-choice slots start after ALL first-choice claims on that
+            # expert (GShard ordering: first choices never lose capacity to
+            # second choices)
             dispatch2 = positions(one_hot2, one_hot.sum(0))
             # renormalised pair gates (Mixtral: softmax over the chosen two)
             denom = jnp.maximum(expert_prob + prob2, 1e-9)

@@ -159,8 +159,23 @@ def _splash_blocks(L: int, block_q: int, block_kv: int, head_dim: int):
 
     if not block_q and not block_kv:
         return None
-    bq = min(block_q or 512, L)
-    bkv = min(block_kv or 1024, L)
+    if block_q < 0 or block_kv < 0:
+        raise ValueError(
+            f"attn_block_q/attn_block_kv must be >= 0, got "
+            f"({block_q}, {block_kv})"
+        )
+
+    def rounded(b, name):
+        """Mosaic wants lane-aligned tiles: round a user block down to a
+        multiple of 128 (min 128) rather than failing deep in the kernel
+        with an opaque compile error."""
+        r = max(b // 128 * 128, 128)
+        if r != b:
+            logger.info("%s=%d rounded to %d (multiple of 128)", name, b, r)
+        return r
+
+    bq = min(rounded(block_q, "attn_block_q") if block_q else 512, L)
+    bkv = min(rounded(block_kv, "attn_block_kv") if block_kv else 1024, L)
 
     # clamp to the ~16 MB scoped-VMEM budget: the dkv kernel holds q/k/v/do
     # tiles plus fp32 [bq, bkv] score/dscore buffers; estimate with a 2x
@@ -170,12 +185,13 @@ def _splash_blocks(L: int, block_q: int, block_kv: int, head_dim: int):
         return 2 * (4 * head_dim * (q_ + 2 * kv_) + 8 * q_ * kv_)
 
     budget = 16 * 1024 * 1024
+    bq0, bkv0 = bq, bkv
     while est(bq, bkv) > budget and max(bq, bkv) > 128:
         if bkv >= bq:
-            bkv //= 2
+            bkv = max(bkv // 2 // 128 * 128, 128)
         else:
-            bq //= 2
-    if (bq, bkv) != (min(block_q or 512, L), min(block_kv or 1024, L)):
+            bq = max(bq // 2 // 128 * 128, 128)
+    if (bq, bkv) != (bq0, bkv0):
         logger.info("splash blocks clamped to (%d, %d) for head_dim %d",
                     bq, bkv, head_dim)
     return sk.BlockSizes(

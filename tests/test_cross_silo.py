@@ -210,8 +210,12 @@ def make_object_gateway():
     the HttpPayloadStore tests. Returns (httpd, blobs, puts)."""
     import http.server
 
+    import email.utils
+    import time as _time
+
     blobs = {}
     puts = []
+    mtimes = {}
 
     class Gateway(http.server.BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -223,6 +227,7 @@ def make_object_gateway():
         def do_PUT(self):
             n = int(self.headers.get("Content-Length", 0))
             blobs[self._key()] = self.rfile.read(n)
+            mtimes[self._key()] = _time.time()
             puts.append(self._key())
             self.send_response(201)
             self.end_headers()
@@ -239,7 +244,14 @@ def make_object_gateway():
             self.wfile.write(data)
 
         def do_HEAD(self):
-            self.send_response(200 if self._key() in blobs else 404)
+            # real object gateways report Last-Modified; HttpPayloadStore
+            # uses it to decide whether a dedup hit needs a TTL-refresh PUT
+            if self._key() in blobs:
+                self.send_response(200)
+                self.send_header("Last-Modified", email.utils.formatdate(
+                    mtimes.get(self._key(), _time.time()), usegmt=True))
+            else:
+                self.send_response(404)
             self.end_headers()
 
         def do_DELETE(self):
@@ -247,7 +259,9 @@ def make_object_gateway():
             self.send_response(204)
             self.end_headers()
 
-    return http.server.ThreadingHTTPServer(("127.0.0.1", 0), Gateway), blobs, puts
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Gateway)
+    httpd.mtimes = mtimes  # tests can age blobs to exercise TTL refresh
+    return httpd, blobs, puts
 
 
 class TestLivenessAndPayloadRef:
@@ -270,7 +284,7 @@ class TestLivenessAndPayloadRef:
         with pytest.raises(ValueError):
             store.put("../escape.npz", arrays)
 
-    def test_http_payload_store_against_object_gateway(self):
+    def test_http_payload_store_against_object_gateway(self, monkeypatch):
         """Object-store backend (reference: S3 remote_storage role): same
         PayloadStore contract over HTTP PUT/GET/DELETE, exercised against an
         in-process object gateway; put_dedup uploads a repeated payload once."""
@@ -300,6 +314,11 @@ class TestLivenessAndPayloadRef:
             k1 = store.put_dedup(arrays)
             k2 = store.put_dedup(arrays)
             assert k1 == k2 and puts.count(k1) == 1
+            # a near-expired blob is re-PUT on dedup hit so an in-flight
+            # reference never points at a gateway-lifecycle sweep target
+            httpd.mtimes[k1] -= store.dedup_refresh_age_s + 60
+            store.put_dedup(arrays)
+            assert puts.count(k1) == 2
             with pytest.raises(ValueError):
                 store.put("../escape", arrays)
             # missing blob and corrupt blob both surface as OSError (the
@@ -309,6 +328,16 @@ class TestLivenessAndPayloadRef:
             blobs["corrupt.npz"] = b"not an npz"
             with pytest.raises(OSError):
                 store.get("corrupt.npz")
+            # auth/timeout are reachable from the args surface (env token
+            # would win over the args one — isolate it)
+            monkeypatch.delenv("FEDML_TPU_PAYLOAD_TOKEN", raising=False)
+            auth = store_from_args(type("A", (), {
+                "payload_store_dir": url,
+                "payload_store_auth_token": "tok123",
+                "payload_store_timeout_s": 7,
+            })())
+            assert auth.headers["Authorization"] == "Bearer tok123"
+            assert auth.timeout_s == 7.0
         finally:
             httpd.shutdown()
 

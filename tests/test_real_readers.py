@@ -71,13 +71,14 @@ def test_stackoverflow_lr_reader(staged):
     assert ds.client_num == 3 and ds.task == "tagpred"
     V = ds.train_x.shape[-1]  # fixture vocab: 12 words
     assert V == 12
-    # "print the list": 3 known words → BoW sums to 1 (all tokens known)
+    # "print the list" + title "the list": all 5 tokens known → BoW sums to 1
     c0 = ds.train_x[0][: ds.train_counts[0]]
     sums = c0.sum(-1)
-    assert np.isclose(sums[1], 1.0)  # print/the/list all in vocab
-    # user_b's "the code zzzunknown data": 3/4 known → mass 0.75
+    assert np.isclose(sums[1], 1.0)
+    # user_b's "the code zzzunknown data" + title "python" (reference joins
+    # tokens + " " + title): 4/5 known → mass 0.8
     c1 = ds.train_x[1][: ds.train_counts[1]]
-    assert np.isclose(c1[0].sum(), 0.75)
+    assert np.isclose(c1[0].sum(), 0.8)
     # tags: fixture has 4 tags; "python|list" → two-hot
     t0 = ds.train_y[0][: ds.train_counts[0]]
     assert t0.shape[-1] == 4 and t0[0].sum() == 2.0

@@ -8,8 +8,14 @@ after changing the formats:  python tools/make_reader_fixtures.py
 
 import json
 import os
+import zlib
 
 import numpy as np
+
+
+def _seed(*parts) -> int:
+    """Stable cross-process seed (builtin hash() is salted for strings)."""
+    return zlib.crc32("/".join(map(str, parts)).encode()) % 2**31
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 FIX = os.path.join(HERE, "..", "tests", "fixtures")
@@ -32,20 +38,24 @@ def make_stackoverflow():
     clients = {
         "user_a": {
             "tokens": [b"how to fix the error", b"print the list"],
+            "title": [b"fix error", b"the list"],
             "tags": [b"python|list", b"python"],
         },
         "user_b": {
             "tokens": [b"the code zzzunknown data"],
+            "title": [b"python"],
             "tags": [b"file|mystery"],
         },
         "user_c": {
             "tokens": [b"loop the loop", b"data file error", b"to print"],
+            "title": [b"loop", b"data", b"print"],
             "tags": [b"loop", b"file", b"python|loop"],
         },
     }
     test_clients = {
         "user_t": {
             "tokens": [b"fix the code", b"the data loop"],
+            "title": [b"code", b"loop"],
             "tags": [b"python", b"loop"],
         },
     }
@@ -55,6 +65,7 @@ def make_stackoverflow():
             for cid, g in cc.items():
                 grp = h5.create_group(f"examples/{cid}")
                 grp.create_dataset("tokens", data=g["tokens"])
+                grp.create_dataset("title", data=g["title"])
                 grp.create_dataset("tags", data=g["tags"])
 
 
@@ -74,7 +85,7 @@ def make_imagenet():
             os.makedirs(d, exist_ok=True)
             for i in range(n):
                 _write_img(os.path.join(d, f"img_{i}.png"),
-                           seed=hash((split, ci, i)) % 2**31)
+                           seed=_seed(split, ci, i))
 
 
 def make_landmarks():
@@ -94,7 +105,7 @@ def make_landmarks():
                 f.write(f"{u},{im},{c}\n")
     for _, im, _ in rows_train + rows_test:
         _write_img(os.path.join(root, "images", im + ".jpg"),
-                   seed=hash(im) % 2**31)
+                   seed=_seed(im))
 
 
 if __name__ == "__main__":
