@@ -215,17 +215,23 @@ class Arguments:
 
     def parse_mesh_shape(self) -> Dict[str, int]:
         """Parse ``mesh_shape`` like ``"data:2,tensor:4"`` into an ordered dict."""
-        out: Dict[str, int] = {}
-        if not self.mesh_shape:
-            return out
-        for part in str(self.mesh_shape).split(","):
-            name, _, size = part.strip().partition(":")
-            if not name or not size or not (size.lstrip("-").isdigit()):
-                raise ValueError(
-                    f"bad mesh_shape entry {part!r}; expected 'axis:size'"
-                )
-            out[name] = int(size)
+        return parse_mesh_shape(self.mesh_shape)
+
+
+def parse_mesh_shape(value) -> Dict[str, int]:
+    """The one parser for ``"axis:size,..."`` mesh strings (Arguments method
+    and bare-namespace callers like ``cross_silo/fedllm.py`` share it)."""
+    out: Dict[str, int] = {}
+    if not value:
         return out
+    for part in str(value).split(","):
+        name, _, size = part.strip().partition(":")
+        if not name or not size or not (size.lstrip("-").isdigit()):
+            raise ValueError(
+                f"bad mesh_shape entry {part!r}; expected 'axis:size'"
+            )
+        out[name] = int(size)
+    return out
 
 
 def add_args() -> argparse.Namespace:

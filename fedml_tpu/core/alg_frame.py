@@ -83,7 +83,14 @@ class ClientTrainer(abc.ABC):
         pass
 
     def test(self, test_data, device, args):
-        return None
+        """Default eval: the shared jit'd pass over (x, y) test arrays.
+        Trainers with a ModelBundle-shaped ``self.model`` get this for free."""
+        if self.model is None or self.model_params is None:
+            return None
+        from ..ml.evaluate import make_eval_fn
+
+        x, y = test_data
+        return make_eval_fn(self.model)(self.model_params, x, y)
 
 
 class ServerAggregator(abc.ABC):

@@ -63,7 +63,9 @@ class FedMLCrossSiloClient:
         opt = str(getattr(args, "federated_optimizer", "FedAvg"))
 
         silo_devices = getattr(args, "silo_device_indices", None)
-        if silo_devices:
+        if silo_devices and not getattr(trainer, "silo_parallel", False):
+            # the FedLLM trainer meshes its silo chips itself; everything
+            # else gets the per-step-psum DP adapter
             from .process_group import SiloProcessGroup
             from .trainer_dist_adapter import TrainerDistAdapter
 

@@ -15,7 +15,6 @@ import jax
 import jax.numpy as jnp
 
 from ..core.alg_frame import ClientTrainer
-from .evaluate import make_eval_fn
 from .local_train import make_local_train_fn
 
 PyTree = Any
@@ -50,12 +49,15 @@ class ModelTrainer(ClientTrainer):
         self.model_params = params
         return {k: float(v) for k, v in metrics.items()}
 
-    def test(self, test_data, device, args):
-        x, y = test_data
-        return make_eval_fn(self.model)(self.model_params, x, y)
-
-
-def create_model_trainer(model, args) -> ModelTrainer:
+def create_model_trainer(model, args) -> ClientTrainer:
     """reference: trainer_creator.py:6-13 — dispatch on dataset/task; the
-    single JAX trainer already routes by ``model.task``."""
+    single JAX trainer already routes by ``model.task``. The Cheetah
+    transformer bundle routes to the FedLLM trainer, whose local steps run
+    sharded over the silo's mesh."""
+    from ..models.transformer_lm import TransformerBundle
+
+    if isinstance(model, TransformerBundle):
+        from ..cross_silo.fedllm import CheetahClientTrainer
+
+        return CheetahClientTrainer(model, args)
     return ModelTrainer(model, args)

@@ -82,6 +82,14 @@ def create(args, output_dim: int) -> ModelBundle:
     task = spec.task if spec else "classification"
     int_input = task in ("nwp", "seq_tagging", "span_extraction")
 
+    if name in ("cheetah", "llama", "cheetah_lm"):
+        # the flagship Cheetah transformer as a federated model (FedLLM):
+        # its own bundle type — local training runs mesh-sharded
+        # (cross_silo/fedllm.py), the FL planes see the ModelBundle surface
+        from .transformer_lm import create_transformer_bundle
+
+        return create_transformer_bundle(args, output_dim, spec)
+
     if name in ("lr", "logistic_regression"):
         module: nn.Module = LogisticRegression(output_dim)
     elif name in ("cnn", "cnn_dropout", "cnn_web"):

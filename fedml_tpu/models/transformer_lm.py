@@ -1,0 +1,88 @@
+"""The Cheetah transformer as a federated model-zoo citizen.
+
+This is where the two product pillars meet: the flagship LLM
+(``parallel/transformer.py``) packaged behind the same :class:`ModelBundle`
+surface the FL planes consume, so the cross-silo FSM, aggregators, and eval
+paths federate it like any zoo model — while its *local training* runs
+sharded over each silo's mesh (``cross_silo/fedllm.py``).
+
+reference: the Cheetah pillar is an empty stub (``python/fedml/distributed/``
+holds one empty ``__init__.py``) and ``model/model_hub.py:20-83`` has no
+transformer — federated LLM fine-tuning is exactly the capability gap this
+module closes. The reference's closest seam is ``create`` dispatch keyed on
+``args.model``; registering the flagship under ``model: "cheetah"`` keeps
+that UX.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.sharding import unbox
+from ..parallel.transformer import Transformer, TransformerConfig
+
+logger = logging.getLogger(__name__)
+
+
+class TransformerBundle:
+    """ModelBundle-shaped adapter over :class:`parallel.Transformer`.
+
+    Same duck-typed surface as :class:`models.ModelBundle` (``init`` /
+    ``apply`` / ``task`` / ``input_shape``): ``init`` returns UNBOXED params
+    (plain pytree — the FL planes flatten leaves onto the wire; partition
+    metadata is re-derived from the module by whichever mesh trains it), and
+    ``apply`` maps tokens [B, L] → logits [B, L, V] fp32, which is the
+    ``nwp`` task contract (logits at position t predict the target y[t], the
+    next token) — so ``ml/evaluate.make_eval_fn`` and ``ml/losses.nwp_loss``
+    work unchanged.
+    """
+
+    def __init__(self, cfg: TransformerConfig, name: str = "cheetah"):
+        self.cfg = cfg
+        self.module = Transformer(cfg)
+        self.name = name
+        self.task = "nwp"
+        self.input_shape = (cfg.max_seq_len,)
+        self.input_dtype = jnp.int32
+        self.meta = {"cfg": cfg}
+
+    def dummy_input(self, batch_size: int = 2):
+        return jnp.zeros((batch_size, 8), jnp.int32)
+
+    def init(self, rng: jax.Array, batch_size: int = 2):
+        variables = self.module.init(rng, self.dummy_input(batch_size))
+        return {"params": unbox(variables["params"])}
+
+    def apply(self, params, x, train: bool = False, rngs=None):
+        return self.module.apply(
+            {"params": params["params"]}, jnp.asarray(x, jnp.int32)
+        )
+
+    def param_count(self, params) -> int:
+        return sum(int(p.size) for p in jax.tree.leaves(params))
+
+
+def create_transformer_bundle(args, output_dim: int, spec=None) -> TransformerBundle:
+    """Build the federated transformer for ``(args, dataset)``.
+
+    Shape knobs ride the same YAML surface as the Cheetah runner
+    (``cheetah/runner.py:config_from_args`` — model_size / d_model / ... /
+    moe_* / attn_*); the DATASET owns the token space, so its vocab and
+    window length override the config's (an nwp dataset's ``output_dim`` is
+    its vocab).
+    """
+    from ..cheetah.runner import config_from_args
+
+    cfg = config_from_args(args)
+    vocab = int(getattr(spec, "vocab_size", 0) or 0) or int(output_dim)
+    seq_len = int(getattr(spec, "seq_len", 0) or 0) or cfg.max_seq_len
+    cfg = dataclasses.replace(cfg, vocab_size=vocab, max_seq_len=seq_len)
+    logger.info(
+        "transformer_lm: vocab=%d seq_len=%d d_model=%d layers=%d",
+        cfg.vocab_size, cfg.max_seq_len, cfg.d_model, cfg.n_layers,
+    )
+    return TransformerBundle(cfg)

@@ -156,20 +156,23 @@ class CheetahTrainer:
         variables = self.model.init(rng, dummy)
         return {"params": unbox(variables["params"])}
 
-    def init_state(self, rng: jax.Array) -> TrainState:
-        with self.mesh:
-            params = self._init_jit(rng)["params"]
-            opt_state = jax.jit(self.opt.init)(params)
-        # jit(opt.init) leaves scalar state (e.g. adam's count) on a single
-        # device; commit such leaves to the full mesh (replicated) so the
-        # train step sees one consistent device set (also post-restore)
-        opt_state = jax.tree.map(
+    def _commit_replicated(self, opt_state):
+        """jit(opt.init) leaves scalar state (e.g. adam's count) on a single
+        device; commit such leaves to the full mesh (replicated) so the
+        train step sees one consistent device set (also post-restore)."""
+        return jax.tree.map(
             lambda x: jax.device_put(x, self._repl)
             if isinstance(x, jax.Array)
             and len(x.sharding.device_set) < self.mesh.size
             else x,
             opt_state,
         )
+
+    def init_state(self, rng: jax.Array) -> TrainState:
+        with self.mesh:
+            params = self._init_jit(rng)["params"]
+            opt_state = jax.jit(self.opt.init)(params)
+        opt_state = self._commit_replicated(opt_state)
         n_params = sum(int(p.size) for p in jax.tree.leaves(params))
         logger.info(
             "cheetah init: %.1fM params over mesh %s",
@@ -177,6 +180,27 @@ class CheetahTrainer:
         )
         # step must be committed to the mesh (replicated) — a default-device
         # scalar breaks jit after checkpoint restore (mixed device sets)
+        step = jax.device_put(jnp.zeros((), jnp.int32), self._repl)
+        return TrainState(step=step, params=params, opt_state=opt_state)
+
+    def state_from_params(self, params: PyTree) -> TrainState:
+        """Fresh TrainState around externally-provided params.
+
+        The FedLLM seam (``cross_silo/fedllm.py``): each FL round re-inits
+        the local optimizer around the broadcast global params — matching the
+        reference's per-round torch optimizer construction in its trainers
+        (``ml/trainer/my_model_trainer_classification.py:30-45``). Host
+        (numpy) leaves are placed onto the mesh with this trainer's param
+        shardings, so a silo's local steps run fsdp/tp/sp-sharded no matter
+        where the global model came from.
+        """
+        with self.mesh:
+            params = jax.tree.map(
+                lambda p, s: jax.device_put(jnp.asarray(p), s),
+                params, self.param_shardings,
+            )
+            opt_state = jax.jit(self.opt.init)(params)
+        opt_state = self._commit_replicated(opt_state)
         step = jax.device_put(jnp.zeros((), jnp.int32), self._repl)
         return TrainState(step=step, params=params, opt_state=opt_state)
 

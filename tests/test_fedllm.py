@@ -1,0 +1,219 @@
+"""FedLLM: cross-silo federated fine-tuning of the Cheetah transformer.
+
+The pillar-meeting tests (reference gap: Cheetah is an empty stub at
+``python/fedml/distributed/`` and no transformer exists in
+``model/model_hub.py`` — FL-of-an-LLM is new capability, verified here
+against exact mathematical mirrors):
+
+- single-silo federation over the full FSM == the same Cheetah local steps
+  run centrally (bit-faithful through serialization, payload store, and
+  aggregation of one);
+- two-silo FedAvg with one SGD step == the hand-computed weighted average of
+  two independent sharded steps;
+- multi-round convergence over the payload store with compressed updates.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import fedml_tpu as fedml
+from fedml_tpu import data as data_mod
+from fedml_tpu import models as model_mod
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.cross_silo import FedMLCrossSiloClient, FedMLCrossSiloServer
+
+
+def make_args(run_id, **kw):
+    base = dict(
+        training_type="cross_silo", dataset="shakespeare", model="cheetah",
+        model_size="tiny", client_num_in_total=2, client_num_per_round=2,
+        comm_round=2, batch_size=8, learning_rate=0.05,
+        client_optimizer="adam", local_steps=3, backend="LOOPBACK",
+        run_id=run_id, frequency_of_the_test=1, random_seed=7,
+    )
+    base.update(kw)
+    return fedml.init(Arguments(overrides=base), should_init_logs=False)
+
+
+def run_world(run_id: str, n_clients: int = 2, **kw):
+    kw.setdefault("client_num_per_round", n_clients)
+    args_s = make_args(run_id, role="server", client_num_in_total=n_clients,
+                       **kw)
+    ds, od = data_mod.load(args_s)
+    bundle = model_mod.create(args_s, od)
+    server = FedMLCrossSiloServer(args_s, None, ds, bundle)
+    clients = []
+    for rank in range(1, n_clients + 1):
+        args_c = make_args(run_id, role="client", rank=rank,
+                           client_num_in_total=n_clients, **kw)
+        clients.append(FedMLCrossSiloClient(args_c, None, ds, bundle))
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    result = server.run()
+    for t in threads:
+        t.join(timeout=60)
+    for c in clients:
+        assert c.manager.done.is_set(), "client did not reach FINISH"
+    return result, server, clients
+
+
+def _windows(x, y):
+    # mirror of CheetahClientTrainer.train(): the packed x rows are the
+    # token windows; the Cheetah loss shifts internally
+    return np.asarray(x).astype(np.int32)
+
+
+def _mirror_local_round(trainer, params, shard, args, round_idx, client_id):
+    """Replicate CheetahClientTrainer.train()'s exact batch draws + steps."""
+    import jax.numpy as jnp
+
+    x, y, n = shard
+    tokens_all = _windows(x, y)
+    batch = int(args.batch_size)
+    steps = int(args.local_steps)
+    seed = (int(args.random_seed) * 1000003 + round_idx * 100003 + client_id)
+    rng = np.random.RandomState(seed & 0x7FFFFFFF)
+    state = trainer.state_from_params(params)
+    for _ in range(steps):
+        idx = rng.randint(0, max(int(n), 1), size=batch)
+        tok = tokens_all[idx]
+        mask = (tok != 0).astype(np.float32)
+        state, _ = trainer.train_step(state, jnp.asarray(tok), jnp.asarray(mask))
+    return state.params
+
+
+def test_cheetah_bundle_contract():
+    """models.create('cheetah') returns an FL-ready transformer bundle with
+    the dataset's token space."""
+    args = make_args("bundle1", role="server")
+    ds, od = data_mod.load(args)
+    bundle = model_mod.create(args, od)
+    assert bundle.task == "nwp" and bundle.cfg.vocab_size == 90
+    assert bundle.cfg.max_seq_len == 80  # shakespeare windows
+    params = bundle.init(jax.random.PRNGKey(0))
+    logits = bundle.apply(params, np.zeros((2, 80), np.int32))
+    assert logits.shape == (2, 80, 90)
+
+
+def test_fedllm_single_silo_matches_centralized_exactly():
+    """One silo over the full FSM == the identical Cheetah run done by hand:
+    round trips through npz serialization, the loopback wire, and
+    single-client aggregation must be value-faithful."""
+    from fedml_tpu.ml.optimizer import create_client_optimizer
+    from fedml_tpu.parallel.sharding import make_mesh
+    from fedml_tpu.parallel.train_step import CheetahTrainer
+
+    rounds = 2
+    result, server, clients = run_world(
+        "fedllm-parity1", n_clients=1, comm_round=rounds
+    )
+    args = make_args("fedllm-parity1-mirror", role="client", rank=1,
+                     client_num_in_total=1, client_num_per_round=1,
+                     comm_round=rounds)
+    ds, od = data_mod.load(args)
+    bundle = model_mod.create(args, od)
+    trainer = CheetahTrainer(
+        bundle.cfg, make_mesh(None),
+        optimizer=create_client_optimizer(args), accum_steps=1,
+    )
+    params = bundle.init(jax.random.PRNGKey(int(args.random_seed)))["params"]
+    shard = ds.client_shard(0)
+    for r in range(rounds):
+        # FSM: broadcast → local train → aggregate(1 client) == identity
+        params = _mirror_local_round(trainer, params, shard, args, r,
+                                     client_id=1)
+        params = jax.tree.map(lambda p: np.asarray(p), params)
+    fed_leaves = jax.tree.leaves(server.manager.global_params["params"])
+    mirror_leaves = jax.tree.leaves(params)
+    assert len(fed_leaves) == len(mirror_leaves)
+    for f, m in zip(fed_leaves, mirror_leaves):
+        np.testing.assert_allclose(np.asarray(f), np.asarray(m),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_fedllm_two_silos_equals_weighted_average():
+    """comm_round=1, one SGD step per silo: the federated result must equal
+    the sample-weighted average of two independent sharded local steps."""
+    kw = dict(comm_round=1, local_steps=1, client_optimizer="sgd",
+              learning_rate=0.1)
+    result, server, clients = run_world("fedllm-avg1", n_clients=2, **kw)
+
+    from fedml_tpu.ml.optimizer import create_client_optimizer
+    from fedml_tpu.parallel.sharding import make_mesh
+    from fedml_tpu.parallel.train_step import CheetahTrainer
+
+    args = make_args("fedllm-avg1-mirror", role="client", rank=1,
+                     client_num_in_total=2, **kw)
+    ds, od = data_mod.load(args)
+    bundle = model_mod.create(args, od)
+    trainer = CheetahTrainer(
+        bundle.cfg, make_mesh(None),
+        optimizer=create_client_optimizer(args), accum_steps=1,
+    )
+    g0 = bundle.init(jax.random.PRNGKey(int(args.random_seed)))["params"]
+    locals_ = []
+    weights = []
+    for ci in range(2):
+        shard = ds.client_shard(ci)
+        locals_.append(_mirror_local_round(
+            trainer, g0, shard, args, 0, client_id=ci + 1))
+        weights.append(float(shard[2]))
+    w = np.asarray(weights) / sum(weights)
+    expect = jax.tree.map(
+        lambda a, b: w[0] * np.asarray(a, np.float64)
+        + w[1] * np.asarray(b, np.float64),
+        locals_[0], locals_[1],
+    )
+    for f, m in zip(jax.tree.leaves(server.manager.global_params["params"]),
+                    jax.tree.leaves(expect)):
+        np.testing.assert_allclose(np.asarray(f, np.float64), m,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_fedllm_converges_over_payload_store(tmp_path):
+    """Multi-round federation with bulk weights riding the payload store
+    (GB-scale product path): the control channel stays control-sized and
+    the federated LM beats the uniform-predictor loss floor."""
+    from fedml_tpu.core.distributed.loopback import LoopbackCommManager
+
+    sizes = []
+    orig = LoopbackCommManager.send_message
+
+    def spy(self, msg):
+        sizes.append(len(msg.serialize()))
+        return orig(self, msg)
+
+    LoopbackCommManager.send_message = spy
+    try:
+        result, server, clients = run_world(
+            "fedllm-store1", n_clients=2, comm_round=3, local_steps=20,
+            payload_store_dir=str(tmp_path), payload_inline_limit_bytes=1024,
+        )
+    finally:
+        LoopbackCommManager.send_message = orig
+    assert result is not None
+    # uniform over vocab 90 → CE = ln(90) = 4.4998; the Markov-chain corpus
+    # is learnable, so even 3 rounds must land clearly below the floor
+    assert result["test_loss"] < 4.3, result
+    # the ~0.9M-param model never rode the control channel
+    assert max(sizes) < 16 * 1024, f"bulk payload leaked: {max(sizes)}"
+
+
+def test_fedllm_sharded_silo_mesh():
+    """A silo whose local step is genuinely multi-device: fsdp×tensor mesh
+    over the virtual CPU devices; federation result stays finite and the
+    trainer reports the sharded mesh."""
+    result, server, clients = run_world(
+        "fedllm-mesh1", n_clients=1, comm_round=1, local_steps=2,
+        mesh_shape="fsdp:4,tensor:2",
+    )
+    tr = clients[0].manager.trainer
+    assert dict(tr.mesh.shape)["fsdp"] == 4
+    assert dict(tr.mesh.shape)["tensor"] == 2
+    assert np.isfinite(result["test_loss"])
