@@ -11,11 +11,16 @@ Two measurements, one JSON line:
    (``model/cv/resnet.py:257`` — it ships no resnet20).
 
 2. **Cheetah tokens/sec/chip + MFU** (north star #2): single-chip pretraining
-   of the flagship decoder-only transformer (~500M params: d2048 x 8L, GQA
-   4q/2kv head_dim 512, seq 2048, bf16, splash attention, chunked fused CE;
-   a remat ladder falls back only if no-remat doesn't fit). MFU = achieved
-   model FLOPs/s over chip peak bf16 FLOPs/s, with model FLOPs per token =
-   6·N + 12·L·layers·d_model (PaLM appendix B convention).
+   of the flagship decoder-only transformer (~490M params: d2048 x 8L, GQA
+   16q/4kv — Llama-standard head_dim 128 — seq 2048, bf16, native-GQA splash
+   attention with (512, 512) blocks, chunked fused CE; a remat ladder falls
+   back only if no-remat doesn't fit). MFU = achieved model FLOPs/s over
+   chip peak bf16 FLOPs/s, with model FLOPs per token = 6·N +
+   12·L·layers·d_model (PaLM appendix B convention). Three secondary shapes
+   ride along, each in its own subprocess: the r2 wide-head hd512 flagship,
+   the remat-on rung (d2048 x 24L, full-block remat — the regime every
+   7B-class run lives in; no-remat OOMs there), and the MoE flagship
+   (8 experts, top-2, MFU on ACTIVE FLOPs).
 
 The headline line is the FedAvg metric (reference-comparable); the Cheetah
 numbers ride along as extra keys so every round's BENCH_r{N}.json records
@@ -235,12 +240,16 @@ def bench_cheetah() -> dict:
 
 
 def main() -> None:
-    # subprocess measurement FIRST — before this process owns the TPU
-    hd512 = {}
-    try:
-        hd512 = bench_cheetah_hd512()
-    except Exception as e:
-        hd512 = {"cheetah_hd512_error": f"{type(e).__name__}: {e}"}
+    # subprocess measurements FIRST — before this process owns the TPU
+    extra = {}
+    for prefix, fn in (("cheetah_hd512", bench_cheetah_hd512),
+                       ("cheetah_remat", bench_cheetah_remat),
+                       ("cheetah_moe", bench_cheetah_moe)):
+        try:
+            extra.update(fn())
+        except Exception as e:
+            # same key scheme as _mfu_subprocess's non-zero-exit path
+            extra[f"{prefix}_error"] = f"{type(e).__name__}: {e}"
     fed = bench_fedavg()
     value = fed["rounds_per_sec"]
     ref = _ref_rounds_per_sec()
@@ -259,13 +268,12 @@ def main() -> None:
         line.update(bench_cheetah())
     except Exception as e:  # cheetah bench must never hide the headline
         line["cheetah_error"] = f"{type(e).__name__}: {e}"
-    line.update(hd512)
+    line.update(extra)
     print(json.dumps(line))
 
 
-def bench_cheetah_hd512() -> dict:
-    """Secondary shape (the r2 wide-head flagship, GQA 4q/2kv hd512) so both
-    datapoints stay measured round over round.
+def _mfu_subprocess(cfg: dict, prefix: str) -> dict:
+    """One mfu_sweep child measurement → {prefix_mfu, prefix_tok_s}.
 
     Runs as a SUBPROCESS and must be called BEFORE this process touches the
     TPU: stock libtpu grants exclusive per-process device ownership, so a
@@ -275,32 +283,72 @@ def bench_cheetah_hd512() -> dict:
     import subprocess
     import sys
 
-    cfg = dict(
-        vocab_size=32000, d_model=2048, n_layers=8, n_heads=4,
-        n_kv_heads=2, d_ff=5632, max_seq_len=2048, remat=False,
-        remat_policy="full", attn_impl="auto", batch=8, seq=2048,
-        steps=10, loss_chunk=256, mu_bf16=True,
-        attn_block_q=512, attn_block_kv=512,  # clamped; 79.4% measured
-    )
     env = dict(os.environ)
     env["PYTHONPATH"] = HERE + os.pathsep + env.get("PYTHONPATH", "")
     p = subprocess.run(
         [sys.executable, os.path.join(HERE, "tools", "mfu_sweep.py"),
          "--one", json.dumps(cfg)],
-        capture_output=True, text=True, timeout=600, env=env,
+        capture_output=True, text=True, timeout=900, env=env,
     )
     out = (p.stdout.strip().splitlines() or ["<no output>"])[-1]
     if p.returncode != 0:
         err = (p.stderr.strip().splitlines() or [""])[-1]
-        return {"cheetah_hd512_error":
-                f"rc={p.returncode} {out[:120]} {err[:200]}"}
+        return {f"{prefix}_error": f"rc={p.returncode} {out[:120]} {err[:200]}"}
     alt = json.loads(out)
     if "skipped" in alt:  # CPU-only host: the child declined the TPU shape
         return {}
-    return {
-        "cheetah_hd512_mfu": alt["mfu"],
-        "cheetah_hd512_tokens_per_sec_per_chip": alt["tok_s"],
+    res = {
+        f"{prefix}_mfu": alt["mfu"],
+        f"{prefix}_tokens_per_sec_per_chip": alt["tok_s"],
     }
+    if "params_active_m" in alt:
+        res[f"{prefix}_params_active_m"] = alt["params_active_m"]
+        res[f"{prefix}_params_total_m"] = alt["params_m"]
+    return res
+
+
+def bench_cheetah_hd512() -> dict:
+    """Secondary shape (the r2 wide-head flagship, GQA 4q/2kv hd512) so both
+    datapoints stay measured round over round."""
+    return _mfu_subprocess(dict(
+        vocab_size=32000, d_model=2048, n_layers=8, n_heads=4,
+        n_kv_heads=2, d_ff=5632, max_seq_len=2048, remat=False,
+        remat_policy="full", attn_impl="auto", batch=8, seq=2048,
+        steps=10, loss_chunk=256, mu_bf16=True,
+        attn_block_q=512, attn_block_kv=512,  # clamped; 79.4% measured
+    ), "cheetah_hd512")
+
+
+def bench_cheetah_remat() -> dict:
+    """The remat-on MFU rung (VERDICT r3 next #3): d2048 x 24L (1.21B — the
+    flagship deepened past the no-remat HBM wall; 24L no-remat OOMs at
+    bs8/seq2048, measured) with remat_policy="full". This is the regime
+    every 7B-class run lives in; the headline's no-remat number says
+    nothing about it. "full" (save block inputs only) is the policy that
+    wins here — measured, "dots" SAVES every matmul output and needs MORE
+    HBM than no-remat once splash attention keeps scores out of HBM
+    (16L dots OOMs at 19.5 GiB while 16L no-remat fits in 13)."""
+    return _mfu_subprocess(dict(
+        vocab_size=32000, d_model=2048, n_layers=24, n_heads=16,
+        n_kv_heads=4, d_ff=5632, max_seq_len=2048, remat=True,
+        remat_policy="full", attn_impl="auto", batch=8, seq=2048,
+        steps=8, loss_chunk=256, mu_bf16=True,
+        attn_block_q=512, attn_block_kv=512,
+    ), "cheetah_remat")
+
+
+def bench_cheetah_moe() -> dict:
+    """MoE flagship (VERDICT r3 next #4): 8 experts, top-2, scatter/gather
+    dispatch (parallel/moe.py). MFU is reported on ACTIVE FLOPs (top_k/E of
+    expert FFN params per token — the standard MoE convention)."""
+    return _mfu_subprocess(dict(
+        vocab_size=32000, d_model=2048, n_layers=4, n_heads=16,
+        n_kv_heads=4, d_ff=2816, max_seq_len=2048, remat=True,
+        remat_policy="full", attn_impl="auto", batch=8, seq=2048,
+        steps=8, loss_chunk=256, mu_bf16=True,
+        attn_block_q=512, attn_block_kv=512,
+        moe_experts=8, moe_top_k=2, moe_capacity_factor=1.25,
+    ), "cheetah_moe")
 
 
 if __name__ == "__main__":

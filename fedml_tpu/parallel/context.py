@@ -25,21 +25,54 @@ class SeqParallelCtx:
     size: int
 
 
-_ACTIVE: Optional[SeqParallelCtx] = None
+# contextvars, not module globals: FL runtimes trace models from several
+# FSM threads at once (one per silo client in-process), and one thread's
+# parallelism context must never leak into another thread's trace
+import contextvars
+
+_ACTIVE: contextvars.ContextVar[Optional[SeqParallelCtx]] = (
+    contextvars.ContextVar("fedml_tpu_seq_ctx", default=None)
+)
 
 
 @contextlib.contextmanager
 def sequence_parallelism(mesh: Mesh, axis_name: str = constants.MESH_AXIS_SEQUENCE):
     """Activate sequence parallelism for model traces inside the block."""
-    global _ACTIVE
     size = int(mesh.shape[axis_name]) if axis_name in mesh.axis_names else 1
-    prev = _ACTIVE
-    _ACTIVE = SeqParallelCtx(mesh, axis_name, size) if size > 1 else None
+    token = _ACTIVE.set(
+        SeqParallelCtx(mesh, axis_name, size) if size > 1 else None
+    )
     try:
-        yield _ACTIVE
+        yield _ACTIVE.get()
     finally:
-        _ACTIVE = prev
+        _ACTIVE.reset(token)
 
 
 def get_seq_context() -> Optional[SeqParallelCtx]:
-    return _ACTIVE
+    return _ACTIVE.get()
+
+
+# -- ambient mesh (batch/tensor sharding) ------------------------------------
+# Pallas kernels cannot be auto-partitioned by pjit ("Mosaic kernels cannot
+# be automatically partitioned") — the attention kernels must be wrapped in
+# shard_map over whatever mesh the step is jitted under. Same pattern as the
+# sequence context: CheetahTrainer scopes its mesh here around tracing, and
+# the Attention module reads it at trace time.
+
+_MESH: "contextvars.ContextVar[Optional[Mesh]]" = (
+    contextvars.ContextVar("fedml_tpu_mesh_ctx", default=None)
+)
+
+
+@contextlib.contextmanager
+def mesh_context(mesh: Optional[Mesh]):
+    """Scope the ambient mesh for model traces inside the block."""
+    token = _MESH.set(mesh if mesh is not None and mesh.size > 1 else None)
+    try:
+        yield _MESH.get()
+    finally:
+        _MESH.reset(token)
+
+
+def get_mesh_context() -> Optional[Mesh]:
+    return _MESH.get()

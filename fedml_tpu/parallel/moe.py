@@ -8,10 +8,12 @@ constant only). Switch-Transformer-style design, TPU-native:
   ``cfg.moe_top_k=2``) experts per token, with the Switch load-balancing
   auxiliary loss; top-2 gates renormalised over the chosen pair, second
   choices fill whatever capacity first choices left
-- dense capacity-factor dispatch (GShard): tokens route into a
-  [E, capacity, D] buffer via one einsum with a one-hot dispatch mask —
-  static shapes, no ragged scatter, MXU end to end; over-capacity tokens
-  drop (pass through the residual unchanged)
+- capacity-factor dispatch (GShard semantics) via static-shape
+  scatter/gather: each token computes its expert slot with an O(T·E)
+  cumsum and scatter-adds into the [E, capacity, D] buffer (unique
+  destinations — no collisions), combine is a gather; over-capacity tokens
+  drop (pass through the residual unchanged). The r3 one-hot dispatch
+  einsum was O(T·E·C) memory and could not allocate at flagship scale.
 - expert FFNs are ONE stacked param tree [E, ...] vmapped over the expert
   axis; the logical ``expert`` axis maps to the ``expert`` mesh axis
   (sharding.LOGICAL_RULES), so under pjit the dispatch/combine einsums
@@ -101,40 +103,38 @@ class MoEFeedForward(nn.Module):
         mean_prob = probs.mean(0)
         aux_loss = E * jnp.sum(frac * mean_prob)
 
-        def positions(oh, offset_per_expert):
-            """Per-token slot index within its expert's capacity buffer."""
-            pos_in = (jnp.cumsum(oh, axis=0) - 1.0) * oh  # [T, E]
+        def slots(oh, idx, offset_per_expert):
+            """Per-token capacity slot + keep mask (GShard ordering), without
+            materialising any [T, E, C] tensor: the r3 one-hot dispatch
+            einsum was O(T·E·C) memory (≈10 GB fp32 at the flagship's
+            T=16k, E=8 — it cannot even allocate single-chip), while the
+            cumsum here is O(T·E) and the buffers O(E·C·D)."""
+            pos_in = jnp.cumsum(oh, axis=0) - oh  # prior same-expert tokens
             off = jnp.sum(oh * offset_per_expert[None, :], axis=-1)
-            pos = (jnp.sum(pos_in, axis=-1) + off).astype(jnp.int32)
-            keep = (pos < capacity).astype(jnp.float32)
-            return (
-                oh[:, :, None]
-                * jax.nn.one_hot(pos, capacity, dtype=jnp.float32)[:, None, :]
-                * keep[:, None, None]
-            )  # [T, E, C]
+            pos = (jnp.sum(pos_in * oh, axis=-1) + off).astype(jnp.int32)
+            keep = pos < capacity
+            # flat destination in the [E*C] buffer; dropped tokens write the
+            # sentinel row E*C (sliced off below)
+            dst = jnp.where(keep, idx * capacity + pos, E * capacity)
+            return dst, keep
 
-        dispatch1 = positions(one_hot, jnp.zeros((E,), jnp.float32))
+        dst1, keep1 = slots(one_hot, expert_idx, jnp.zeros((E,), jnp.float32))
+        xt_c = xt.astype(cfg.dtype)
+        # scatter dispatch: destinations are unique across choices (GShard
+        # ordering — second-choice slots start after ALL first-choice claims
+        # on that expert), so the adds never collide
+        buf = jnp.zeros((E * capacity + 1, D), cfg.dtype).at[dst1].add(xt_c)
         if top_k == 2:
-            # second-choice slots start after ALL first-choice claims on that
-            # expert (GShard ordering: first choices never lose capacity to
-            # second choices)
-            dispatch2 = positions(one_hot2, one_hot.sum(0))
+            dst2, keep2 = slots(one_hot2, idx2, one_hot.sum(0))
+            buf = buf.at[dst2].add(xt_c)
             # renormalised pair gates (Mixtral: softmax over the chosen two)
             denom = jnp.maximum(expert_prob + prob2, 1e-9)
-            gate1 = expert_prob / denom
-            gate2 = prob2 / denom
-            dispatch = dispatch1 + dispatch2
-            combine = (
-                dispatch1 * gate1[:, None, None]
-                + dispatch2 * gate2[:, None, None]
-            )
+            gate1 = (expert_prob / denom) * keep1
+            gate2 = (prob2 / denom) * keep2
         else:
-            dispatch = dispatch1
-            combine = dispatch1 * expert_prob[:, None, None]
-
-        expert_in = jnp.einsum(
-            "tec,td->ecd", dispatch, xt.astype(jnp.float32)
-        ).astype(cfg.dtype)
+            gate1 = expert_prob * keep1
+            gate2 = None
+        expert_in = buf[: E * capacity].reshape(E, capacity, D)
 
         def ffn(gu_w, down_w, h):
             gu = jnp.einsum("cd,df->cf", h, gu_w.astype(cfg.dtype))
@@ -145,9 +145,15 @@ class MoEFeedForward(nn.Module):
 
         expert_out = jax.vmap(ffn)(w_gate_up, w_down, expert_in)  # [E, C, D]
 
-        # combine, scaled by the (re)normalised router gates; dropped tokens
+        # combine: gather each token's slot back, scaled by the
+        # (re)normalised router gates; dropped tokens (gate masked to 0)
         # contribute nothing and pass through the residual unchanged
-        y = jnp.einsum(
-            "tec,ecd->td", combine, expert_out.astype(jnp.float32)
-        ).astype(cfg.dtype)
+        flat_out = jnp.concatenate(
+            [expert_out.reshape(E * capacity, D),
+             jnp.zeros((1, D), expert_out.dtype)], axis=0
+        )
+        y32 = flat_out[dst1].astype(jnp.float32) * gate1[:, None]
+        if gate2 is not None:
+            y32 = y32 + flat_out[dst2].astype(jnp.float32) * gate2[:, None]
+        y = y32.astype(cfg.dtype)
         return y.reshape(B, L, D), aux_loss

@@ -35,22 +35,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map as _shard_map_raw
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map as _shard_map_raw
-
-
-def shard_map(fn, mesh, in_specs, out_specs):
-    """shard_map across jax versions (check_rep/check_vma kwarg churn)."""
-    for kw in ({"check_vma": False}, {"check_rep": False}, {}):
-        try:
-            return _shard_map_raw(
-                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
-            )
-        except TypeError:
-            continue
-    raise RuntimeError("no compatible shard_map signature")
+from .sharding import compat_shard_map as shard_map
 
 from .. import constants
 from .transformer import (

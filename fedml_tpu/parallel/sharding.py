@@ -86,6 +86,37 @@ def make_mesh(
     return Mesh(dev_array, axis_names=tuple(full.keys()))
 
 
+def compat_shard_map(fn, mesh, in_specs, out_specs):
+    """shard_map across jax versions (check_rep/check_vma kwarg churn).
+
+    The single compat point — pipeline, attention kernels, and ring
+    attention all wrap through here so a jax upgrade breaks zero or all of
+    them, never one.
+    """
+    try:
+        from jax import shard_map as _sm
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map as _sm
+    for kw in ({"check_vma": False}, {"check_rep": False}, {}):
+        try:
+            return _sm(fn, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, **kw)
+        except TypeError:
+            continue
+    raise RuntimeError("no compatible shard_map signature")
+
+
+def batch_mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """The mesh axes that shard the batch dimension of activations — the
+    canonical layout every constraint/kernel wrap must agree on."""
+    from .. import constants as _c
+
+    return tuple(
+        a for a in (_c.MESH_AXIS_DATA, _c.MESH_AXIS_FSDP)
+        if int(mesh.shape.get(a, 1)) > 1
+    )
+
+
 def logical_to_mesh_spec(logical_axes: Tuple) -> P:
     rules = dict(LOGICAL_RULES)
     return P(*(rules.get(a) if a is not None else None for a in logical_axes))

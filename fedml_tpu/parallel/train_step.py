@@ -194,11 +194,21 @@ class CheetahTrainer:
         shardings, so a silo's local steps run fsdp/tp/sp-sharded no matter
         where the global model came from.
         """
+        def fresh(p, s):
+            # train_step donates its state: device_put may ALIAS a
+            # caller-owned jax array (same-sharding fast path, and even a
+            # host->replicated put can reuse the source buffer as one
+            # replica — observed: a replicated [128] norm weight deleted
+            # under a silo's second round), and donation then deletes the
+            # caller's array. Sharding-equivalence guards are not a reliable
+            # aliasing oracle, so jax.Array inputs are always copied; numpy
+            # inputs copy on transfer anyway.
+            if isinstance(p, jax.Array):
+                p = jnp.array(p, copy=True)
+            return jax.device_put(jnp.asarray(p), s)
+
         with self.mesh:
-            params = jax.tree.map(
-                lambda p, s: jax.device_put(jnp.asarray(p), s),
-                params, self.param_shardings,
-            )
+            params = jax.tree.map(fresh, params, self.param_shardings)
             opt_state = jax.jit(self.opt.init)(params)
         opt_state = self._commit_replicated(opt_state)
         step = jax.device_put(jnp.zeros((), jnp.int32), self._repl)
@@ -283,11 +293,16 @@ class CheetahTrainer:
         return jax.device_put(tokens, shard), jax.device_put(mask, shard)
 
     def train_step(self, state: TrainState, tokens, mask) -> Tuple[TrainState, dict]:
+        from .context import mesh_context
+
         tokens, mask = self.shard_batch(tokens, mask)
         if self.seq_sharded:
             from .context import sequence_parallelism
 
-            with self.mesh, sequence_parallelism(self.mesh):
+            with self.mesh, mesh_context(self.mesh), \
+                    sequence_parallelism(self.mesh):
                 return self._step_jit(state, tokens, mask)
-        with self.mesh:
+        # mesh context lets the attention kernels shard_map themselves
+        # (Mosaic kernels cannot be auto-partitioned by pjit)
+        with self.mesh, mesh_context(self.mesh):
             return self._step_jit(state, tokens, mask)

@@ -61,6 +61,23 @@ def run_one(cfg: dict) -> None:
     )
     state = tr.init_state(jax.random.PRNGKey(0))
     n_params = sum(int(p.size) for p in jax.tree.leaves(state.params))
+    # MoE: FLOPs follow ACTIVE params — each token visits top_k of E
+    # experts, so expert FFN weights count at top_k/E (standard MoE MFU
+    # convention); router/attention/embed count fully
+    n_active = n_params
+    if tc.moe_experts > 1:
+        import jax.tree_util as jtu
+
+        expert_params = sum(
+            int(leaf.size)
+            for path, leaf in jtu.tree_flatten_with_path(state.params)[0]
+            if any("MoEFeedForward" in str(getattr(k, "key", k)) for k in path)
+            and any(str(getattr(k, "key", k)) in ("w_gate_up", "w_down")
+                    for k in path)
+        )
+        top_k = int(getattr(tc, "moe_top_k", 1))
+        n_active = n_params - expert_params \
+            + expert_params * top_k // tc.moe_experts
     rng = np.random.RandomState(0)
     tok = jnp.asarray(rng.randint(0, tc.vocab_size, (B, L)).astype(np.int32))
     mask = jnp.ones((B, L), jnp.int32)
@@ -79,18 +96,22 @@ def run_one(cfg: dict) -> None:
             state, m = tr._step_jit(state, tok_d, mask_d)
         float(np.asarray(m["loss"]))
         dt = (time.perf_counter() - t0) / steps
-    fpt = 6.0 * n_params + 12.0 * L * tc.n_layers * tc.d_model
+    fpt = 6.0 * n_active + 12.0 * L * tc.n_layers * tc.d_model
     n_chips = jax.device_count()
     tps = B * L / dt / n_chips  # per chip (mesh spans all local devices)
     sys.path.insert(0, REPO)
     from bench import TPU_PEAK_FLOPS
 
     peak = TPU_PEAK_FLOPS.get(jax.devices()[0].device_kind, 197e12)
-    print(json.dumps({
-        "step_s": round(dt, 3), "tok_s": round(tps), "params_m": round(n_params / 1e6, 1),
+    line = {
+        "step_s": round(dt, 3), "tok_s": round(tps),
+        "params_m": round(n_params / 1e6, 1),
         "n_chips": n_chips,
         "mfu": round(tps * fpt / peak, 4),
-    }))
+    }
+    if n_active != n_params:
+        line["params_active_m"] = round(n_active / 1e6, 1)
+    print(json.dumps(line))
 
 
 def main() -> None:
