@@ -40,32 +40,38 @@ def _parse_bool(v) -> bool:
 def config_from_args(args) -> TransformerConfig:
     size = str(getattr(args, "model_size", "tiny")).lower()
     if size in ("7b", "llama2_7b"):
-        return TransformerConfig.llama2_7b()
-    if size == "tiny":
-        return TransformerConfig.tiny(
+        cfg = TransformerConfig.llama2_7b()
+    elif size == "tiny":
+        cfg = TransformerConfig.tiny(
             vocab_size=int(getattr(args, "vocab_size", 256))
         )
+    else:
+        cfg = TransformerConfig(
+            vocab_size=int(getattr(args, "vocab_size", 32000)),
+            d_model=int(getattr(args, "d_model", 1024)),
+            n_layers=int(getattr(args, "n_layers", 8)),
+            n_heads=int(getattr(args, "n_heads", 8)),
+            n_kv_heads=int(getattr(args, "n_kv_heads", 8)),
+            d_ff=int(getattr(args, "d_ff", 2816)),
+            max_seq_len=int(getattr(args, "seq_len", 1024)),
+        )
     # knobs beyond the shape: splash kernel blocks (the hd128 MFU lever —
-    # tools/mfu_sweep.py), MoE routing, remat — all YAML-reachable. Only
-    # keys the config actually carries are passed through, so the
+    # tools/mfu_sweep.py), MoE routing, remat, positional scheme — all
+    # YAML-reachable, applied to EVERY size (the one place the args→config
+    # mapping lives; bundle factories must not re-plumb knobs). Only keys
+    # the config actually carries are passed through, so the
     # TransformerConfig dataclass defaults stay the single source of truth.
+    import dataclasses as _dc
+
     extra = {}
     for name, cast in (("attn_block_q", int), ("attn_block_kv", int),
                        ("moe_experts", int), ("moe_top_k", int),
                        ("moe_capacity_factor", float),
-                       ("remat", _parse_bool), ("remat_policy", str)):
-        if hasattr(args, name):
+                       ("remat", _parse_bool), ("remat_policy", str),
+                       ("pos_emb", str)):
+        if getattr(args, name, None) is not None:
             extra[name] = cast(getattr(args, name))
-    return TransformerConfig(
-        vocab_size=int(getattr(args, "vocab_size", 32000)),
-        d_model=int(getattr(args, "d_model", 1024)),
-        n_layers=int(getattr(args, "n_layers", 8)),
-        n_heads=int(getattr(args, "n_heads", 8)),
-        n_kv_heads=int(getattr(args, "n_kv_heads", 8)),
-        d_ff=int(getattr(args, "d_ff", 2816)),
-        max_seq_len=int(getattr(args, "seq_len", 1024)),
-        **extra,
-    )
+    return _dc.replace(cfg, **extra) if extra else cfg
 
 
 class CheetahRunner:

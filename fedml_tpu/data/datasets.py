@@ -97,6 +97,12 @@ REGISTRY = {
     "coco128_det": DatasetSpec(
         "coco128_det", (32, 32, 3), 6, "detection", 8, 40, 160
     ),
+    # real-resolution detection (reference trains YOLOv5 at 640px on
+    # coco128): 224px images through the native host pipeline + a deeper
+    # CenterNet — row 75's "32x32 toy" objection closes here
+    "fedcv_det224": DatasetSpec(
+        "fedcv_det224", (224, 224, 3), 6, "detection", 4, 16, 32
+    ),
     # Healthcare / FLamby family (reference: python/app/healthcare/*) —
     # tabular & imaging tasks mapped onto their natural task types
     "fed_heart_disease": DatasetSpec(
@@ -446,13 +452,18 @@ def synth_detection(spec: DatasetSpec, n_train: int, n_test: int, seed: int):
     Hs, Ws = H // 4, W // 4
     protos = rng.rand(C, 3).astype(np.float32) * 2 - 1
 
+    # rectangle sizes scale with resolution (32px keeps the original 6-14px
+    # range; 224px draws 14-56px objects)
+    lo = max(H // 16, 6)
+    hi = max(H // 4, 14)
+
     def make(n, rng):
         x = rng.randn(n, H, W, 3).astype(np.float32) * 0.3
         y = np.zeros((n, Hs, Ws, C + 3), np.float32)
         for i in range(n):
             for _ in range(rng.randint(1, 4)):
                 c = rng.randint(0, C)
-                dh, dw = rng.randint(6, 14), rng.randint(6, 14)
+                dh, dw = rng.randint(lo, hi), rng.randint(lo, hi)
                 h0 = rng.randint(0, H - dh)
                 w0 = rng.randint(0, W - dw)
                 x[i, h0:h0 + dh, w0:w0 + dw] += protos[c]

@@ -82,6 +82,16 @@ def create(args, output_dim: int) -> ModelBundle:
     task = spec.task if spec else "classification"
     int_input = task in ("nwp", "seq_tagging", "span_extraction")
 
+    if name in ("cheetah_tagger", "cheetah_span"):
+        # FedNLP heads on the REAL Cheetah backbone (row 75 scale path):
+        # same transformer as the flagship, task head on hidden states
+        from .transformer_heads import create_head_bundle
+
+        return create_head_bundle(
+            args, output_dim, spec,
+            "tagger" if name == "cheetah_tagger" else "span",
+        )
+
     if name in ("cheetah", "llama", "cheetah_lm"):
         # the flagship Cheetah transformer as a federated model (FedLLM):
         # its own bundle type — local training runs mesh-sharded
@@ -131,10 +141,14 @@ def create(args, output_dim: int) -> ModelBundle:
         module = DartsNetwork(output_dim)
     elif name in ("centernet", "centernet_lite", "yolo", "detector"):
         # FedCV detection (reference: app/fedcv/object_detection) —
-        # dense anchor-free head, see models/detection.py
+        # dense anchor-free head, see models/detection.py; real-resolution
+        # inputs (>=128px) get a deeper feature stack
         from .detection import CenterNetLite
 
-        module = CenterNetLite(num_classes=output_dim)
+        widths = (
+            (32, 64, 128, 128) if sample_shape[0] >= 128 else (32, 64, 64)
+        )
+        module = CenterNetLite(num_classes=output_dim, widths=widths)
     elif name in ("transformer", "tiny_transformer", "transformer_lm",
                   "bilstm_tagger", "tagger", "span_extractor", "bilstm_span"):
         # FedNLP zoo (reference: app/fednlp/{seq_tagging,span_extraction,

@@ -159,7 +159,14 @@ class BatchPrefetcher:
     def __init__(self, x: np.ndarray, y: np.ndarray, batch_size: int,
                  seed: int = 0, threads: int = 4, depth: int = 3):
         self.x = np.ascontiguousarray(x, dtype=np.float32)
-        self.y = np.ascontiguousarray(y, dtype=np.int32)
+        # y rows move as opaque 4-byte elements through the C++ gather, so
+        # float32 targets (detection grids, regression) ride BIT-EXACT via
+        # an int32 view — no native change, no precision loss
+        y = np.asarray(y)
+        self._y_dtype = (
+            np.float32 if np.issubdtype(y.dtype, np.floating) else np.int32
+        )
+        self.y = np.ascontiguousarray(y, dtype=self._y_dtype)
         self.batch = int(batch_size)
         self._lib = get_lib()
         self._handle = None
@@ -167,8 +174,9 @@ class BatchPrefetcher:
         self._yrow = int(np.prod(self.y.shape[1:], dtype=np.int64)) or 1
         if self._lib is not None:
             self._handle = self._lib.prefetcher_create(
-                _fptr(self.x), _iptr(self.y), self.x.shape[0], self._row,
-                self._yrow, self.batch, int(seed) & (2**64 - 1), threads, depth,
+                _fptr(self.x), _iptr(self.y.view(np.int32)), self.x.shape[0],
+                self._row, self._yrow, self.batch,
+                int(seed) & (2**64 - 1), threads, depth,
             )
         else:
             self._rng = np.random.RandomState(seed)
@@ -178,9 +186,11 @@ class BatchPrefetcher:
 
     def next(self) -> Tuple[np.ndarray, np.ndarray, int]:
         bx = np.empty((self.batch,) + self.x.shape[1:], np.float32)
-        by = np.empty((self.batch,) + self.y.shape[1:], np.int32)
+        by = np.empty((self.batch,) + self.y.shape[1:], self._y_dtype)
         if self._handle is not None:
-            epoch = self._lib.prefetcher_next(self._handle, _fptr(bx), _iptr(by))
+            epoch = self._lib.prefetcher_next(
+                self._handle, _fptr(bx), _iptr(by.view(np.int32))
+            )
             return bx, by, int(epoch)
         idx = []
         for _ in range(self.batch):

@@ -61,10 +61,14 @@ class PipelineCheetah:
 
     Capabilities (explicit, so nobody infers more than is here):
 
-    - schedule: plain GPipe — M microbatches through S stages over
-      ``M + S - 1`` ticks; bubble fraction = (S-1)/(M+S-1) (measured by
-      ``tests/test_pipeline.py::test_bubble_fraction_measured``); no 1F1B,
-      no interleaved stages
+    - schedule: ``"gpipe"`` (default) — M microbatches through S stages
+      over ``M + S - 1`` ticks, backward by autodiff; or ``"1f1b"`` —
+      hand-scheduled one-forward-one-backward ticks whose in-flight
+      activation memory is O(S) instead of O(M)
+      (``_train_step_device_1f1b``; gradient-exact vs gpipe, verified by
+      ``tests/test_pipeline.py::test_1f1b_matches_gpipe``). Bubble
+      fraction is (S-1)/(M+S-1) for both (non-interleaved); 1F1B's win is
+      the memory headroom that lets M grow. No interleaved stages.
     - backward: ``jax.grad`` through the scan (ppermute's transpose is the
       reverse rotation) — exact, rematerialised per stage
     - composes with a ``data`` mesh axis (pp x dp); tensor/sequence axes
@@ -80,7 +84,18 @@ class PipelineCheetah:
         mesh: Mesh,
         microbatches: int = 4,
         optimizer: Optional[optax.GradientTransformation] = None,
+        schedule: str = "gpipe",
     ):
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"schedule must be 'gpipe' or '1f1b', got {schedule!r}")
+        if getattr(cfg, "pos_emb", "rope") != "rope":
+            # both schedules hard-code rotary; silently dropping a
+            # config knob the single-device path honours would train a
+            # DIFFERENT model than the same YAML elsewhere
+            raise NotImplementedError(
+                "PipelineCheetah supports pos_emb='rope' only"
+            )
+        self.schedule = schedule
         self.cfg = cfg
         self.mesh = mesh
         self.n_stages = int(mesh.shape[PIPELINE])
@@ -257,6 +272,142 @@ class PipelineCheetah:
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
+    # -- 1F1B schedule --------------------------------------------------------
+    def _train_step_device_1f1b(self, params, opt_state, tokens, mask):
+        """Hand-scheduled one-forward-one-backward pipeline tick loop.
+
+        GPipe-by-autodiff (``_train_step_device``) lets ``jax.grad`` run the
+        whole forward scan first, so every tick's stage output — M + S - 1
+        activations of [mb, L, D] — is live until its backward. 1F1B
+        interleaves: at tick t each stage forwards microbatch ``t - s`` and
+        backwards microbatch ``t - 2(S-1) + s`` (the last stage backwards a
+        microbatch at the same tick its forward completes), so only a ring
+        of 2S in-flight stage INPUTS is ever saved — activation memory
+        O(S), independent of M. Bubble fraction is unchanged vs GPipe for
+        the non-interleaved schedule — the win is memory, which is what
+        lets M grow (and the bubble shrink) without re-enabling remat.
+
+        Gradients are exact: each backward tick recomputes its stage
+        forward from the saved input and applies the cotangent arriving
+        from the next stage over the reverse ``ppermute``.
+        """
+        cfg = self.cfg
+        S, M = self.n_stages, self.microbatches
+        stage = jax.lax.axis_index(PIPELINE)
+        Mb, L = tokens.shape[1], tokens.shape[2]
+        pos = jnp.arange(L)[None, :]
+        cos, sin = rotary_embedding(pos, cfg.head_dim, cfg.rope_theta)
+        R = 2 * S  # ring capacity > max in-flight (2(S-1)+1)
+        T = M + 2 * (S - 1)
+        perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+        perm_bwd = [((i + 1) % S, i) for i in range(S)]
+        is_last = (stage == S - 1)
+
+        def stage_fwd(p_blocks, p_embed, buf, mb_tokens):
+            x0 = jnp.take(p_embed, mb_tokens, axis=0).astype(cfg.dtype)
+            x_in = jnp.where(stage == 0, x0, buf)
+            return self._apply_stage(p_blocks, x_in, cos, sin)
+
+        def loss_sum_fn(p_norm, p_head, y, mb_tokens, mb_mask):
+            h = rms_norm(y, p_norm.astype(jnp.float32), cfg.norm_eps)
+            logits = jnp.einsum(
+                "bld,dv->blv", h, p_head.astype(cfg.dtype)
+            ).astype(jnp.float32)
+            per = optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], mb_tokens[:, 1:]
+            )
+            return (per * mb_mask[:, 1:].astype(jnp.float32)).sum()
+
+        zeros_g = {
+            "embed": jnp.zeros_like(params["embed"]),
+            "blocks": jax.tree.map(jnp.zeros_like, params["blocks"]),
+            "norm_f": jnp.zeros_like(params["norm_f"]),
+            "head": jnp.zeros_like(params["head"]),
+        }
+
+        def tick(carry, t):
+            fwd_buf, bwd_buf, saved, g, loss_sum = carry
+            # ---- forward of microbatch m_f = t - stage
+            m_f = t - stage
+            f_valid = ((m_f >= 0) & (m_f < M)).astype(jnp.float32)
+            tok_f = jnp.take(tokens, jnp.clip(m_f, 0, M - 1), axis=0)
+            msk_f = jnp.take(mask, jnp.clip(m_f, 0, M - 1), axis=0)
+            y = stage_fwd(params["blocks"], params["embed"], fwd_buf, tok_f)
+            # save this microbatch's stage INPUT for its backward recompute
+            slot_f = jnp.where(m_f >= 0, m_f % R, 0)
+            cur = jax.lax.dynamic_index_in_dim(saved, slot_f, 0,
+                                               keepdims=False)
+            saved = jax.lax.dynamic_update_index_in_dim(
+                saved,
+                jnp.where(f_valid > 0, fwd_buf, cur),
+                slot_f, 0,
+            )
+            # ---- last stage: loss grads for THIS microbatch, immediately
+            lval, (g_norm, g_head, dy_loss) = jax.value_and_grad(
+                loss_sum_fn, argnums=(0, 1, 2)
+            )(params["norm_f"], params["head"], y, tok_f, msk_f)
+            w_last = is_last.astype(jnp.float32) * f_valid
+            loss_sum = loss_sum + lval * w_last
+            g["norm_f"] = g["norm_f"] + g_norm * w_last
+            g["head"] = g["head"] + g_head * w_last
+            # ---- backward of microbatch m_b = t - 2(S-1) + stage
+            m_b = t - 2 * (S - 1) + stage
+            b_valid = ((m_b >= 0) & (m_b < M)).astype(jnp.float32)
+            tok_b = jnp.take(tokens, jnp.clip(m_b, 0, M - 1), axis=0)
+            slot_b = jnp.where(m_b >= 0, m_b % R, 0)
+            x_saved = jax.lax.dynamic_index_in_dim(saved, slot_b, 0,
+                                                   keepdims=False)
+            # cotangent: the last stage's is its own fresh loss grad
+            # (m_b == m_f there); other stages' arrived over the ring
+            dy = jnp.where(is_last, dy_loss.astype(cfg.dtype), bwd_buf)
+            _, vjp = jax.vjp(
+                lambda pb, pe, xb: stage_fwd(pb, pe, xb, tok_b),
+                params["blocks"], params["embed"], x_saved,
+            )
+            d_blocks, d_embed, dx = vjp(dy)
+            g["blocks"] = jax.tree.map(
+                lambda a, b: a + b * b_valid, g["blocks"], d_blocks
+            )
+            g["embed"] = g["embed"] + d_embed * b_valid
+            # ---- rotate: activations forward, cotangents backward
+            fwd_buf = jax.lax.ppermute(y, PIPELINE, perm_fwd)
+            bwd_buf = jax.lax.ppermute(
+                (dx * b_valid).astype(cfg.dtype), PIPELINE, perm_bwd
+            )
+            return (fwd_buf, bwd_buf, saved, g, loss_sum), None
+
+        buf0 = jnp.zeros((Mb, L, cfg.d_model), cfg.dtype)
+        saved0 = jnp.zeros((R, Mb, L, cfg.d_model), cfg.dtype)
+        carry = jax.lax.scan(
+            tick, (buf0, buf0, saved0, zeros_g, jnp.zeros(())),
+            jnp.arange(T),
+        )[0]
+        g, loss_sum = carry[3], carry[4]
+        # normalize by the GLOBAL token count and sync exactly like GPipe
+        cnt = mask[:, :, 1:].astype(jnp.float32).sum()  # replicated over pp
+        if DATA in self.mesh.axis_names and self.mesh.shape[DATA] > 1:
+            cnt = jax.lax.psum(cnt, DATA)
+        cnt = jnp.maximum(cnt, 1.0)
+
+        def sync(path_is_blocks, gr):
+            if not path_is_blocks:
+                gr = jax.lax.psum(gr, PIPELINE)
+            if DATA in self.mesh.axis_names and self.mesh.shape[DATA] > 1:
+                gr = jax.lax.psum(gr, DATA)
+            return gr / cnt
+
+        grads = {
+            "embed": sync(False, g["embed"]),
+            "blocks": jax.tree.map(partial(sync, True), g["blocks"]),
+            "norm_f": sync(False, g["norm_f"]),
+            "head": sync(False, g["head"]),
+        }
+        # loss_sum is already nonzero only on the last stage (w_last mask)
+        loss = self._all_reduce_scalar(loss_sum) / cnt
+        updates, opt_state = self.opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
     # -- public API ----------------------------------------------------------
     def init_opt_state(self, params: PyTree) -> PyTree:
         with self.mesh:
@@ -294,8 +445,13 @@ class PipelineCheetah:
         if self._step is None:
             p_spec, d_spec = self._specs()
             o_spec = _opt_state_specs(p_spec, opt_state)
+            device_fn = (
+                self._train_step_device_1f1b
+                if self.schedule == "1f1b"
+                else self._train_step_device
+            )
             fn = shard_map(
-                self._train_step_device, mesh=self.mesh,
+                device_fn, mesh=self.mesh,
                 in_specs=(p_spec, o_spec, d_spec, d_spec),
                 out_specs=(p_spec, o_spec, P()),
             )

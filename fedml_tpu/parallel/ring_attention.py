@@ -7,12 +7,28 @@ long-context work). The design follows the public Ring Attention recipe
 (blockwise attention with online softmax + K/V rotation over the ring):
 
 - each of the S devices on the ``sequence`` axis holds one block of Q, K, V
-- S steps: attend the local Q block against the currently-held K/V block
-  (flash-style running (m, l, o) accumulators), then ``lax.ppermute`` K/V one
-  hop around the ring — compute and ICI transfer overlap, peak memory is
-  O(L/S) per device, and the result is EXACT attention over the full length
+- S steps: attend the local Q block against the currently-held K/V block,
+  then ``lax.ppermute`` K/V one hop around the ring — compute and ICI
+  transfer overlap, peak memory is O(L/S) per device, and the result is
+  EXACT attention over the full length
 - causal masking by global block offsets: past blocks attend fully, the
   diagonal block uses the in-block triangle, future blocks are skipped
+
+Two inner engines, one contract:
+
+- ``use_kernel=True`` (TPU): each block attend is the Pallas flash kernel
+  (``_flash_attention(..., save_residuals=True)`` → per-block (o, l, m)),
+  merged across ring steps with the standard online-softmax correction —
+  the [Lq, Lk] score matrix never leaves VMEM (r3 ran fp32 einsum logits
+  here while the single-device path had splash).
+- ``use_kernel=False`` (CPU/tests): the fp32 einsum block attend.
+
+Both run under ONE ``jax.custom_vjp``: the backward is the hand-scheduled
+blockwise flash backward (recompute p against the saved global LSE per
+K/V block; dk/dv ride the ring with their block). Before this, autodiff
+through the fwd scan SAVED every block's [B,H,Lq,Lk] probabilities —
+reassembling the full attention matrix in HBM and silently defeating ring
+attention's O(L/S) training memory.
 
 Call from inside ``shard_map`` with the sequence axis named; q/k/v carry the
 per-device local blocks ``[B, L/S, H, D]``.
@@ -20,7 +36,7 @@ per-device local blocks ``[B, L/S, H, D]``.
 
 from __future__ import annotations
 
-from functools import partial
+import math
 
 import jax
 import jax.numpy as jnp
@@ -28,11 +44,8 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def _block_attend(q, k, v, q_offset, kv_offset, causal, scale):
-    """One Q-block × K/V-block partial attention.
-
-    Returns (scores_max [B,H,Lq], exp_scores [B,H,Lq,Lk], pv [B,H,Lq,D]).
-    """
+def _masked_logits(q, k, q_offset, kv_offset, causal, scale):
+    """[B,H,Lq,Lk] fp32 logits with the global-offset causal mask."""
     logits = jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32) * scale
     if causal:
         Lq, Lk = q.shape[1], k.shape[1]
@@ -43,34 +56,39 @@ def _block_attend(q, k, v, q_offset, kv_offset, causal, scale):
     return logits
 
 
-def make_ring_attention(static_ring_size: int, axis_name: str, causal: bool = True):
+def make_ring_attention(static_ring_size: int, axis_name: str,
+                        causal: bool = True, use_kernel: bool = False,
+                        block_q: int = 0, block_kv: int = 0):
     """Build a ring-attention fn for a statically-known ring size (the mesh
-    axis size is always known at trace time)."""
+    axis size is always known at trace time). ``block_q``/``block_kv`` are
+    the splash kernel tiles (0 = the measured (512, 512) default), same
+    knobs the single-device path takes from the YAML surface."""
     S = int(static_ring_size)
     rot_pairs = [(i, (i + 1) % S) for i in range(S)]
 
-    def fn(q, k, v):
+    def _rot(x):
+        return jax.lax.ppermute(x, axis_name, rot_pairs)
+
+    # -- forward: online-softmax merge over ring steps ----------------------
+    def _fwd_einsum(q, k, v):
         B, Lb, H, Dh = q.shape
-        scale = 1.0 / jnp.sqrt(jnp.asarray(Dh, jnp.float32))
+        scale = 1.0 / math.sqrt(Dh)
         my = jax.lax.axis_index(axis_name)
         q_offset = my * Lb
 
         def step(carry, s):
             o, m, l, k_cur, v_cur = carry
-            kv_idx = (my - s) % S
-            kv_offset = kv_idx * Lb
-            logits = _block_attend(q, k_cur, v_cur, q_offset, kv_offset,
-                                   causal, scale)  # [B,H,Lq,Lk]
-            m_blk = jnp.max(logits, axis=-1)  # [B,H,Lq]
+            kv_offset = ((my - s) % S) * Lb
+            logits = _masked_logits(q, k_cur, q_offset, kv_offset, causal,
+                                    scale)
+            m_blk = jnp.max(logits, axis=-1)
             m_new = jnp.maximum(m, m_blk)
             corr = jnp.exp(m - m_new)
-            p = jnp.exp(logits - m_new[..., None])  # [B,H,Lq,Lk]
+            p = jnp.exp(logits - m_new[..., None])
             l_new = l * corr + p.sum(-1)
             pv = jnp.einsum("bhlm,bmhd->bhld", p, v_cur.astype(jnp.float32))
             o_new = o * corr[..., None] + pv
-            k_next = jax.lax.ppermute(k_cur, axis_name, rot_pairs)
-            v_next = jax.lax.ppermute(v_cur, axis_name, rot_pairs)
-            return (o_new, m_new, l_new, k_next, v_next), None
+            return (o_new, m_new, l_new, _rot(k_cur), _rot(v_cur)), None
 
         o0 = jnp.zeros((B, H, Lb, Dh), jnp.float32)
         m0 = jnp.full((B, H, Lb), NEG_INF, jnp.float32)
@@ -78,7 +96,133 @@ def make_ring_attention(static_ring_size: int, axis_name: str, causal: bool = Tr
         (o, m, l, _, _), _ = jax.lax.scan(
             step, (o0, m0, l0, k, v), jnp.arange(S)
         )
-        out = o / jnp.maximum(l[..., None], 1e-30)
-        return jnp.einsum("bhld->blhd", out).astype(q.dtype)
+        l_safe = jnp.maximum(l, 1e-30)
+        out = (o / l_safe[..., None]).swapaxes(1, 2).astype(q.dtype)
+        return out, m + jnp.log(l_safe)
 
-    return fn
+    def _fwd_kernel(q, k, v):
+        """Splash-kernel block attends merged across the ring.
+
+        Step 0 is the diagonal block (every device: kv_idx == my — STATIC),
+        so the in-block triangle uses a CausalMask kernel; later steps run a
+        FullMask kernel and a per-device ``keep`` predicate zeroes future
+        blocks (kv_idx > my) in the LSE merge. Each block's normalized
+        output + logsumexp come from ``save_residuals=True`` — the [Lq, Lk]
+        score matrix never leaves VMEM.
+        """
+        from jax.experimental.pallas.ops.tpu.splash_attention import (
+            splash_attention_kernel as sk,
+            splash_attention_mask as sm_lib,
+        )
+
+        B, Lb, H, Dh = q.shape
+        scale = 1.0 / math.sqrt(Dh)
+        my = jax.lax.axis_index(axis_name)
+
+        # kernel tiles: config knobs when set, else the (512, 512) blocks
+        # that took the single-device splash path from 42% to 76% MFU
+        # (bench.py) — the kernel defaults underfeed the MXU
+        from .transformer import _splash_blocks
+
+        blocks = _splash_blocks(Lb, block_q or 512, block_kv or 512, Dh)
+
+        def make(diag_causal: bool):
+            mask = sm_lib.MultiHeadMask(
+                [sm_lib.CausalMask((Lb, Lb)) if diag_causal
+                 else sm_lib.FullMask((Lb, Lb))] * H
+            )
+            return sk.make_splash_mha(
+                mask=mask, save_residuals=True,
+                block_sizes=blocks, head_shards=1, q_seq_shards=1,
+            )
+
+        kern_diag = make(causal)
+        kern_full = make(False)
+        qt = (q * scale).swapaxes(1, 2)  # [B, H, Lb, D]
+
+        def call(kern, kt, vt):
+            o, (lse,) = jax.vmap(kern)(qt, kt, vt)
+            return o.astype(jnp.float32), lse  # [B,H,Lb,D], [B,H,Lb]
+
+        kt0 = k.swapaxes(1, 2)
+        vt0 = v.swapaxes(1, 2)
+        acc, lse = call(kern_diag, kt0, vt0)
+
+        def step(carry, s):
+            acc, lse, k_cur, v_cur = carry
+            k_cur = _rot(k_cur)
+            v_cur = _rot(v_cur)
+            ob, lse_b = call(kern_full, k_cur, v_cur)
+            if causal:
+                lse_b = jnp.where(s <= my, lse_b, NEG_INF)
+            lse_new = jnp.logaddexp(lse, lse_b)
+            acc_new = (
+                acc * jnp.exp(lse - lse_new)[..., None]
+                + ob * jnp.exp(lse_b - lse_new)[..., None]
+            )
+            return (acc_new, lse_new, k_cur, v_cur), None
+
+        if S > 1:
+            (acc, lse, _, _), _ = jax.lax.scan(
+                step, (acc, lse, kt0, vt0), jnp.arange(1, S)
+            )
+        return acc.swapaxes(1, 2).astype(q.dtype), lse
+
+    _fwd_impl = _fwd_kernel if use_kernel else _fwd_einsum
+
+    # -- custom VJP: hand-scheduled blockwise backward ----------------------
+    @jax.custom_vjp
+    def ring(q, k, v):
+        return _fwd_impl(q, k, v)[0]
+
+    def ring_fwd(q, k, v):
+        out, lse = _fwd_impl(q, k, v)
+        return out, (q, k, v, out, lse)
+
+    def ring_bwd(res, do):
+        """Blockwise flash backward: per ring step, recompute this block's
+        probabilities against the saved GLOBAL log-sum-exp, accumulate
+        dq locally while dk/dv ride the ring with their K/V block (after S
+        rotations they are home). Memory stays O(block); nothing from the
+        forward scan is retained but (q, k, v, out, lse)."""
+        q, k, v, out, lse = res
+        B, Lb, H, Dh = q.shape
+        scale = 1.0 / math.sqrt(Dh)
+        my = jax.lax.axis_index(axis_name)
+        q_offset = my * Lb
+        do32 = do.astype(jnp.float32)
+        delta = jnp.einsum(
+            "blhd,blhd->bhl", do32, out.astype(jnp.float32)
+        )  # [B, H, Lq]
+
+        def step(carry, s):
+            dq, k_cur, v_cur, dk, dv = carry
+            kv_offset = ((my - s) % S) * Lb
+            logits = _masked_logits(q, k_cur, q_offset, kv_offset, causal,
+                                    scale)
+            p = jnp.exp(logits - lse[..., None])  # exact softmax probs
+            dv_new = dv + jnp.einsum("bhlm,blhd->bmhd", p, do32)
+            dp = jnp.einsum("blhd,bmhd->bhlm", do32,
+                            v_cur.astype(jnp.float32))
+            ds = p * (dp - delta[..., None]) * scale
+            dq_new = dq + jnp.einsum(
+                "bhlm,bmhd->blhd", ds, k_cur.astype(jnp.float32)
+            )
+            dk_new = dk + jnp.einsum(
+                "bhlm,blhd->bmhd", ds, q.astype(jnp.float32)
+            )
+            # dk/dv travel WITH their block; after S rotations they're home
+            return (dq_new, _rot(k_cur), _rot(v_cur), _rot(dk_new),
+                    _rot(dv_new)), None
+
+        zeros_kv = jnp.zeros((B, Lb, H, Dh), jnp.float32)
+        (dq, _, _, dk, dv), _ = jax.lax.scan(
+            step,
+            (jnp.zeros((B, Lb, H, Dh), jnp.float32), k, v, zeros_kv,
+             zeros_kv),
+            jnp.arange(S),
+        )
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    ring.defvjp(ring_fwd, ring_bwd)
+    return ring

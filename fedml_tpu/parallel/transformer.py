@@ -76,6 +76,14 @@ class TransformerConfig:
     # MXU underfed (tools/mfu_sweep.py sweeps these)
     attn_block_q: int = 0
     attn_block_kv: int = 0
+    # False = bidirectional encoder attention (FedNLP heads like span
+    # extraction need lookahead; the LM paths keep the causal default)
+    causal: bool = True
+    # "rope" (default) or "learned" absolute positions. Learned positions
+    # average cleanly under FedAvg (clients share one positional basis);
+    # rotary models can converge to per-client-rotated solutions whose
+    # average destroys the task — measured on the prefix-LM seq2seq head.
+    pos_emb: str = "rope"
 
     @property
     def head_dim(self) -> int:
@@ -202,8 +210,9 @@ def _splash_blocks(L: int, block_q: int, block_kv: int, head_dim: int):
 
 
 def splash_attention_tpu(q: jax.Array, k: jax.Array, v: jax.Array,
-                         block_q: int = 0, block_kv: int = 0) -> jax.Array:
-    """Causal splash attention (the current-generation Pallas TPU kernel).
+                         block_q: int = 0, block_kv: int = 0,
+                         causal: bool = True) -> jax.Array:
+    """Splash attention (the current-generation Pallas TPU kernel).
 
     q: [B, L, H, D]; k/v: [B, L, Hkv, D] → out [B, L, H, D]. GQA/MQA run
     NATIVELY (``make_splash_mqa`` vmapped over kv groups) — K/V are never
@@ -222,16 +231,20 @@ def splash_attention_tpu(q: jax.Array, k: jax.Array, v: jax.Array,
     Hkv = k.shape[2]
     scale = float(1.0 / D ** 0.5)
     blocks = _splash_blocks(L, block_q, block_kv, D)
+
+    def head_mask(n):
+        m = sm.CausalMask((L, L)) if causal else sm.FullMask((L, L))
+        return sm.MultiHeadMask([m] * n)
+
     qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))  # [B, H(kv), L, D]
     if Hkv == H:
-        mask = sm.MultiHeadMask([sm.CausalMask((L, L))] * H)
-        kernel = sk.make_splash_mha(mask=mask, block_sizes=blocks,
+        kernel = sk.make_splash_mha(mask=head_mask(H), block_sizes=blocks,
                                     head_shards=1, q_seq_shards=1)
         out = jax.vmap(kernel)(qt * scale, kt, vt)
         return out.swapaxes(1, 2)
     # grouped-query: per kv group g, rep = H/Hkv query heads share k/v[g]
     rep = H // Hkv
-    mask = sm.MultiHeadMask([sm.CausalMask((L, L))] * rep)
+    mask = head_mask(rep)
     kernel = sk.make_splash_mqa(mask=mask, block_sizes=blocks,
                                 head_shards=1, q_seq_shards=1)
     qg = (qt * scale).reshape(B, Hkv, rep, L, D)
@@ -240,9 +253,9 @@ def splash_attention_tpu(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def flash_attention_tpu(
-    q: jax.Array, k: jax.Array, v: jax.Array
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True
 ) -> jax.Array:
-    """Causal flash attention via the Pallas TPU kernel.
+    """Flash attention via the Pallas TPU kernel.
 
     q/k/v: [B, L, H, D] (Hkv already expanded for GQA) → out [B, L, H, D].
     The kernel wants [B, H, L, D]; blocks stream through VMEM so the [L, L]
@@ -255,7 +268,7 @@ def flash_attention_tpu(
 
     D = q.shape[-1]
     qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
-    out = _flash(qt, kt, vt, causal=True, sm_scale=float(1.0 / D ** 0.5))
+    out = _flash(qt, kt, vt, causal=causal, sm_scale=float(1.0 / D ** 0.5))
     return out.swapaxes(1, 2)
 
 
@@ -339,9 +352,10 @@ def expand_gqa(k, v, n_heads):
 
 
 def attention_scores(
-    q: jax.Array, k: jax.Array, v: jax.Array, mask: Optional[jax.Array]
+    q: jax.Array, k: jax.Array, v: jax.Array, mask: Optional[jax.Array],
+    causal: bool = True,
 ) -> jax.Array:
-    """Plain causal attention (single-device / tensor-parallel path).
+    """Plain attention (single-device / tensor-parallel path).
 
     q: [B, L, H, D], k/v: [B, L, Hkv, D] → out [B, L, H, D]. GQA via repeat.
     The sequence-parallel path replaces this with ring attention
@@ -351,8 +365,9 @@ def attention_scores(
     k, v = expand_gqa(k, v, H)
     scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
     logits = jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32) * scale
-    causal = jnp.tril(jnp.ones((L, L), jnp.bool_))
-    logits = jnp.where(causal[None, None], logits, -1e30)
+    if causal:
+        tri = jnp.tril(jnp.ones((L, L), jnp.bool_))
+        logits = jnp.where(tri[None, None], logits, -1e30)
     if mask is not None:
         logits = jnp.where(mask[:, None, None, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
@@ -408,7 +423,20 @@ class Attention(nn.Module):
                 _c.MESH_AXIS_TENSOR,
                 None,
             )
-            ring = make_ring_attention(seq_ctx.size, seq_ctx.axis_name)
+            # splash kernel inside the ring when the per-device block is in
+            # the kernel's winning regime (measured, tools/bench_ring_kernel
+            # .py: fwd 1.5x at block 8192, but fwd+bwd loses below ~4k —
+            # the blockwise backward is einsum either way); einsum otherwise
+            Lb = L // seq_ctx.size
+            use_kernel = (
+                _attn_backend(cfg.attn_impl) == "splash"
+                and Lb >= 4096 and Lb % 128 == 0
+            )
+            ring = make_ring_attention(
+                seq_ctx.size, seq_ctx.axis_name, causal=cfg.causal,
+                use_kernel=use_kernel,
+                block_q=cfg.attn_block_q, block_kv=cfg.attn_block_kv,
+            )
             out = compat_shard_map(
                 ring, mesh=seq_ctx.mesh, in_specs=(spec, spec, spec),
                 out_specs=spec,
@@ -425,14 +453,17 @@ class Attention(nn.Module):
                     partial(
                         splash_attention_tpu,
                         block_q=cfg.attn_block_q, block_kv=cfg.attn_block_kv,
+                        causal=cfg.causal,
                     ),
                     q, k, v,
                 )
             else:
                 k, v = expand_gqa(k, v, H)
-                out = _shard_attn_kernel(flash_attention_tpu, q, k, v)
+                out = _shard_attn_kernel(
+                    partial(flash_attention_tpu, causal=cfg.causal), q, k, v
+                )
         else:
-            out = attention_scores(q, k, v, mask)
+            out = attention_scores(q, k, v, mask, causal=cfg.causal)
         out = out.reshape(B, L, H * hd)
         return jnp.einsum("ble,ed->bld", out, wo.astype(cfg.dtype))
 
@@ -496,10 +527,27 @@ class Transformer(nn.Module):
             cfg.param_dtype,
         )
         x = jnp.take(embed, tokens, axis=0).astype(cfg.dtype)
-        x = _constrain_batch_activations(x)
         if positions is None:
             positions = jnp.arange(tokens.shape[1])[None, :]
-        cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+        if cfg.pos_emb == "learned":
+            pos_table = self.param(
+                "pos_emb",
+                nn.with_partitioning(nn.initializers.normal(0.02),
+                                     (None, EMBED)),
+                (cfg.max_seq_len, cfg.d_model),
+                cfg.param_dtype,
+            )
+            x = x + jnp.take(pos_table, positions[0], axis=0).astype(
+                cfg.dtype
+            )[None]
+            # identity rotation: attention runs position-free
+            ang = jnp.zeros(positions.shape + (cfg.head_dim // 2,),
+                            jnp.float32)
+            cos, sin = jnp.cos(ang), jnp.sin(ang)
+        else:
+            cos, sin = rotary_embedding(positions, cfg.head_dim,
+                                        cfg.rope_theta)
+        x = _constrain_batch_activations(x)
 
         if cfg.remat:
             policy = (

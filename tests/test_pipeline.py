@@ -182,3 +182,74 @@ def test_opt_state_specs_match_by_path_not_shape():
             assert sp == P(), (name, sp)
         elif "count" in name:
             assert sp == P(), (name, sp)
+
+
+class Test1F1B:
+    def test_1f1b_matches_gpipe(self):
+        """The hand-scheduled 1F1B tick loop is gradient-exact: one SGD
+        train step must produce the same params and loss as the
+        autodiff-GPipe schedule."""
+        mesh = make_mesh({"pipeline": 2}, devices=jax.devices()[:2])
+        params = PipelineCheetah(CFG, mesh, microbatches=4).init_params(
+            jax.random.PRNGKey(5)
+        )
+        tokens, mask = make_batch(np.random.RandomState(5))
+        mt, mm = microbatch(tokens, mask, 4)
+        results = {}
+        for sched in ("gpipe", "1f1b"):
+            pp = PipelineCheetah(CFG, mesh, microbatches=4,
+                                 optimizer=optax.sgd(1.0), schedule=sched)
+            o = pp.init_opt_state(params)
+            new_params, _, loss = pp.train_step(
+                params, o, jnp.asarray(mt), jnp.asarray(mm)
+            )
+            results[sched] = (new_params, float(loss))
+        assert np.isclose(results["gpipe"][1], results["1f1b"][1],
+                          rtol=1e-5), results
+        for g, f in zip(jax.tree.leaves(results["gpipe"][0]),
+                        jax.tree.leaves(results["1f1b"][0])):
+            # bf16 recompute/reassociation noise between the two
+            # schedules: abs diffs measure ~2e-4 on grads of ~1e-2
+            np.testing.assert_allclose(np.asarray(g), np.asarray(f),
+                                       rtol=2e-2, atol=8e-4)
+
+    def test_1f1b_four_stage_with_data_axis(self):
+        """1F1B composes with data parallelism and trains (loss drops)."""
+        mesh = make_mesh({"pipeline": 4, "data": 2},
+                         devices=jax.devices()[:8])
+        pp = PipelineCheetah(CFG, mesh, microbatches=4,
+                             optimizer=optax.adamw(3e-3), schedule="1f1b")
+        params = pp.init_params(jax.random.PRNGKey(6))
+        o = pp.init_opt_state(params)
+        tokens, mask = make_batch(np.random.RandomState(6), b=8)
+        mt, mm = jnp.asarray(microbatch(tokens, mask, 4)[0]), jnp.asarray(
+            microbatch(tokens, mask, 4)[1])
+        losses = []
+        for _ in range(6):
+            params, o, loss = pp.train_step(params, o, mt, mm)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+
+    def test_1f1b_activation_memory_beats_gpipe(self):
+        """1F1B's reason to exist: in-flight activations are O(S), not
+        O(M). Compare the compiled per-device temp footprint of both
+        schedules at M=16 — same model, same batch; the 1F1B program must
+        be materially smaller (the GPipe scan keeps all M + S - 1 stage
+        outputs alive for autodiff)."""
+        mesh = make_mesh({"pipeline": 2}, devices=jax.devices()[:2])
+        M = 16
+        tokens = np.random.RandomState(7).randint(
+            0, CFG.vocab_size, (M * 2, 32)).astype(np.int32)
+        mt, mm = microbatch(tokens, np.ones_like(tokens), M)
+        temps = {}
+        for sched in ("gpipe", "1f1b"):
+            pp = PipelineCheetah(CFG, mesh, microbatches=M,
+                                 optimizer=optax.sgd(0.1), schedule=sched)
+            params = pp.init_params(jax.random.PRNGKey(7))
+            o = pp.init_opt_state(params)
+            pp.train_step(params, o, jnp.asarray(mt), jnp.asarray(mm))
+            temps[sched] = int(
+                pp._step.lower(params, o, jnp.asarray(mt), jnp.asarray(mm))
+                .compile().memory_analysis().temp_size_in_bytes
+            )
+        assert temps["1f1b"] < 0.8 * temps["gpipe"], temps

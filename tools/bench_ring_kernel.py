@@ -1,0 +1,117 @@
+"""Kernel-level CP-path measurement (VERDICT r3 next #6).
+
+A sequence axis of 1-vs-2 on the virtual CPU mesh says nothing about
+performance, so this measures what CAN be measured honestly single-chip:
+the ring-attention INNER engine — fp32 einsum block attend (the r3 path)
+vs the Pallas flash kernel merge (the r4 path) — at real context-parallel
+block shapes, fwd+bwd through the shared custom-VJP blockwise backward.
+
+Runs on the one real TPU chip with a 1-device ``sequence`` mesh (the ring
+machinery — shard_map, axis_index, ppermute, online merge — is all live;
+only the hop count is 1). Writes RING_KERNEL_BENCH.json.
+
+Usage:  python tools/bench_ring_kernel.py [--batch 4] [--block 2048]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--block", type=int, default=2048)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "RING_KERNEL_BENCH.json"))
+    a = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from fedml_tpu.parallel.ring_attention import make_ring_attention
+    from fedml_tpu.parallel.sharding import compat_shard_map
+
+    if jax.devices()[0].platform != "tpu":
+        print(json.dumps({"skipped": "not a tpu host"}))
+        return
+
+    B, Lb, H, D = a.batch, a.block, a.heads, a.head_dim
+    mesh = Mesh(np.asarray(jax.devices()[:1]), axis_names=("sequence",))
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.standard_normal((B, Lb, H, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, Lb, H, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, Lb, H, D)), jnp.bfloat16)
+
+    def measure(use_kernel: bool) -> dict:
+        ring = make_ring_attention(1, "sequence", use_kernel=use_kernel)
+        spec = P(None, "sequence", None, None)
+        sm = compat_shard_map(ring, mesh=mesh, in_specs=(spec,) * 3,
+                              out_specs=spec)
+
+        @jax.jit
+        def fwd(q, k, v):
+            return jnp.sum(sm(q, k, v).astype(jnp.float32) ** 2)
+
+        @jax.jit
+        def fwd_bwd(q, k, v):
+            l, grads = jax.value_and_grad(
+                lambda q, k, v: jnp.sum(sm(q, k, v).astype(jnp.float32) ** 2),
+                argnums=(0, 1, 2),
+            )(q, k, v)
+            return l, grads
+
+        def sync(x):
+            return float(np.asarray(jax.tree.leaves(x)[0]).ravel()[0])
+
+        def timeit(f):
+            r = f(q, k, v)
+            sync(r)
+            t0 = time.perf_counter()
+            for _ in range(a.steps):
+                r = f(q, k, v)
+            sync(r)
+            return (time.perf_counter() - t0) / a.steps, r
+
+        dt_f, _ = timeit(fwd)
+        dt_fb, (l, _) = timeit(fwd_bwd)
+        return {"ms_per_fwd": round(dt_f * 1e3, 2),
+                "ms_per_fwd_bwd": round(dt_fb * 1e3, 2), "loss": float(l)}
+
+    einsum = measure(False)
+    kernel = measure(True)
+    out = {
+        "shape": {"batch": B, "block": Lb, "heads": H, "head_dim": D},
+        "einsum_inner": einsum,
+        "flash_kernel_inner": kernel,
+        "kernel_fwd_speedup": round(
+            einsum["ms_per_fwd"] / kernel["ms_per_fwd"], 2
+        ),
+        "kernel_fwd_bwd_speedup": round(
+            einsum["ms_per_fwd_bwd"] / kernel["ms_per_fwd_bwd"], 2
+        ),
+        # both paths share the blockwise custom-VJP backward; the numbers
+        # differ by the forward engine (+ what XLA can fuse around it)
+        "loss_rel_diff": abs(einsum["loss"] - kernel["loss"])
+        / max(abs(einsum["loss"]), 1e-9),
+        "device": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(out))
+    with open(a.out, "w") as f:
+        json.dump(out, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -176,8 +176,9 @@ def main() -> None:
         print(json.dumps(row))
         return row
 
+    requested = parse_rows(a.rows)
     rows = []
-    for chip, n in parse_rows(a.rows):
+    for chip, n in requested:
         rows.append(compile_for(chip, n))
         out = {
             "model": "llama2_7b",
@@ -186,6 +187,10 @@ def main() -> None:
             "mu_dtype": a.mu_dtype,
             "remat": cfg.remat,
             "source": "TPU compiler memory_analysis via AOT topologies",
+            "rows_requested": [f"{c}:{k}" for c, k in requested],
+            # a partial artifact (crash mid-list) must be distinguishable
+            # from a complete run: fits/agree only cover finished rows
+            "complete": len(rows) == len(requested),
             "rows": rows,
             "fits": all(r["fits"] for r in rows),
             "analytic_agrees_with_compiler": all(r["agree"] for r in rows),

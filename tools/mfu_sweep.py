@@ -81,21 +81,21 @@ def run_one(cfg: dict) -> None:
     rng = np.random.RandomState(0)
     tok = jnp.asarray(rng.randint(0, tc.vocab_size, (B, L)).astype(np.int32))
     mask = jnp.ones((B, L), jnp.int32)
-    tok_d, mask_d = tr.shard_batch(tok, mask)
-    with mesh:
-        # >= 2 warmup steps: the FIRST step compiles, and the SECOND
-        # recompiles (the donated state comes back with step-output
-        # shardings that differ from init_state's) — timing from warmup=1
-        # puts that second ~10 s compile inside the measured window and
-        # under-reports MFU by 2-3x
-        for _ in range(3):
-            state, m = tr._step_jit(state, tok_d, mask_d)
-        float(np.asarray(m["loss"]))  # true sync (axon block_until_ready no-op)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, m = tr._step_jit(state, tok_d, mask_d)
-        float(np.asarray(m["loss"]))
-        dt = (time.perf_counter() - t0) / steps
+    # go through train_step (not _step_jit): it scopes the mesh_context the
+    # Pallas kernels need to shard_map themselves on multi-chip meshes
+    # >= 2 warmup steps: the FIRST step compiles, and the SECOND
+    # recompiles (the donated state comes back with step-output
+    # shardings that differ from init_state's) — timing from warmup=1
+    # puts that second ~10 s compile inside the measured window and
+    # under-reports MFU by 2-3x
+    for _ in range(3):
+        state, m = tr.train_step(state, tok, mask)
+    float(np.asarray(m["loss"]))  # true sync (axon block_until_ready no-op)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = tr.train_step(state, tok, mask)
+    float(np.asarray(m["loss"]))
+    dt = (time.perf_counter() - t0) / steps
     fpt = 6.0 * n_active + 12.0 * L * tc.n_layers * tc.d_model
     n_chips = jax.device_count()
     tps = B * L / dt / n_chips  # per chip (mesh spans all local devices)
