@@ -80,10 +80,16 @@ def _same_substrate() -> dict:
     try:
         with open(path) as f:
             d = json.load(f)
-        return {
+        out = {
             "vs_baseline_same_substrate": d.get("same_substrate_ratio"),
             "same_substrate_config": d.get("config"),
         }
+        legs = d.get("legs")
+        if legs:
+            out["same_substrate_legs"] = {
+                m: leg.get("same_substrate_ratio") for m, leg in legs.items()
+            }
+        return out
     except (OSError, ValueError):
         return {"vs_baseline_same_substrate": None}
 

@@ -140,7 +140,7 @@ def load(args) -> Tuple[FedDataset, int]:
         )
         return ds, spec.class_num
 
-    tx, ty, ex, ey = load_raw(
+    tx, ty, ex, ey, real_files = load_raw(
         spec, cache_dir, n_train, spec.test_total, seed
     )
 
@@ -164,7 +164,8 @@ def load(args) -> Tuple[FedDataset, int]:
         test_y=ey,
         class_num=spec.class_num,
         task=spec.task,
-        meta={"vocab_size": spec.vocab_size, "seq_len": spec.seq_len, "name": name},
+        meta={"vocab_size": spec.vocab_size, "seq_len": spec.seq_len,
+              "name": name, "real_files": real_files},
     )
     ds = pad_cap_to_batch_multiple(ds, int(getattr(args, "batch_size", 32)))
     logger.info(

@@ -480,36 +480,39 @@ def synth_detection(spec: DatasetSpec, n_train: int, n_test: int, seed: int):
 
 
 def load_raw(spec: DatasetSpec, cache_dir: str, n_train: int, n_test: int, seed: int):
-    """Real data if cached on disk, else synthetic with identical shapes."""
+    """(tx, ty, ex, ey, real) — real data if cached on disk, else synthetic
+    with identical shapes; ``real`` says which one the caller got (the
+    baseline-reproduction harness refuses to claim published numbers on
+    synthetic data)."""
     if spec.name == "mnist":
         real = try_load_mnist(cache_dir)
         if real is not None:
             logger.info("mnist: using real IDX files from %s", cache_dir)
-            return real
+            return real + (True,)
     if spec.name in ("cifar10", "cifar100"):
         real = try_load_cifar(cache_dir, spec.name)
         if real is not None:
             logger.info("%s: using real pickle batches from %s", spec.name, cache_dir)
-            return real
+            return real + (True,)
     logger.info("%s: synthetic fallback (%d train / %d test)", spec.name, n_train, n_test)
     if spec.n_nodes > 0:  # FedGraphNN family: packed dense graph blocks
         from .graphs import synth_graph
 
-        return synth_graph(spec, n_train, n_test, seed)
+        return synth_graph(spec, n_train, n_test, seed) + (False,)
     if spec.task == "seq_tagging":
-        return synth_seq_tagging(spec, n_train, n_test, seed)
+        return synth_seq_tagging(spec, n_train, n_test, seed) + (False,)
     if spec.task == "span_extraction":
-        return synth_span_extraction(spec, n_train, n_test, seed)
+        return synth_span_extraction(spec, n_train, n_test, seed) + (False,)
     if spec.name == "fednlp_seq2seq":
-        return synth_seq2seq(spec, n_train, n_test, seed)
+        return synth_seq2seq(spec, n_train, n_test, seed) + (False,)
     if spec.task == "detection":
-        return synth_detection(spec, n_train, n_test, seed)
+        return synth_detection(spec, n_train, n_test, seed) + (False,)
     if spec.task == "regression":
-        return synth_regression(spec, n_train, n_test, seed)
+        return synth_regression(spec, n_train, n_test, seed) + (False,)
     if spec.task == "classification":
-        return synth_classification(spec, n_train, n_test, seed)
+        return synth_classification(spec, n_train, n_test, seed) + (False,)
     if spec.task == "tagpred":
-        return synth_tagpred(spec, n_train, n_test, seed)
+        return synth_tagpred(spec, n_train, n_test, seed) + (False,)
     if spec.task == "segmentation":
-        return synth_segmentation(spec, n_train, n_test, seed)
-    return synth_nwp(spec, n_train, n_test, seed)
+        return synth_segmentation(spec, n_train, n_test, seed) + (False,)
+    return synth_nwp(spec, n_train, n_test, seed) + (False,)

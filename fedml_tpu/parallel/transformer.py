@@ -537,9 +537,9 @@ class Transformer(nn.Module):
                 (cfg.max_seq_len, cfg.d_model),
                 cfg.param_dtype,
             )
-            x = x + jnp.take(pos_table, positions[0], axis=0).astype(
-                cfg.dtype
-            )[None]
+            # positions may be [1, L] (broadcast) or [B, L] (per-example,
+            # same contract as the rotary branch)
+            x = x + jnp.take(pos_table, positions, axis=0).astype(cfg.dtype)
             # identity rotation: attention runs position-free
             ang = jnp.zeros(positions.shape + (cfg.head_dim // 2,),
                             jnp.float32)
@@ -564,6 +564,14 @@ class Transformer(nn.Module):
             )
 
         x = RMSNorm(cfg.norm_eps)(x)
+        if return_hidden:
+            # returning BEFORE the head param is declared matters twice:
+            # the chunked-CE caller (train_step.lm_loss_chunked) fuses the
+            # head matmul itself so [B, L, vocab] fp32 logits never hit
+            # HBM, and task-head backbones (models/transformer_heads.py)
+            # never CREATE the [d_model, vocab] LM head — at 7B scale a
+            # ~131M-param dead weight every FL round would otherwise ship
+            return x
         # tied-untied choice: separate output head (Llama unties)
         w_out = self.param(
             "w_lm_head",
@@ -571,11 +579,6 @@ class Transformer(nn.Module):
             (cfg.d_model, cfg.vocab_size),
             cfg.param_dtype,
         )
-        if return_hidden:
-            # the caller fuses the head matmul into a chunked loss
-            # (train_step.lm_loss_chunked) so [B, L, vocab] fp32 logits are
-            # never materialised in HBM
-            return x
         return jnp.einsum("bld,dv->blv", x, w_out.astype(cfg.dtype)).astype(
             jnp.float32
         )

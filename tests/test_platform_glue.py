@@ -10,6 +10,8 @@ import json
 import os
 import zipfile
 
+import pytest
+
 from fedml_tpu.agent import (
     STATUS_FAILED,
     STATUS_FINISHED,
@@ -332,3 +334,34 @@ def test_cli_build_launch_agent_pipeline(tmp_path, monkeypatch, capsys):
                      "--state_dir", str(tmp_path / "st")]) == 0
     out = capsys.readouterr().out
     assert "FINISHED" in out
+
+
+@pytest.mark.slow
+def test_reproduce_baselines_harness_fixture_run(tmp_path):
+    """The published-baseline harness (tools/reproduce_baselines.py) runs a
+    benchmark row end-to-end against the checked-in REAL-format fixture and
+    reports data provenance honestly: real data for the fixture-staged row,
+    synthetic (reproduces=null) without staging."""
+    import json
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fixture = os.path.join(repo, "tests", "fixtures", "stackoverflow")
+
+    def run(*argv):
+        p = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools",
+                                          "reproduce_baselines.py"),
+             "--platform", "cpu", *argv],
+            capture_output=True, text=True, timeout=540,
+        )
+        assert p.returncode == 0, p.stderr[-800:]
+        return json.loads(p.stdout.strip().splitlines()[-1])
+
+    real = run("--row", "stackoverflow_lr", "--cache-dir", fixture,
+               "--rounds", "2")
+    assert real["data"] == "real" and real["reproduces"] is None
+    synth = run("--row", "mnist_lr", "--rounds", "2")
+    assert synth["data"] == "synthetic" and synth["reproduces"] is None
+    assert synth["published_acc"] == 81.9
