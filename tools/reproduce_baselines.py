@@ -75,7 +75,7 @@ ROWS = {
     "stackoverflow_nwp_rnn": dict(
         dataset="stackoverflow_nwp", model="rnn", published=18.3,
         client_num_in_total=10, client_num_per_round=10, comm_round=2000,
-        epochs=1, batch_size=10, learning_rate=0.3, client_optimizer="sgd",
+        epochs=1, batch_size=10, learning_rate=0.03, client_optimizer="sgd",
         source="BENCHMARK_simulation.md:10 (config :167-188)",
     ),
 }
