@@ -33,6 +33,10 @@ BASE = dict(
 
 
 def run_one(cfg: dict) -> None:
+    sys.path.insert(0, REPO)
+    from bench import TPU_PEAK_FLOPS, _maybe_force_platform
+
+    _maybe_force_platform()  # BENCH_PLATFORM=cpu — off-TPU driving
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -99,9 +103,6 @@ def run_one(cfg: dict) -> None:
     fpt = 6.0 * n_active + 12.0 * L * tc.n_layers * tc.d_model
     n_chips = jax.device_count()
     tps = B * L / dt / n_chips  # per chip (mesh spans all local devices)
-    sys.path.insert(0, REPO)
-    from bench import TPU_PEAK_FLOPS
-
     peak = TPU_PEAK_FLOPS.get(jax.devices()[0].device_kind, 197e12)
     line = {
         "step_s": round(dt, 3), "tok_s": round(tps),
