@@ -411,6 +411,7 @@ def _translate_mfu(prefix: str, parsed: dict):
     res = {
         f"{prefix}_mfu": parsed["mfu"],
         f"{prefix}_tokens_per_sec_per_chip": parsed["tok_s"],
+        f"{prefix}_device_kind": parsed.get("device_kind"),
     }
     if "params_active_m" in parsed:
         res[f"{prefix}_params_active_m"] = parsed["params_active_m"]
@@ -483,14 +484,56 @@ def build_line(results: dict, ref: float | None, meta: dict) -> dict:
     return line
 
 
+def _probe_device_kind(timeout: float = 90.0):
+    """Ask a SUBPROCESS for the device kind (a wedged tunnel hangs the
+    probe, not the bench). None = unknown — e.g. the tunnel is down, which
+    is exactly the case the cache insures against, so unknown ACCEPTS the
+    cached rows rather than discarding the insurance."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].device_kind)"],
+            capture_output=True, text=True, timeout=timeout,
+        )
+        if p.returncode == 0 and p.stdout.strip():
+            return p.stdout.strip().splitlines()[-1]
+    except Exception:
+        pass
+    return None
+
+
+def _usable(cached, digest: str, ttl_s: float) -> bool:
+    return bool(
+        cached and cached.get("digest") == digest
+        and cached.get("platform") == "tpu"
+        and time.time() - cached.get("t", 0) < ttl_s
+    )
+
+
 def run_legs(budget_s: float, ttl_s: float, min_leg_s: float = 240.0,
-             leg_timeout_s: float = 900.0, runner=None) -> dict:
+             leg_timeout_s: float = 900.0, runner=None,
+             device_prober=None) -> dict:
     """Run all legs under a global deadline, emitting the cumulative line
-    after every completed leg. ``runner`` is injectable for tests."""
+    after every completed leg. ``runner``/``device_prober`` are injectable
+    for tests."""
     t_start = time.monotonic()
     cache = _load_partial()
     ref = _ref_rounds_per_sec()
     results: dict = {}
+
+    # a cache row measured on a DIFFERENT TPU generation must not be served
+    # as this round's number: when any cached row is reusable, probe the
+    # current chip once and drop mismatched rows (they re-run fresh)
+    specs = leg_specs()
+    reusable = {n: cache["legs"].get(n) for n, _, d, _ in specs
+                if _usable(cache["legs"].get(n), d, ttl_s)}
+    if reusable:
+        kind = (device_prober or _probe_device_kind)()
+        if kind:
+            for n, row in reusable.items():
+                row_kind = row.get("device_kind")
+                if row_kind and row_kind != kind:
+                    del cache["legs"][n]
 
     def emit():
         elapsed = round(time.monotonic() - t_start, 1)
@@ -512,11 +555,9 @@ def run_legs(budget_s: float, ttl_s: float, min_leg_s: float = 240.0,
 
     runner = runner or default_runner
     line = {}
-    for name, argv, digest, translate in leg_specs():
+    for name, argv, digest, translate in specs:
         cached = cache["legs"].get(name)
-        if (cached and cached.get("digest") == digest
-                and cached.get("platform") == "tpu"
-                and time.time() - cached.get("t", 0) < ttl_s):
+        if _usable(cached, digest, ttl_s):
             results[name] = {**cached["result"], f"{name}_cached": True}
             line = emit()
             continue
@@ -539,6 +580,9 @@ def run_legs(budget_s: float, ttl_s: float, min_leg_s: float = 240.0,
             _write_partial(name, {
                 "digest": digest, "t": time.time(), "platform": platform,
                 "dur_s": round(time.time() - t0, 1), "result": res,
+                "device_kind": next(
+                    (v for k2, v in res.items()
+                     if k2.endswith("device_kind") and v), None),
             })
         line = emit()
     return line

@@ -41,9 +41,12 @@ def _tpu_runner(argv, timeout):
                 "device_kind": "TPU v5 lite"}
     if "--leg cheetah" in joined:
         return {"cheetah_mfu": 0.758, "cheetah_tokens_per_sec_per_chip": 1e5,
-                "platform": "tpu"}
+                "cheetah_device_kind": "TPU v5 lite", "platform": "tpu"}
     return {"mfu": 0.5, "tok_s": 9e4, "params_m": 600.0, "n_chips": 1,
-            "step_s": 0.2}
+            "step_s": 0.2, "device_kind": "TPU v5 lite"}
+
+
+V5E = lambda: "TPU v5 lite"  # noqa: E731  — injected device prober
 
 
 def _lines(capsys):
@@ -110,27 +113,56 @@ def test_cache_reuse_and_invalidation(partial_path, capsys, monkeypatch):
         {"legs": {"foreign_leg": {"digest": "x", "t": 1, "platform": "tpu",
                                   "result": {}}}}))
 
-    bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=runner)
+    bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=runner,
+                   device_prober=V5E)
     n_first = len(calls)
     assert n_first == len(bench.leg_specs())
     assert "foreign_leg" in json.loads(partial_path.read_text())["legs"]
 
     # second run: every leg served from cache, zero subprocesses
-    final = bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=runner)
+    final = bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=runner,
+                           device_prober=V5E)
     assert len(calls) == n_first
     assert final["value"] == 1.25
     assert final["fedavg_cached"] is True and final["cheetah_cached"] is True
 
     # a config change invalidates exactly the changed leg
     monkeypatch.setitem(bench.MOE_CFG, "moe_capacity_factor", 9.9)
-    bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=runner)
+    bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=runner, device_prober=V5E)
     assert len(calls) == n_first + 1
     assert "mfu_sweep" in " ".join(calls[-1])
 
     # an expired cache re-runs everything
     calls.clear()
-    bench.run_legs(budget_s=1e6, ttl_s=0, runner=runner)
+    bench.run_legs(budget_s=1e6, ttl_s=0, runner=runner, device_prober=V5E)
     assert len(calls) == len(bench.leg_specs())
+
+
+def test_cache_dropped_on_device_kind_mismatch(partial_path, capsys):
+    calls = []
+
+    def runner(argv, timeout):
+        calls.append(argv)
+        return _tpu_runner(argv, timeout)
+
+    bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=runner, device_prober=V5E)
+    n = len(calls)
+
+    # same chip generation → all cached
+    bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=runner, device_prober=V5E)
+    assert len(calls) == n
+
+    # a v6e host must NOT serve v5e numbers: every row re-measures fresh
+    final = bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=runner,
+                           device_prober=lambda: "TPU v6e")
+    assert len(calls) == 2 * n
+    assert "fedavg_cached" not in final
+
+    # unknown kind (wedged tunnel — the insurance case) accepts the cache
+    calls.clear()
+    bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=runner,
+                   device_prober=lambda: None)
+    assert not calls
 
 
 def test_cpu_results_are_not_cached_and_not_ref_compared(partial_path, capsys):

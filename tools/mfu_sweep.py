@@ -109,6 +109,7 @@ def run_one(cfg: dict) -> None:
         "params_m": round(n_params / 1e6, 1),
         "n_chips": n_chips,
         "mfu": round(tps * fpt / peak, 4),
+        "device_kind": jax.devices()[0].device_kind,
     }
     if n_active != n_params:
         line["params_active_m"] = round(n_active / 1e6, 1)
