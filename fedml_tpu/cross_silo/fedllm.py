@@ -115,12 +115,20 @@ class CheetahClientTrainer(ClientTrainer):
         )
         rng = np.random.RandomState(seed & 0x7FFFFFFF)
 
+        # pad id: losses.PAD_TOKEN is the ONE framework-wide constant — the
+        # nwp loss, eval metrics (ml/losses.py:21, matching the reference's
+        # NWP masking of id 0), and this training mask must all agree, so a
+        # corpus where 0 is a real symbol must remap at ingestion rather
+        # than override here (a train-only knob would silently diverge the
+        # train and eval token sets)
+        from ..ml.losses import PAD_TOKEN
+
         state = self.trainer.state_from_params(self.model_params["params"])
         losses = []
         for _ in range(steps):
             idx = rng.randint(0, max(n, 1), size=batch)
             tok = tokens_all[idx]
-            mask = (tok != 0).astype(np.float32)
+            mask = (tok != PAD_TOKEN).astype(np.float32)
             state, metrics = self.trainer.train_step(
                 state, jnp.asarray(tok), jnp.asarray(mask)
             )

@@ -103,38 +103,42 @@ class MoEFeedForward(nn.Module):
         mean_prob = probs.mean(0)
         aux_loss = E * jnp.sum(frac * mean_prob)
 
-        def slots(oh, idx, offset_per_expert):
-            """Per-token capacity slot + keep mask (GShard ordering), without
-            materialising any [T, E, C] tensor: the r3 one-hot dispatch
-            einsum was O(T·E·C) memory (≈10 GB fp32 at the flagship's
-            T=16k, E=8 — it cannot even allocate single-chip), while the
-            cumsum here is O(T·E) and the buffers O(E·C·D)."""
-            pos_in = jnp.cumsum(oh, axis=0) - oh  # prior same-expert tokens
-            off = jnp.sum(oh * offset_per_expert[None, :], axis=-1)
-            pos = (jnp.sum(pos_in * oh, axis=-1) + off).astype(jnp.int32)
-            keep = pos < capacity
-            # flat destination in the [E*C] buffer; dropped tokens write the
-            # sentinel row E*C (sliced off below)
-            dst = jnp.where(keep, idx * capacity + pos, E * capacity)
-            return dst, keep
-
-        dst1, keep1 = slots(one_hot, expert_idx, jnp.zeros((E,), jnp.float32))
-        xt_c = xt.astype(cfg.dtype)
-        # scatter dispatch: destinations are unique across choices (GShard
-        # ordering — second-choice slots start after ALL first-choice claims
-        # on that expert), so the adds never collide
-        buf = jnp.zeros((E * capacity + 1, D), cfg.dtype).at[dst1].add(xt_c)
+        # -- sort-based grouped dispatch (r5; VERDICT r4 #4) ----------------
+        # The r4 path scatter-added token rows into the [E·C, D] buffer —
+        # two row-scatters of [T, D] per layer, which TPUs serialize; MoE
+        # measured 40.1% MFU vs the 75.8% dense bar. Sorting the (up to) k·T
+        # assignments by expert makes every group contiguous, so dispatch,
+        # combine, and un-sort are all row-GATHERS (MXU-friendly), with the
+        # only scatters left the unavoidable ones autodiff inserts for the
+        # gather transposes in backward. Priority semantics are unchanged
+        # from GShard: the flat assignment order is (all first choices in
+        # token order, then all second choices), and the stable sort
+        # preserves it within each expert group, so over capacity second
+        # choices drop before first and later tokens before earlier —
+        # byte-identical keep sets to the r4 cumsum dispatch.
+        kT = top_k * T
         if top_k == 2:
-            dst2, keep2 = slots(one_hot2, idx2, one_hot.sum(0))
-            buf = buf.at[dst2].add(xt_c)
-            # renormalised pair gates (Mixtral: softmax over the chosen two)
-            denom = jnp.maximum(expert_prob + prob2, 1e-9)
-            gate1 = (expert_prob / denom) * keep1
-            gate2 = (prob2 / denom) * keep2
+            flat_expert = jnp.concatenate([expert_idx, idx2]).astype(jnp.int32)
         else:
-            gate1 = expert_prob * keep1
-            gate2 = None
-        expert_in = buf[: E * capacity].reshape(E, capacity, D)
+            flat_expert = expert_idx.astype(jnp.int32)
+        order = jnp.argsort(flat_expert, stable=True)      # [kT]
+        sorted_expert = flat_expert[order]
+        sorted_token = (order % T).astype(jnp.int32)       # assignment → token
+        counts = jnp.bincount(flat_expert, length=E)       # [E]
+        group_start = (jnp.cumsum(counts) - counts).astype(jnp.int32)
+        pos_sorted = jnp.arange(kT, dtype=jnp.int32) - group_start[sorted_expert]
+        keep_sorted = pos_sorted < capacity
+
+        xt_c = xt.astype(cfg.dtype)
+        # dispatch: slot (e, c) is filled by sorted assignment
+        # group_start[e] + c when c < counts[e]; one gather, no scatter
+        slot_src = group_start[:, None] + jnp.arange(capacity,
+                                                     dtype=jnp.int32)[None, :]
+        slot_valid = jnp.arange(capacity)[None, :] < counts[:, None]  # [E, C]
+        tok_for_slot = sorted_token[jnp.clip(slot_src, 0, kT - 1)]
+        expert_in = jnp.where(
+            slot_valid[..., None], xt_c[tok_for_slot], 0
+        )  # [E, C, D]
 
         def ffn(gu_w, down_w, h):
             gu = jnp.einsum("cd,df->cf", h, gu_w.astype(cfg.dtype))
@@ -145,15 +149,30 @@ class MoEFeedForward(nn.Module):
 
         expert_out = jax.vmap(ffn)(w_gate_up, w_down, expert_in)  # [E, C, D]
 
-        # combine: gather each token's slot back, scaled by the
-        # (re)normalised router gates; dropped tokens (gate masked to 0)
-        # contribute nothing and pass through the residual unchanged
-        flat_out = jnp.concatenate(
-            [expert_out.reshape(E * capacity, D),
-             jnp.zeros((1, D), expert_out.dtype)], axis=0
+        # combine: gather each sorted assignment's slot output, un-sort via
+        # the inverse permutation (another gather), and gate-weight per
+        # choice; dropped assignments (keep=0) contribute nothing and pass
+        # through the residual unchanged
+        flat_out = expert_out.reshape(E * capacity, D)
+        slot_of_sorted = jnp.clip(
+            sorted_expert * capacity + pos_sorted, 0, E * capacity - 1
         )
-        y32 = flat_out[dst1].astype(jnp.float32) * gate1[:, None]
-        if gate2 is not None:
-            y32 = y32 + flat_out[dst2].astype(jnp.float32) * gate2[:, None]
+        out_sorted = (
+            flat_out[slot_of_sorted].astype(jnp.float32)
+            * keep_sorted[:, None]
+        )  # [kT, D]
+        inv = jnp.argsort(order, stable=True)
+        out_flat = out_sorted[inv]          # original assignment order
+        keep_flat = keep_sorted[inv]
+        if top_k == 2:
+            keep1, keep2 = keep_flat[:T], keep_flat[T:]
+            # renormalised pair gates (Mixtral: softmax over the chosen two)
+            denom = jnp.maximum(expert_prob + prob2, 1e-9)
+            gate1 = (expert_prob / denom) * keep1
+            gate2 = (prob2 / denom) * keep2
+            y32 = out_flat[:T] * gate1[:, None] + out_flat[T:] * gate2[:, None]
+        else:
+            gate1 = expert_prob * keep_flat
+            y32 = out_flat * gate1[:, None]
         y = y32.astype(cfg.dtype)
         return y.reshape(B, L, D), aux_loss

@@ -342,14 +342,30 @@ class PipelineCheetah:
                 jnp.where(f_valid > 0, fwd_buf, cur),
                 slot_f, 0,
             )
-            # ---- last stage: loss grads for THIS microbatch, immediately
-            lval, (g_norm, g_head, dy_loss) = jax.value_and_grad(
-                loss_sum_fn, argnums=(0, 1, 2)
-            )(params["norm_f"], params["head"], y, tok_f, msk_f)
-            w_last = is_last.astype(jnp.float32) * f_valid
-            loss_sum = loss_sum + lval * w_last
-            g["norm_f"] = g["norm_f"] + g_norm * w_last
-            g["head"] = g["head"] + g_head * w_last
+            # ---- last stage: loss grads for THIS microbatch, immediately.
+            # Gated with lax.cond (r4 ADVICE): ungated, the [mb,L,D]x[D,V]
+            # head fwd+bwd ran on EVERY tick of EVERY stage and was masked
+            # after the fact — M+2(S-1) head matmul pairs per step per
+            # stage vs the M the last stage needs, a real tax at vocab 32k.
+            def head_grads(ops):
+                p_norm, p_head, y_, tok_, msk_ = ops
+                lval, (g_norm, g_head, dy_loss) = jax.value_and_grad(
+                    loss_sum_fn, argnums=(0, 1, 2)
+                )(p_norm, p_head, y_, tok_, msk_)
+                return lval, g_norm, g_head, dy_loss
+
+            def head_skip(ops):
+                p_norm, p_head, y_, _tok, _msk = ops
+                return (jnp.zeros(()), jnp.zeros_like(p_norm),
+                        jnp.zeros_like(p_head), jnp.zeros_like(y_))
+
+            lval, g_norm, g_head, dy_loss = jax.lax.cond(
+                is_last & (f_valid > 0), head_grads, head_skip,
+                (params["norm_f"], params["head"], y, tok_f, msk_f),
+            )
+            loss_sum = loss_sum + lval
+            g["norm_f"] = g["norm_f"] + g_norm
+            g["head"] = g["head"] + g_head
             # ---- backward of microbatch m_b = t - 2(S-1) + stage
             m_b = t - 2 * (S - 1) + stage
             b_valid = ((m_b >= 0) & (m_b < M)).astype(jnp.float32)

@@ -98,6 +98,56 @@ class TestTop2Routing:
         assert y.shape == x.shape and np.isfinite(float(aux))
         assert np.isfinite(np.asarray(y, np.float32)).all()
 
+    def test_tight_capacity_matches_priority_oracle(self):
+        """At overflowing capacity the kept set follows GShard priority —
+        per expert: first choices (in token order), then second choices;
+        everything past C drops. Pinned against a python oracle so the r5
+        sort-based dispatch provably preserves the r4 cumsum semantics."""
+        from fedml_tpu.parallel.moe import MoEFeedForward
+
+        T, E, C = 8, 4, 2
+        cfg = moe_cfg(moe_top_k=2, moe_capacity_factor=float(0.5))  # C=2
+        layer = MoEFeedForward(cfg)
+        x = jax.random.normal(jax.random.PRNGKey(11), (1, T, 64), jnp.float32)
+        variables = layer.init(jax.random.PRNGKey(12), x)
+        (y, _aux), _ = layer.apply(variables, x, mutable=["intermediates"])
+
+        p = jax.tree.map(
+            lambda t: t.value if hasattr(t, "value") else t,
+            variables["params"], is_leaf=lambda t: hasattr(t, "value"),
+        )
+        xt = np.asarray(x.reshape(T, 64), np.float32)
+        probs = np.asarray(
+            jax.nn.softmax(jnp.asarray(xt) @ p["w_router"], axis=-1)
+        )
+        e1 = probs.argmax(-1)
+        probs2 = probs.copy()
+        probs2[np.arange(T), e1] = 0
+        e2 = probs2.argmax(-1)
+        # assignment priority order: all first choices, then all seconds
+        load = {e: 0 for e in range(E)}
+        kept = set()
+        for j, e in enumerate(np.concatenate([e1, e2])):
+            if load[int(e)] < C:
+                kept.add(j)
+                load[int(e)] += 1
+        want = np.zeros_like(xt)
+        for t in range(T):
+            g1, g2 = probs[t, e1[t]], probs2[t, e2[t]]
+            denom = g1 + g2
+            for j, (gate, e) in ((t, (g1 / denom, e1[t])),
+                                 (T + t, (g2 / denom, e2[t]))):
+                if j not in kept:
+                    continue
+                gu = xt[t] @ np.asarray(p["w_gate_up"][e], np.float32)
+                gate_h, up = np.split(gu, 2)
+                h = (gate_h / (1 + np.exp(-gate_h))) * up
+                want[t] += gate * (h @ np.asarray(p["w_down"][e], np.float32))
+        np.testing.assert_allclose(
+            np.asarray(y.reshape(T, 64), np.float32), want,
+            rtol=2e-2, atol=2e-3,
+        )
+
     def test_top2_trains(self):
         cfg = moe_cfg(moe_top_k=2)
         mesh = make_mesh({"fsdp": 1}, devices=jax.devices()[:1])
