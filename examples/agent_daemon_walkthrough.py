@@ -1,0 +1,54 @@
+"""Agent-daemon walkthrough: build → submit → claim → run → status FSM.
+
+The deployment plane end-to-end on one host (reference:
+``cli/edge_deployment/client_runner.py`` + daemons — there the queue is the
+MLOps MQTT broker; here it is a directory both submitter and agent see,
+which is what a TPU pod actually shares):
+
+1. ``fedml_tpu build`` packages a training entry point;
+2. ``submit_job`` drops it into the job queue (atomic descriptor publish);
+3. an ``Agent`` claims it (atomic rename — safe with many agents), unpacks,
+   runs the entry point as a subprocess, and appends every status
+   transition to ``status.jsonl`` (IDLE → UPGRADING → INITIALIZING →
+   TRAINING → FINISHED, the reference's client_constants FSM).
+"""
+
+# run-from-checkout shim: make the repo importable without `pip install -e .`
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
+
+import json
+import os
+import tempfile
+
+from fedml_tpu.agent import Agent, agent_state, login, submit_job
+from fedml_tpu.cli import main as cli_main
+
+root = tempfile.mkdtemp(prefix="agent-demo-")
+src = os.path.join(root, "src")
+os.makedirs(src)
+with open(os.path.join(src, "train.py"), "w") as f:
+    f.write("print('hello from the federated job')\n")
+
+# 1. build the package (the `fedml_tpu build` CLI)
+pkg = os.path.join(root, "pkg.zip")
+rc = cli_main(["build", "-sf", src, "-ep", "train.py", "-o", pkg])
+assert rc == 0, "build failed"
+
+# 2. bind this host as an edge device (local state, reference `fedml login`)
+state_dir = os.path.join(root, "state")
+login("acct-42", role="client", state_dir=state_dir)
+print("agent state:", agent_state(state_dir))
+
+# 3. submit into the shared-directory queue + run one agent cycle
+jobs = os.path.join(root, "jobs")
+job_id = submit_job(pkg, jobs)
+agent = Agent(jobs_dir=jobs, work_dir=os.path.join(root, "work"))
+result = agent.run_once()
+assert result is not None and result.job_id == job_id
+
+# 4. the observable status FSM (work_dir/status.jsonl)
+transitions = agent.job_statuses(job_id)
+print("job", job_id, "→", " → ".join(transitions))
+assert transitions[-1] == "FINISHED", transitions
+print("agent walkthrough ok")

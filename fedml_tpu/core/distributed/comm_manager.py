@@ -152,6 +152,12 @@ class FedMLCommManager(Observer):
                 world_size=self.size,
                 ip_config_path=str(getattr(self.args, "grpc_ipconfig_path", "")),
                 base_port=base_port,
+                # TRPC-role fast path (tensor_transport.py): raw zero-copy
+                # frames + chunked streaming for bulk tensors
+                wire_format=str(getattr(self.args, "grpc_wire_format", "npz")),
+                stream_threshold_bytes=int(getattr(
+                    self.args, "grpc_stream_threshold_bytes", 8 * 1024 * 1024
+                )),
             )
         elif self.backend == constants.COMM_BACKEND_MQTT:
             from .mqtt_backend import MqttCommManager

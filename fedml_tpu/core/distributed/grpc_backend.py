@@ -55,10 +55,17 @@ class GRPCCommManager(BaseCommunicationManager):
         ip_config: Optional[Dict[int, str]] = None,
         ip_config_path: str = "",
         base_port: int = CommunicationConstants.GRPC_BASE_PORT,
+        wire_format: str = "npz",
+        stream_threshold_bytes: int = 8 * 1024 * 1024,
     ):
         self.rank = int(rank)
         self.world_size = int(world_size)
         self.base_port = int(base_port)
+        # "raw" = the direct-tensor frame format (tensor_transport.py), the
+        # TRPC-role fast path: zero-copy decode + chunked streaming for
+        # payloads past stream_threshold_bytes (no monolithic gRPC buffer)
+        self.wire_format = str(wire_format)
+        self.stream_threshold = int(stream_threshold_bytes)
         if ip_config is None and ip_config_path:
             ip_config = load_ip_config(ip_config_path)
         self.ip_config = ip_config or {i: "127.0.0.1" for i in range(world_size)}
@@ -67,10 +74,15 @@ class GRPCCommManager(BaseCommunicationManager):
         self._running = False
         self._channels: Dict[int, grpc.Channel] = {}
         self._stubs: Dict[int, grpc.UnaryUnaryMultiCallable] = {}
+        self._stream_stubs: Dict[int, grpc.StreamUnaryMultiCallable] = {}
         self._lock = threading.Lock()
 
         def handle_send(request: bytes, context) -> bytes:
             self._queue.put(request)
+            return b"ok"
+
+        def handle_send_stream(request_iter, context) -> bytes:
+            self._queue.put(b"".join(request_iter))
             return b"ok"
 
         handlers = grpc.method_handlers_generic_handler(
@@ -80,7 +92,12 @@ class GRPCCommManager(BaseCommunicationManager):
                     handle_send,
                     request_deserializer=None,  # raw bytes through
                     response_serializer=None,
-                )
+                ),
+                "SendStream": grpc.stream_unary_rpc_method_handler(
+                    handle_send_stream,
+                    request_deserializer=None,
+                    response_serializer=None,
+                ),
             },
         )
         self._server = grpc.server(
@@ -95,21 +112,42 @@ class GRPCCommManager(BaseCommunicationManager):
         self._server.start()
         logger.info("grpc backend: rank %d serving at %s", rank, bind)
 
+    def _ensure_channel(self, receiver_id: int) -> None:
+        if receiver_id not in self._stubs:
+            target = (
+                f"{self.ip_config[receiver_id]}:{self.base_port + receiver_id}"
+            )
+            ch = grpc.insecure_channel(target, options=_GRPC_OPTIONS)
+            self._channels[receiver_id] = ch
+            self._stubs[receiver_id] = ch.unary_unary(
+                _METHOD, request_serializer=None, response_deserializer=None
+            )
+            self._stream_stubs[receiver_id] = ch.stream_unary(
+                f"/{_SERVICE}/SendStream",
+                request_serializer=None, response_deserializer=None,
+            )
+
     def _stub(self, receiver_id: int) -> grpc.UnaryUnaryMultiCallable:
         with self._lock:
-            if receiver_id not in self._stubs:
-                target = (
-                    f"{self.ip_config[receiver_id]}:{self.base_port + receiver_id}"
-                )
-                ch = grpc.insecure_channel(target, options=_GRPC_OPTIONS)
-                self._channels[receiver_id] = ch
-                self._stubs[receiver_id] = ch.unary_unary(
-                    _METHOD, request_serializer=None, response_deserializer=None
-                )
+            self._ensure_channel(receiver_id)
             return self._stubs[receiver_id]
 
+    def _stream_stub(self, receiver_id: int) -> grpc.StreamUnaryMultiCallable:
+        with self._lock:
+            self._ensure_channel(receiver_id)
+            return self._stream_stubs[receiver_id]
+
     def send_message(self, msg: Message) -> None:
-        self._stub(msg.get_receiver_id())(msg.serialize(), timeout=300)
+        msg.wire_format = self.wire_format
+        payload = msg.serialize()
+        if len(payload) > self.stream_threshold:
+            from .tensor_transport import iter_chunks
+
+            self._stream_stub(msg.get_receiver_id())(
+                iter_chunks(payload), timeout=300
+            )
+        else:
+            self._stub(msg.get_receiver_id())(payload, timeout=300)
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)

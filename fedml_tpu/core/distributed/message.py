@@ -74,14 +74,23 @@ class Message:
         return self.arrays
 
     # -- wire format ---------------------------------------------------------
+    # "npz" (default, self-describing zip) or "raw" — the direct-tensor
+    # frame format (tensor_transport.py): one encode copy, ZERO-copy decode
+    # views. deserialize() sniffs the body magic, so mixed-format worlds
+    # interoperate (npz bodies start with the zip magic "PK").
+    wire_format = "npz"
+
     def serialize(self) -> bytes:
         header = json.dumps(self.msg_params).encode("utf-8")
+        prefix = [len(header).to_bytes(4, "big"), header]
+        if self.wire_format == "raw" and self.arrays:
+            from .tensor_transport import encode_frame_parts
+
+            # single-pass assembly: one join over prefix + frame pieces
+            return b"".join(prefix + encode_frame_parts(self.arrays))
         buf = io.BytesIO()
         np.savez(buf, *self.arrays)
-        body = buf.getvalue()
-        return (
-            len(header).to_bytes(4, "big") + header + body
-        )
+        return b"".join(prefix + [buf.getvalue()])
 
     @staticmethod
     def deserialize(data: bytes) -> "Message":
@@ -89,10 +98,15 @@ class Message:
         header = json.loads(data[4 : 4 + hlen].decode("utf-8"))
         msg = Message()
         msg.init(header)
-        body = data[4 + hlen :]
-        if body:
-            with np.load(io.BytesIO(body)) as z:
-                msg.arrays = [z[k] for k in z.files]
+        body = memoryview(data)[4 + hlen:]
+        if len(body):
+            from .tensor_transport import decode_frames, is_raw_body
+
+            if is_raw_body(body):
+                msg.arrays = decode_frames(body)
+            else:
+                with np.load(io.BytesIO(bytes(body))) as z:
+                    msg.arrays = [z[k] for k in z.files]
         return msg
 
     def __repr__(self) -> str:  # pragma: no cover

@@ -1,0 +1,92 @@
+"""Direct-tensor wire format — the TRPC-role transport (r4 VERDICT #10).
+
+reference: ``core/distributed/communication/trpc/trpc_comm_manager.py:25-176``
+— PyTorch TensorPipe RPC with ``set_device_map`` so tensors move
+device-to-device without host serialization. A TPU pod has no CUDA-direct
+DCN path (cross-host device transfer is the XLA collectives' job over
+ICI/DCN meshes), so the role this module covers is the part that remains on
+the FL message plane: moving LARGE host tensors between processes with as
+few copies and codec passes as possible.
+
+The default ``Message`` body is an npz (a zip container): every array is
+deflate-scanned and copied through the zip writer, and ``np.load`` copies
+again on read. The RAW frame format here writes one JSON header
+(dtype/shape per tensor) plus the tensors' raw bytes, and decodes to
+ZERO-COPY numpy views over the received buffer — the receive path does no
+per-element work at all. ``fedml_tpu.Comm/SendStream`` (grpc_backend)
+streams these bodies in bounded chunks so a GB-scale weight blob never
+needs a single contiguous gRPC message buffer — the pinned-host-staging
+analog. Measured by ``tools/bench_tensor_transport.py`` →
+``TENSOR_TRANSPORT_BENCH.json``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Sequence, Union
+
+import numpy as np
+
+RAW_MAGIC = b"FTT1"
+
+
+def encode_frame_parts(arrays: Sequence[np.ndarray]) -> List[bytes]:
+    """[arrays] → the body PIECES [RAW_MAGIC, u32 header_len, JSON header,
+    frame, frame, ...] — callers join them together with their own prefix
+    so the whole wire payload is assembled in ONE pass (Message.serialize
+    does exactly that; a naive encode-then-concat would copy a GB-scale
+    blob twice).
+
+    No alignment padding: the body rides behind a variable-length message
+    prefix anyway, so in-body alignment cannot survive to the receive
+    buffer — numpy accepts unaligned views (ALIGNED=False)."""
+    metas = []
+    frames: List[bytes] = []
+    off = 0
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        metas.append({"dtype": a.dtype.str, "shape": list(a.shape),
+                      "off": off})
+        frames.append(a.tobytes())  # the single data copy on encode
+        off += a.nbytes
+    header = json.dumps(metas).encode("utf-8")
+    return [RAW_MAGIC, len(header).to_bytes(4, "big"), header, *frames]
+
+
+def encode_frames(arrays: Sequence[np.ndarray]) -> bytes:
+    """Standalone body: the joined :func:`encode_frame_parts`."""
+    return b"".join(encode_frame_parts(arrays))
+
+
+def decode_frames(buf: Union[bytes, memoryview]) -> List[np.ndarray]:
+    """RAW body → list of ZERO-COPY numpy views over ``buf``.
+
+    The views are read-only (the buffer is immutable bytes); consumers that
+    mutate must copy — FL aggregation stacks/averages into fresh arrays
+    anyway, so the hot path never pays a receive-side copy."""
+    view = memoryview(buf)
+    if view[:4] != RAW_MAGIC:
+        raise ValueError("not a raw tensor-frame body")
+    hlen = int.from_bytes(view[4:8], "big")
+    metas = json.loads(bytes(view[8:8 + hlen]).decode("utf-8"))
+    base = 8 + hlen
+    out = []
+    for m in metas:
+        dt = np.dtype(m["dtype"])
+        n = int(np.prod(m["shape"])) if m["shape"] else 1
+        start = base + int(m["off"])
+        frame = view[start:start + n * dt.itemsize]
+        out.append(np.frombuffer(frame, dtype=dt).reshape(m["shape"]))
+    return out
+
+
+def is_raw_body(body: Union[bytes, memoryview]) -> bool:
+    return bytes(body[:4]) == RAW_MAGIC
+
+
+def iter_chunks(payload: Union[bytes, memoryview],
+                chunk_bytes: int = 4 * 1024 * 1024):
+    """Bounded-size chunks for the streaming RPC (no monolithic buffer)."""
+    view = memoryview(payload)
+    for i in range(0, len(view), chunk_bytes):
+        yield bytes(view[i:i + chunk_bytes])

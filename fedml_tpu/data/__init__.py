@@ -64,6 +64,15 @@ def _try_natural_partition(name: str, cache_dir: str, spec: DatasetSpec):
         from .real_readers import try_load_imagenet
 
         return try_load_imagenet(cache_dir, image_hw=spec.sample_shape[:2])
+    if spec.task == "detection" and spec.sample_shape[0] >= 128:
+        # real-resolution detection keys read staged COCO-format data
+        # (annotations json + images dir); synthetic fallback otherwise
+        from .real_readers import try_load_coco_detection
+
+        return try_load_coco_detection(
+            cache_dir, image_hw=spec.sample_shape[:2],
+            num_classes=spec.class_num,
+        )
     if name in ("gld23k", "gld160k"):
         from .real_readers import try_load_landmarks
 

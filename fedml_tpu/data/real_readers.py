@@ -369,3 +369,148 @@ def try_load_landmarks(cache_dir: str, name: str = "gld23k",
     logger.info("%s: %d user-clients, %d test images from %s",
                 name, len(client_xs), len(test_x), base)
     return client_xs, client_ys, test_x, test_y
+
+
+# ---------------------------------------------------------------------------
+# COCO-format detection (reference: python/app/fedcv/object_detection — the
+# YOLOv5 task trains from COCO-layout datasets, data/coco128.yaml +
+# coco128/{images,labels}; the canonical interchange format is the
+# annotations-JSON + images-dir pair read here)
+# ---------------------------------------------------------------------------
+
+
+def _coco_dense_target(boxes, cats, src_hw, out_hw, num_classes, stride=4):
+    """Encode COCO boxes ([x, y, w, h] in source pixels) as the dense
+    CenterNet-style grid ``models/detection.py`` trains on: per-cell one-hot
+    class heatmap ++ normalized (h, w) ++ center mask — the SAME layout
+    ``datasets.synth_detection`` emits, so loss/eval/decode are shared."""
+    H, W = out_hw
+    Hs, Ws = H // stride, W // stride
+    y = np.zeros((Hs, Ws, num_classes + 3), np.float32)
+    sh, sw = H / max(src_hw[0], 1), W / max(src_hw[1], 1)
+    for (bx, by, bw, bh), c in zip(boxes, cats):
+        if not 0 <= c < num_classes:
+            continue
+        cy = int((by + bh / 2) * sh) // stride
+        cx = int((bx + bw / 2) * sw) // stride
+        cy = min(max(cy, 0), Hs - 1)
+        cx = min(max(cx, 0), Ws - 1)
+        y[cy, cx, :num_classes] = 0.0
+        y[cy, cx, c] = 1.0
+        y[cy, cx, num_classes:num_classes + 2] = (bh * sh / H, bw * sw / W)
+        y[cy, cx, -1] = 1.0
+    return y
+
+
+def try_load_coco_detection(cache_dir: str,
+                            image_hw: Tuple[int, int] = (224, 224),
+                            num_classes: int = 6,
+                            max_per_client: int = 128,
+                            max_test: int = 512):
+    """COCO-format detection: ``annotations/instances_*.json`` + image dirs.
+
+    Layout searched under ``cache_dir/{coco,coco128,COCO}``: the standard
+    ``annotations/instances_train*.json`` (+ ``instances_val*.json``), with
+    each image's ``file_name`` resolved against the split dir, ``images/``,
+    or the root. Category ids (sparse in COCO) map to contiguous classes in
+    sorted order; boxes beyond ``num_classes`` categories are skipped
+    (logged). Natural partition: one client per DOMINANT category of the
+    image — detection's analog of the ImageNet reader's class-clients (the
+    reference partitions COCO across clients by label distribution too).
+    Targets are dense stride-4 grids (:func:`_coco_dense_target`)."""
+    root = None
+    for sub in ("coco", "coco128", "COCO"):
+        p = os.path.join(cache_dir, sub)
+        if os.path.isdir(os.path.join(p, "annotations")):
+            root = p
+            break
+    if root is None:
+        return None
+    ann_dir = os.path.join(root, "annotations")
+
+    def find_ann(kind):
+        cands = sorted(
+            f for f in os.listdir(ann_dir)
+            if f.startswith(f"instances_{kind}") and f.endswith(".json")
+        )
+        return os.path.join(ann_dir, cands[0]) if cands else None
+
+    train_json = find_ann("train")
+    if train_json is None:
+        return None
+
+    def load_split(path, bound, what):
+        with open(path) as f:
+            blob = json.load(f)
+        cat_ids = sorted(c["id"] for c in blob.get("categories", []))
+        cat_map = {cid: i for i, cid in enumerate(cat_ids)}
+        skipped = sum(1 for cid in cat_ids if cat_map[cid] >= num_classes)
+        if skipped:
+            logger.warning(
+                "coco %s: %d categories beyond num_classes=%d skipped",
+                what, skipped, num_classes,
+            )
+        per_img: Dict[int, Dict] = {
+            im["id"]: {"meta": im, "boxes": [], "cats": []}
+            for im in blob.get("images", [])
+        }
+        for a in blob.get("annotations", []):
+            rec = per_img.get(a.get("image_id"))
+            c = cat_map.get(a.get("category_id"), -1)
+            if rec is not None and 0 <= c < num_classes:
+                rec["boxes"].append([float(v) for v in a["bbox"]])
+                rec["cats"].append(c)
+        split_dir = os.path.splitext(os.path.basename(path))[0].replace(
+            "instances_", ""
+        )
+        xs, ys, dom = [], [], []
+        n_boxes = 0
+        for rec in per_img.values():
+            if len(xs) >= bound:
+                logger.warning("coco %s: capped at %d images", what, bound)
+                break
+            if not rec["boxes"]:
+                continue  # unannotated images train nothing here
+            img_path = None
+            for sub in (split_dir, "images", "."):
+                p = os.path.join(root, sub, rec["meta"]["file_name"])
+                if os.path.isfile(p):
+                    img_path = p
+                    break
+            if img_path is None:
+                continue
+            src_hw = (int(rec["meta"].get("height", 0) or 0),
+                      int(rec["meta"].get("width", 0) or 0))
+            if src_hw[0] <= 0 or src_hw[1] <= 0:
+                from PIL import Image
+
+                with Image.open(img_path) as im:
+                    src_hw = (im.height, im.width)
+            xs.append(_read_image(img_path, image_hw))
+            ys.append(_coco_dense_target(rec["boxes"], rec["cats"], src_hw,
+                                         image_hw, num_classes))
+            dom.append(int(np.bincount(rec["cats"]).argmax()))
+            n_boxes += len(rec["boxes"])
+        logger.info("coco %s: %d images, %d boxes", what, len(xs), n_boxes)
+        return xs, ys, dom
+
+    xs, ys, dom = load_split(train_json, max_per_client * num_classes,
+                             "train")
+    if not xs:
+        return None
+    client_xs, client_ys = [], []
+    for c in sorted(set(dom)):
+        idx = [i for i, d in enumerate(dom) if d == c][:max_per_client]
+        client_xs.append(np.stack([xs[i] for i in idx]))
+        client_ys.append(np.stack([ys[i] for i in idx]))
+
+    val_json = find_ann("val")
+    if val_json is not None:
+        txs, tys, _ = load_split(val_json, max_test, "val")
+    else:
+        txs, tys = [], []
+    test_x = np.stack(txs) if txs else client_xs[0][:0]
+    test_y = np.stack(tys) if tys else client_ys[0][:0]
+    logger.info("coco: %d dominant-category clients, %d val images from %s",
+                len(client_xs), len(test_x), root)
+    return client_xs, client_ys, test_x, test_y

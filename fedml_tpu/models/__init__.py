@@ -36,6 +36,16 @@ from .vision import (
 
 logger = logging.getLogger(__name__)
 
+# model names whose forward is convolution-dominated (cohort-impl heuristic)
+CONV_MODEL_FAMILIES = frozenset((
+    "cnn", "cnn_dropout", "cnn_web", "resnet18_gn", "resnet18", "resnet20",
+    "resnet56", "mobilenet", "mobilenet_v1", "mobilenet_v2", "mobilenet_v3",
+    "mobilenet_v3_small", "vgg11", "vgg16", "vgg", "efficientnet",
+    "efficientnet_b0", "efficientnet-b0", "fcn", "deeplab", "deeplabv3_plus",
+    "unet", "darts", "darts_search", "centernet", "centernet_lite", "yolo",
+    "detector", "dcgan", "gan",
+))
+
 __all__ = ["create", "ModelBundle"]
 
 
@@ -203,5 +213,9 @@ def create(args, output_dim: int) -> ModelBundle:
         task=task,
         meta={"dataset": dataset, "output_dim": output_dim},
     )
+    # convolutional families: consumed by the sp engine's cohort-impl
+    # heuristic (XLA:CPU lowers VMAPPED convs pathologically; lr/mlp on
+    # image datasets must NOT be demoted to lax.map by shape alone)
+    bundle.conv_model = name in CONV_MODEL_FAMILIES
     logger.info("model: %s for %s (output_dim=%d)", name, dataset, output_dim)
     return bundle

@@ -45,6 +45,9 @@ class MeshFedAvgAPI(FedAvgAPI):
     # cohorts are host-gathered and placed sharded over the mesh — the
     # single-device HBM-resident fast path must not allocate in __init__
     hbm_resident_default = False
+    # the cohort axis is SHARDED over devices: lax.map would serialize the
+    # whole mesh onto one program — vmap is structural here
+    cohort_impl_default = "vmap"
 
     def __init__(self, args, device, dataset, model, client_trainer=None,
                  server_aggregator=None):

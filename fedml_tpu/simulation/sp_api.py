@@ -68,6 +68,15 @@ class FedAvgAPI:
     # so __init__ never parks a dead dataset copy in device-0 HBM
     hbm_resident_default = True
 
+    # cohort execution: "vmap" fuses the round into one batched program (the
+    # TPU design); "map" runs clients sequentially under lax.map — identical
+    # math, same stacked outputs. "auto" picks map ONLY for conv models on
+    # XLA:CPU, where vmapped convs lower to a grouped-conv path ~100x slower
+    # than the plain conv (measured: resnet56 compiles >60 min and the
+    # same-substrate cnn leg ran 0.01x; lax.map keeps each conv un-grouped).
+    # The mesh engine pins vmap — its cohort axis is SHARDED over devices.
+    cohort_impl_default = "auto"
+
     @staticmethod
     def _hbm_budget() -> int:
         try:
@@ -98,13 +107,55 @@ class FedAvgAPI:
         self.fednova = self.opt_name == constants.FEDML_FEDERATED_OPTIMIZER_FEDNOVA
 
         cap = self.ds.cap
+        impl = str(
+            getattr(args, "sp_cohort_impl", "") or self.cohort_impl_default
+        ).lower()
+        if self.cohort_impl_default == "vmap" and impl != "vmap":
+            # mesh engine: the cohort axis is SHARDED over devices — lax.map
+            # would silently serialize the whole pod onto one program
+            logger.warning(
+                "sp_cohort_impl=%r ignored: this engine requires vmap "
+                "(cohort axis sharded over devices)", impl,
+            )
+            impl = "vmap"
+        if impl == "auto":
+            conv_model = bool(getattr(model, "conv_model", False))
+            on_cpu = jax.devices()[0].platform == "cpu"
+            impl = "map" if (conv_model and on_cpu) else "vmap"
+        if impl not in ("vmap", "map"):
+            raise ValueError(f"sp_cohort_impl must be vmap|map|auto, got {impl!r}")
+        if impl == "map":
+            logger.info("sp engine: lax.map cohort (conv-on-CPU fallback)")
         if self.fedsgd:
             fn = make_grad_fn(model, args, cap)
-            self.cohort_fn = jax.jit(jax.vmap(fn, in_axes=(None, 0, 0, 0, 0)))
+            if impl == "map":
+                self.cohort_fn = jax.jit(
+                    lambda gp, cx, cy, cn, rngs:
+                    jax.lax.map(lambda o: fn(gp, *o), (cx, cy, cn, rngs))
+                )
+            else:
+                self.cohort_fn = jax.jit(
+                    jax.vmap(fn, in_axes=(None, 0, 0, 0, 0))
+                )
         else:
             fn = make_local_train_fn(model, args, cap, scaffold=self.scaffold)
-            axes = (None, 0, 0, 0, 0) + ((None, 0) if self.scaffold else ())
-            self.cohort_fn = jax.jit(jax.vmap(fn, in_axes=axes))
+            if impl == "map":
+                if self.scaffold:
+                    self.cohort_fn = jax.jit(
+                        lambda gp, cx, cy, cn, rngs, cg, cls:
+                        jax.lax.map(
+                            lambda o: fn(gp, o[0], o[1], o[2], o[3], cg, o[4]),
+                            (cx, cy, cn, rngs, cls),
+                        )
+                    )
+                else:
+                    self.cohort_fn = jax.jit(
+                        lambda gp, cx, cy, cn, rngs:
+                        jax.lax.map(lambda o: fn(gp, *o), (cx, cy, cn, rngs))
+                    )
+            else:
+                axes = (None, 0, 0, 0, 0) + ((None, 0) if self.scaffold else ())
+                self.cohort_fn = jax.jit(jax.vmap(fn, in_axes=axes))
 
         # server optimizer over pseudo-gradients (FedOpt family + FedSGD)
         self.server_opt = None

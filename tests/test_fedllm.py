@@ -217,3 +217,65 @@ def test_fedllm_sharded_silo_mesh():
     assert dict(tr.mesh.shape)["fsdp"] == 4
     assert dict(tr.mesh.shape)["tensor"] == 2
     assert np.isfinite(result["test_loss"])
+
+
+@pytest.mark.slow
+def test_fedllm_100m_scale_transport(tmp_path):
+    """Scale-proof of the FedLLM transport contract (VERDICT r4 #8): a
+    ~115M-param Cheetah federated across 2 silos with the payload store
+    carrying the weights and UpdateCodec (8-bit quantize) shrinking the C2S
+    delta. Asserts bulk bytes never ride the control channel and the
+    encoded update is a fraction of the raw fp32 params."""
+    from fedml_tpu.core.compression import UpdateCodec
+    from fedml_tpu.core.distributed.loopback import LoopbackCommManager
+
+    wire_sizes = []
+    orig_send = LoopbackCommManager.send_message
+
+    def spy_send(self, msg):
+        wire_sizes.append(len(msg.serialize()))
+        return orig_send(self, msg)
+
+    encoded_ratios = []
+    orig_encode = UpdateCodec.encode
+
+    def spy_encode(self, gvec, vec, round_idx=0):
+        arrays, meta = orig_encode(self, gvec, vec, round_idx)
+        raw = int(np.asarray(vec).nbytes)
+        enc = sum(int(np.asarray(a).nbytes) for a in arrays)
+        encoded_ratios.append(enc / raw)
+        return arrays, meta
+
+    LoopbackCommManager.send_message = spy_send
+    UpdateCodec.encode = spy_encode
+    t0 = time.time()
+    try:
+        result, server, clients = run_world(
+            "scale100m",
+            # ~115M params: d896 x 12L MHA hd112 + SwiGLU ff2368 (the
+            # dataset owns vocab/seq: shakespeare 90 x 80)
+            model_size="mid", d_model=896, n_layers=12, n_heads=8,
+            n_kv_heads=8, d_ff=2368,
+            comm_round=1, local_steps=1, batch_size=8, epochs=1,
+            compression="quantize", quantize_bits=8,
+            payload_store_dir=str(tmp_path), payload_inline_limit_bytes=1 << 20,
+        )
+    finally:
+        LoopbackCommManager.send_message = orig_send
+        UpdateCodec.encode = orig_encode
+    wall = time.time() - t0
+
+    n_params = clients[0].manager.trainer.trainer and sum(
+        int(p.size)
+        for p in jax.tree.leaves(server.manager.global_params)
+    )
+    assert n_params >= 100e6, f"model too small for the claim: {n_params}"
+    assert result is not None and np.isfinite(result["test_loss"])
+    # bulk weights ride the store: every control message stays small
+    assert max(wire_sizes) < (1 << 20), max(wire_sizes)
+    # the C2S delta really shrank: 8-bit quantize ≈ 1/4 of fp32 + scales
+    assert len(encoded_ratios) >= 2  # one per silo
+    assert max(encoded_ratios) < 0.35, encoded_ratios
+    print(f"fedllm-100m: params={n_params/1e6:.1f}M wall={wall:.1f}s "
+          f"wire_max={max(wire_sizes)}B "
+          f"compression={np.mean(encoded_ratios):.3f}x-of-raw")

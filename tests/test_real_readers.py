@@ -121,3 +121,51 @@ def test_unstaged_falls_back_to_synthetic(tmp_path):
         ds, _ = data_mod.load(_args(name, str(tmp_path / "empty"),
                                     client_num_in_total=4))
         assert ds.client_num == 4 and ds.train_data_num > 0
+
+
+def test_coco_detection_reader(staged):
+    """COCO-format annotations json + image dirs (VERDICT r4 #7): sparse
+    category ids remap to contiguous classes, boxes land in the right
+    stride-4 cell of the dense CenterNet target, dominant-category clients
+    form the natural partition."""
+    import json
+
+    cache = staged("coco_det")
+    ds, class_num = data_mod.load(_args("fedcv_det224", cache))
+    assert ds.task == "detection"
+    # images resized to the spec resolution; dense stride-4 targets
+    assert tuple(ds.train_x.shape[2:]) == (224, 224, 3)
+    assert tuple(ds.train_y.shape[2:]) == (56, 56, 6 + 3)
+    assert ds.meta["natural_partition"] is True
+    assert 1 <= ds.client_num <= 3  # one client per dominant category
+    assert ds.test_x.shape[0] == 4  # val2017 fixture images
+
+    # cross-check one annotation against the dense target encoding
+    with open(os.path.join(cache, "coco", "annotations",
+                           "instances_val2017.json")) as f:
+        blob = json.load(f)
+    cat_map = {c["id"]: i for i, c in
+               enumerate(sorted(blob["categories"], key=lambda c: c["id"]))}
+    img0 = blob["images"][0]["id"]
+    anns0 = [a for a in blob["annotations"] if a["image_id"] == img0]
+    ty0 = np.asarray(ds.test_y[0])
+    centers = np.nonzero(ty0[..., -1] > 0.5)
+    assert len(centers[0]) >= 1
+    # every annotated box has its center cell set with its (remapped) class
+    hits = 0
+    for a in anns0:
+        x, y, w, h = a["bbox"]
+        cy = int((y + h / 2) * 224 / 32) // 4
+        cx = int((x + w / 2) * 224 / 32) // 4
+        if ty0[cy, cx, -1] > 0.5 and ty0[cy, cx, cat_map[a["category_id"]]] == 1.0:
+            hits += 1
+    assert hits >= 1
+    # sizes normalized to (0, 1]
+    hw = ty0[..., 6:8][ty0[..., -1] > 0.5]
+    assert (hw > 0).all() and (hw <= 1.0).all()
+
+
+def test_coco_reader_unstaged_falls_back(tmp_path):
+    ds, _ = data_mod.load(_args("fedcv_det224", str(tmp_path),
+                                client_num_in_total=4))
+    assert ds.meta.get("natural_partition") is None  # synthetic path

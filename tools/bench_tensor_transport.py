@@ -1,0 +1,128 @@
+"""Measure the TRPC-role direct-tensor transport vs the npz path (r4 #10).
+
+Three legs, host-only (no jax):
+1. codec: Message.serialize/deserialize with npz vs raw frames;
+2. localhost gRPC: unary npz vs streamed raw for a large tensor
+   (the reference's trpc benchmark analog, ``python/tests/grpc_benchmark``);
+3. decode-aliasing proof: raw decode is zero-copy (views share the buffer).
+
+Writes TENSOR_TRANSPORT_BENCH.json.
+
+Usage: python tools/bench_tensor_transport.py [--mb 256] [--repeats 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+
+
+def bench_codec(arrays, repeats) -> dict:
+    from fedml_tpu.core.distributed.message import Message
+
+    out = {}
+    for fmt in ("npz", "raw"):
+        msg = Message("bench", 1, 2)
+        msg.set_arrays(arrays)
+        msg.wire_format = fmt
+        enc = dec = 1e9
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            payload = msg.serialize()
+            enc = min(enc, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            back = Message.deserialize(payload)
+            dec = min(dec, time.perf_counter() - t0)
+        assert all(
+            np.array_equal(a, b) for a, b in zip(arrays, back.get_arrays())
+        )
+        out[fmt] = {"encode_s": round(enc, 4), "decode_s": round(dec, 4),
+                    "bytes": len(payload)}
+    out["decode_speedup"] = round(
+        out["npz"]["decode_s"] / max(out["raw"]["decode_s"], 1e-9), 1
+    )
+    out["encode_speedup"] = round(
+        out["npz"]["encode_s"] / max(out["raw"]["encode_s"], 1e-9), 1
+    )
+    return out
+
+
+def bench_grpc(arrays, repeats, base_port=29760) -> dict:
+    from fedml_tpu.core.distributed.grpc_backend import GRPCCommManager
+    from fedml_tpu.core.distributed.message import Message
+
+    out = {}
+    for fmt, port_off in (("npz", 0), ("raw", 4)):
+        recv = GRPCCommManager("127.0.0.1", base_port + port_off + 2, rank=2,
+                               world_size=3, base_port=base_port + port_off,
+                               wire_format=fmt)
+        send = GRPCCommManager("127.0.0.1", base_port + port_off + 1, rank=1,
+                               world_size=3, base_port=base_port + port_off,
+                               wire_format=fmt)
+        try:
+            msg = Message("bench", 1, 2)
+            msg.set_arrays(arrays)
+            best = 1e9
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                send.send_message(msg)
+                raw = recv._queue.get(timeout=60)
+                back = Message.deserialize(raw)
+                best = min(best, time.perf_counter() - t0)
+            assert np.array_equal(back.get_arrays()[0], arrays[0])
+            nbytes = sum(a.nbytes for a in arrays)
+            out[fmt] = {
+                "roundtrip_s": round(best, 4),
+                "gbps": round(nbytes * 8 / best / 1e9, 2),
+                "path": "stream" if fmt == "raw" else "unary",
+            }
+        finally:
+            send.stop_receive_message()
+            recv.stop_receive_message()
+    out["speedup"] = round(
+        out["npz"]["roundtrip_s"] / max(out["raw"]["roundtrip_s"], 1e-9), 2
+    )
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=256)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "TENSOR_TRANSPORT_BENCH.json"))
+    a = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    n = a.mb * 1024 * 1024 // 4
+    arrays = [rng.standard_normal(n).astype(np.float32)]
+
+    from fedml_tpu.core.distributed.tensor_transport import (
+        decode_frames, encode_frames,
+    )
+
+    body = encode_frames(arrays)
+    views = decode_frames(body)
+    zero_copy = not views[0].flags["OWNDATA"]
+
+    res = {
+        "payload_mb": a.mb,
+        "codec": bench_codec(arrays, a.repeats),
+        "grpc_localhost": bench_grpc(arrays, a.repeats),
+        "raw_decode_zero_copy": bool(zero_copy),
+    }
+    print(json.dumps(res))
+    with open(a.out, "w") as f:
+        json.dump(res, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -108,10 +108,56 @@ def make_landmarks():
                    seed=_seed(im))
 
 
+def make_coco():
+    """Minimal COCO-format detection instance: annotations JSON (sparse
+    category ids — exercises the contiguous remapping) + image dirs."""
+    import json
+
+    root = os.path.join(FIX, "coco_det", "coco")
+    os.makedirs(os.path.join(root, "annotations"), exist_ok=True)
+
+    def blob(split, n_imgs, box_seed):
+        rng = np.random.RandomState(_seed("coco", split, box_seed))
+        images, annotations = [], []
+        os.makedirs(os.path.join(root, split), exist_ok=True)
+        aid = 1
+        for i in range(n_imgs):
+            fname = f"{split}_{i:03d}.jpg"
+            _write_img(os.path.join(root, split, fname),
+                       seed=_seed("coco", split, i), size=(32, 32))
+            images.append({"id": i + 1, "file_name": fname,
+                           "width": 32, "height": 32})
+            for _ in range(rng.randint(1, 3)):
+                w, h = int(rng.randint(6, 16)), int(rng.randint(6, 16))
+                x = int(rng.randint(0, 32 - w))
+                y = int(rng.randint(0, 32 - h))
+                annotations.append({
+                    "id": aid, "image_id": i + 1,
+                    # sparse ids 1/3/7 → contiguous classes 0/1/2
+                    "category_id": int(rng.choice([1, 3, 7])),
+                    "bbox": [x, y, w, h], "area": w * h, "iscrowd": 0,
+                })
+                aid += 1
+        return {
+            "images": images, "annotations": annotations,
+            "categories": [
+                {"id": 1, "name": "person"},
+                {"id": 3, "name": "car"},
+                {"id": 7, "name": "train"},
+            ],
+        }
+
+    for split, n in (("train2017", 8), ("val2017", 4)):
+        with open(os.path.join(
+                root, "annotations", f"instances_{split}.json"), "w") as f:
+            json.dump(blob(split, n, 1), f)
+
+
 if __name__ == "__main__":
     make_stackoverflow()
     make_imagenet()
     make_landmarks()
+    make_coco()
     total = sum(
         os.path.getsize(os.path.join(r, f))
         for r, _, fs in os.walk(FIX) for f in fs
