@@ -62,12 +62,17 @@ def main() -> None:
                           "--port", str(port)])
         for r in range(1, N_CLIENTS + 1)
     ]
+    ok = False
     try:
         result = server.run()
         print("grpc multiprocess result:", result)
         assert result is not None and result["test_acc"] > 0.5
+        ok = True
     finally:
         for p in procs:
+            if not ok:
+                p.kill()  # don't orphan clients (or mask the real error
+                #           with TimeoutExpired) when the server failed
             p.wait(timeout=60)
     print("cross-silo gRPC multi-process ok")
 

@@ -44,9 +44,12 @@ def encode_frame_parts(arrays: Sequence[np.ndarray]) -> List[bytes]:
     frames: List[bytes] = []
     off = 0
     for a in arrays:
+        a = np.asarray(a)
+        # record the TRUE shape before ascontiguousarray, which promotes
+        # 0-d scalars to (1,) — the npz path preserves () and so must we
+        shape = list(a.shape)
         a = np.ascontiguousarray(a)
-        metas.append({"dtype": a.dtype.str, "shape": list(a.shape),
-                      "off": off})
+        metas.append({"dtype": a.dtype.str, "shape": shape, "off": off})
         frames.append(a.tobytes())  # the single data copy on encode
         off += a.nbytes
     header = json.dumps(metas).encode("utf-8")

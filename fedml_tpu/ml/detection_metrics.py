@@ -160,7 +160,12 @@ def evaluate_map50(bundle, params, test_x, test_y, batch_size: int = 8,
     import jax
     import jax.numpy as jnp
 
-    apply = jax.jit(lambda p, bx: bundle.apply(p, bx, train=False))
+    # cache the jitted forward on the bundle: re-jitting a fresh lambda per
+    # call recompiles the conv stack every eval (minutes at 224px on CPU)
+    apply = getattr(bundle, "_map50_apply", None)
+    if apply is None:
+        apply = jax.jit(lambda p, bx: bundle.apply(p, bx, train=False))
+        bundle._map50_apply = apply
     logits = []
     n = test_x.shape[0]
     for i in range(0, n, batch_size):

@@ -112,11 +112,13 @@ class FedAvgAPI:
         ).lower()
         if self.cohort_impl_default == "vmap" and impl != "vmap":
             # mesh engine: the cohort axis is SHARDED over devices — lax.map
-            # would silently serialize the whole pod onto one program
-            logger.warning(
-                "sp_cohort_impl=%r ignored: this engine requires vmap "
-                "(cohort axis sharded over devices)", impl,
-            )
+            # would silently serialize the whole pod onto one program.
+            # ("auto" resolves to vmap here anyway; only "map" conflicts.)
+            if impl == "map":
+                logger.warning(
+                    "sp_cohort_impl='map' ignored: this engine requires "
+                    "vmap (cohort axis sharded over devices)"
+                )
             impl = "vmap"
         if impl == "auto":
             conv_model = bool(getattr(model, "conv_model", False))

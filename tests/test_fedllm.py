@@ -259,13 +259,20 @@ def test_fedllm_100m_scale_transport(tmp_path):
             comm_round=1, local_steps=1, batch_size=8, epochs=1,
             compression="quantize", quantize_bits=8,
             payload_store_dir=str(tmp_path), payload_inline_limit_bytes=1 << 20,
+            # 1-device silo mesh: at 115M params the default fsdp-8 VIRTUAL
+            # mesh starves one per-device thread past XLA:CPU's 40s
+            # collective-rendezvous deadline (two silos train concurrently
+            # on ONE physical core) and the runtime hard-aborts; 8-way
+            # silo sharding is covered at tiny scale by
+            # test_fedllm_sharded_silo_mesh — THIS test proves transport
+            mesh_shape="data:1", silo_device_indices=[0],
         )
     finally:
         LoopbackCommManager.send_message = orig_send
         UpdateCodec.encode = orig_encode
     wall = time.time() - t0
 
-    n_params = clients[0].manager.trainer.trainer and sum(
+    n_params = sum(
         int(p.size)
         for p in jax.tree.leaves(server.manager.global_params)
     )

@@ -107,6 +107,7 @@ def test_raw_frames_roundtrip_and_sniffing():
         np.arange(11, dtype=np.int32),
         rng.standard_normal((8, 8)).astype(np.float64)[::2],  # non-contig
         np.float16(rng.standard_normal((5,))),
+        np.float32(3.5).reshape(()),  # 0-d scalar: shape must survive as ()
     ]
     body = encode_frames(arrays)
     back = decode_frames(body)
