@@ -34,11 +34,13 @@ sys.path.insert(0, REPO)
 N_TOTAL, PER_ROUND, PER_CLIENT, BATCH = 100, 10, 500, 32
 
 # per-leg model wiring: (our dataset/model names, input shape, classes).
-# cnn note: XLA:CPU executes VMAPPED convs as grouped convolutions through
-# a naive path ~100x slower than torch's per-client loop — an execution-
-# backend artifact of the CPU comparison substrate, not architecture (the
-# identical program on TPU is bench.py's headline); the leg is reported
-# with that caveat. rnn is the mid-size leg free of the conv pathology.
+# cnn note: conv models on CPU run the r5 lax.map cohort (the vmapped
+# grouped-conv lowering and its >60-min compiles are gone), but plain
+# XLA:CPU conv codegen still executes small convs ~100x slower than
+# torch's oneDNN kernels — an execution-backend artifact of the CPU
+# comparison substrate, not architecture (the identical program on TPU is
+# bench.py's headline); the leg is reported with that caveat. rnn is the
+# mid-size leg free of the conv story (LSTM: oneDNN ~2x).
 MODELS = {
     "lr": dict(dataset="mnist", shape=(28, 28, 1), classes=10),
     "rnn": dict(dataset="shakespeare", shape=(80,), classes=90),
@@ -230,11 +232,12 @@ def main() -> None:
                   "dependent, not purely architectural — the fused "
                   "vmap/scan engine wins where per-client Python overhead "
                   "dominates (lr), while for LSTM/conv models torch's "
-                  "oneDNN CPU kernels beat XLA:CPU codegen (vmapped convs "
-                  "lower to a naive grouped-conv path). On the TARGET "
-                  "substrate (TPU) the same programs are bench.py's "
-                  "headline numbers. resnet56 opt-in: its XLA:CPU compile "
-                  "exceeds 60 min on this single-core host.",
+                  "oneDNN CPU kernels beat plain XLA:CPU codegen (the r5 "
+                  "lax.map cohort removed the old vmapped grouped-conv "
+                  "compile wall — 224px federated detection now runs on "
+                  "CPU — but not the per-kernel quality gap on tiny "
+                  "convs). On the TARGET substrate (TPU) the same "
+                  "programs are bench.py's headline numbers.",
     }
     with open(a.out, "w") as f:
         json.dump(out, f, indent=2)
