@@ -486,9 +486,17 @@ def build_line(results: dict, ref: float | None, meta: dict) -> dict:
 
 def _probe_device_kind(timeout: float = 90.0):
     """Ask a SUBPROCESS for the device kind (a wedged tunnel hangs the
-    probe, not the bench). None = unknown — e.g. the tunnel is down, which
-    is exactly the case the cache insures against, so unknown ACCEPTS the
-    cached rows rather than discarding the insurance."""
+    probe, not the bench). Returns ``(kind, reason)``:
+
+    - ``(str, "ok")`` — chip identified;
+    - ``(None, "timeout")`` — probe exceeded its budget: could be a DOWN
+      tunnel or merely a SLOW-but-healthy host, so callers must NOT treat
+      this as proof of unreachability;
+    - ``(None, "error")`` — backend init failed fast (e.g. UNAVAILABLE):
+      the one case where legs are certain to fail too.
+
+    None kinds ACCEPT cached rows (the insurance case) rather than
+    discarding them."""
     try:
         p = subprocess.run(
             [sys.executable, "-c",
@@ -496,10 +504,12 @@ def _probe_device_kind(timeout: float = 90.0):
             capture_output=True, text=True, timeout=timeout,
         )
         if p.returncode == 0 and p.stdout.strip():
-            return p.stdout.strip().splitlines()[-1]
+            return p.stdout.strip().splitlines()[-1], "ok"
+        return None, "error"
+    except subprocess.TimeoutExpired:
+        return None, "timeout"
     except Exception:
-        pass
-    return None
+        return None, "error"
 
 
 def _usable(cached, digest: str, ttl_s: float) -> bool:
@@ -521,26 +531,44 @@ def run_legs(budget_s: float, ttl_s: float, min_leg_s: float = 240.0,
     ref = _ref_rounds_per_sec()
     results: dict = {}
 
-    # a cache row measured on a DIFFERENT TPU generation must not be served
-    # as this round's number: when any cached row is reusable, probe the
-    # current chip once and drop mismatched rows (they re-run fresh)
+    # one up-front device probe (in a SUBPROCESS — a wedged tunnel hangs the
+    # probe, not the bench). Purpose is twofold: (a) a cache row measured on
+    # a DIFFERENT TPU generation must not be served as this round's number —
+    # mismatched rows are dropped and re-run; (b) when the tunnel is
+    # UNREACHABLE, every leg would hang to its full timeout at backend init,
+    # so leg timeouts shrink to fail fast and the line carries explicit
+    # errors within minutes instead of rc=124.
     specs = leg_specs()
-    reusable = {n: cache["legs"].get(n) for n, _, d, _ in specs
-                if _usable(cache["legs"].get(n), d, ttl_s)}
-    if reusable:
-        kind = (device_prober or _probe_device_kind)()
-        if kind:
-            for n, row in reusable.items():
-                row_kind = row.get("device_kind")
-                if row_kind and row_kind != kind:
-                    del cache["legs"][n]
+    probe = (device_prober or _probe_device_kind)()
+    # tolerate simple probers that return a bare kind (tests inject these)
+    kind, reason = probe if isinstance(probe, tuple) else (probe, "ok")
+    if kind is None and reason == "error":
+        # backend init fails FAST and deterministically (tunnel down): legs
+        # would each hang their full timeout at init, so fail fast instead.
+        # A probe TIMEOUT is NOT proof of unreachability (a loaded host can
+        # blow the 90s budget and still serve legs fine) — keep timeouts.
+        leg_timeout_s = min(leg_timeout_s, 240.0)
+    for n, _, d, _ in specs:
+        row = cache["legs"].get(n)
+        if (_usable(row, d, ttl_s) and kind and row.get("device_kind")
+                and row["device_kind"] != kind):
+            del cache["legs"][n]
 
     def emit():
         elapsed = round(time.monotonic() - t_start, 1)
         line = build_line(results, ref, {"bench_elapsed_s": elapsed,
-                                         "bench_budget_s": budget_s})
+                                         "bench_budget_s": budget_s,
+                                         "bench_device_probe":
+                                         kind or ("unreachable"
+                                                  if reason == "error"
+                                                  else "probe-timeout")})
         print(json.dumps(line), flush=True)
         return line
+
+    # a parseable tail exists from second zero: even a driver timeout before
+    # the FIRST leg resolves leaves this line, not an empty capture (r4
+    # recorded rc=124 with tail="")
+    emit()
 
     def default_runner(argv, timeout):
         env = dict(os.environ)

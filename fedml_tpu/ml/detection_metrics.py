@@ -150,6 +150,28 @@ def map_at_50(pred_logits: Sequence[np.ndarray],
     }
 
 
+def collect_detection_logits(bundle, params, test_x,
+                             batch_size: int = 8) -> List[np.ndarray]:
+    """One dense forward over the test set (jit-sized batches, device);
+    callers score the SAME logits at any number of IoU thresholds without
+    re-running the conv stack (minutes at 224px on CPU)."""
+    import jax
+    import jax.numpy as jnp
+
+    # cache the jitted forward on the bundle: re-jitting a fresh lambda per
+    # call would recompile the conv stack every eval
+    apply = getattr(bundle, "_map50_apply", None)
+    if apply is None:
+        apply = jax.jit(lambda p, bx: bundle.apply(p, bx, train=False))
+        bundle._map50_apply = apply
+    logits: List[np.ndarray] = []
+    n = test_x.shape[0]
+    for i in range(0, n, batch_size):
+        bx = jnp.asarray(np.asarray(test_x[i:i + batch_size], np.float32))
+        logits.extend(np.asarray(apply(params, bx), np.float32))
+    return logits
+
+
 def evaluate_map50(bundle, params, test_x, test_y, batch_size: int = 8,
                    **decode_kw) -> Dict[str, float]:
     """mAP@0.5 of a detection bundle over a test set.
@@ -157,19 +179,6 @@ def evaluate_map50(bundle, params, test_x, test_y, batch_size: int = 8,
     Runs the dense forward in jit-sized batches (device), then decodes and
     matches host-side — the federated analog of the reference's
     ``yolov5/val.py`` end-of-training eval."""
-    import jax
-    import jax.numpy as jnp
-
-    # cache the jitted forward on the bundle: re-jitting a fresh lambda per
-    # call recompiles the conv stack every eval (minutes at 224px on CPU)
-    apply = getattr(bundle, "_map50_apply", None)
-    if apply is None:
-        apply = jax.jit(lambda p, bx: bundle.apply(p, bx, train=False))
-        bundle._map50_apply = apply
-    logits = []
-    n = test_x.shape[0]
-    for i in range(0, n, batch_size):
-        bx = jnp.asarray(np.asarray(test_x[i:i + batch_size], np.float32))
-        logits.extend(np.asarray(apply(params, bx), np.float32))
+    logits = collect_detection_logits(bundle, params, test_x, batch_size)
     return map_at_50(logits, [np.asarray(t, np.float32) for t in test_y],
                      **decode_kw)

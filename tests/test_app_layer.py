@@ -149,11 +149,15 @@ class TestFederatedDetection224:
         for r in range(int(args.comm_round)):
             args.round_idx = r
             api._train_round(r)
-        trained_50 = evaluate_map50(bundle, api.global_params,
-                                    ds.test_x, ds.test_y, batch_size=4)
-        trained_25 = evaluate_map50(bundle, api.global_params,
-                                    ds.test_x, ds.test_y, batch_size=4,
-                                    iou_thresh=0.25)
+        from fedml_tpu.ml.detection_metrics import (
+            collect_detection_logits, map_at_50,
+        )
+
+        logits = collect_detection_logits(bundle, api.global_params,
+                                          ds.test_x, batch_size=4)
+        targets = [np.asarray(t, np.float32) for t in ds.test_y]
+        trained_50 = map_at_50(logits, targets)
+        trained_25 = map_at_50(logits, targets, iou_thresh=0.25)
         print(f"federated det224 mAP@0.5={trained_50['map50']:.3f} "
               f"mAP@0.25: init={init_25['map50']:.3f} -> "
               f"trained={trained_25['map50']:.3f} "

@@ -54,15 +54,18 @@ def _lines(capsys):
 
 
 def test_emits_cumulative_line_after_every_leg(partial_path, capsys):
-    final = bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=_tpu_runner)
+    final = bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=_tpu_runner, device_prober=V5E)
     lines = _lines(capsys)
-    assert len(lines) == len(bench.leg_specs())
+    # one startup line (parseable tail from second zero) + one per leg
+    assert len(lines) == len(bench.leg_specs()) + 1
     # every line is a full headline line — the tail is always parseable
     for ln in lines:
         assert ln["metric"] == (
             "fedavg_rounds_per_sec_100clients_cifar10_resnet56")
         assert "unit" in ln and "vs_baseline" in ln
-    assert lines[0]["value"] == 1.25  # headline present from the FIRST line
+    assert lines[0]["value"] is None  # startup line precedes any leg
+    assert lines[0]["bench_device_probe"] == "TPU v5 lite"
+    assert lines[1]["value"] == 1.25  # headline present from the first leg
     assert final == lines[-1]
     assert final["cheetah_mfu"] == 0.758
     assert final["cheetah_moe_mfu"] == 0.5
@@ -77,7 +80,7 @@ def test_one_wedged_leg_does_not_zero_the_round(partial_path, capsys):
             raise subprocess.TimeoutExpired(argv, timeout)
         return _tpu_runner(argv, timeout)
 
-    final = bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=runner)
+    final = bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=runner, device_prober=V5E)
     assert final["value"] is None
     assert final["fedavg_error"] == "leg timeout"
     assert final["cheetah_mfu"] == 0.758  # later legs still ran
@@ -94,7 +97,7 @@ def test_budget_skips_remaining_legs_with_markers(partial_path, capsys):
 
     # budget already below min_leg_s: every leg skipped, line still printed
     final = bench.run_legs(budget_s=10, ttl_s=1e6, min_leg_s=240,
-                           runner=runner)
+                           runner=runner, device_prober=V5E)
     assert not calls
     for name, *_ in bench.leg_specs():
         assert final[f"{name}_skipped"] == "budget"
@@ -175,7 +178,7 @@ def test_cpu_results_are_not_cached_and_not_ref_compared(partial_path, capsys):
             return {"cheetah_mfu": 0.01, "platform": "cpu"}
         return {"skipped": "not a tpu host"}
 
-    final = bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=cpu_runner)
+    final = bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=cpu_runner, device_prober=V5E)
     # the smoke number must never masquerade as the resnet56 headline metric
     assert final["value"] is None
     assert final["fedavg_cpu_smoke_rounds_per_sec"] == 50.0
@@ -191,7 +194,36 @@ def test_crashed_leg_records_error_and_continues(partial_path, capsys):
             raise RuntimeError("rc=1 <no output> XlaRuntimeError: oom")
         return _tpu_runner(argv, timeout)
 
-    final = bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=runner)
+    final = bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=runner, device_prober=V5E)
     assert final["value"] == 1.25
     assert "oom" in final["cheetah_hd512_error"]
     assert "oom" in final["cheetah_moe_error"]
+
+
+def test_unreachable_tunnel_fails_fast_with_parseable_tail(partial_path,
+                                                           capsys):
+    """Tunnel down (probe fails FAST with an error) + empty cache: legs
+    shrink to the fast-fail timeout and the startup line already carries
+    the probe verdict. A probe TIMEOUT must NOT shrink (a slow-but-healthy
+    host can blow the probe budget and still serve 900s legs)."""
+    seen_timeouts = []
+
+    def runner(argv, timeout):
+        seen_timeouts.append(timeout)
+        raise subprocess.TimeoutExpired(argv, timeout)
+
+    final = bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=runner,
+                           device_prober=lambda: (None, "error"))
+    lines = _lines(capsys)
+    assert lines[0]["bench_device_probe"] == "unreachable"
+    assert all(t <= 240.0 for t in seen_timeouts)
+    for name, *_ in bench.leg_specs():
+        assert final[f"{name}_error"] == "leg timeout"
+
+    # probe timeout: full leg timeouts retained, verdict disclosed
+    seen_timeouts.clear()
+    bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=runner,
+                   device_prober=lambda: (None, "timeout"))
+    lines = _lines(capsys)
+    assert lines[0]["bench_device_probe"] == "probe-timeout"
+    assert any(t > 240.0 for t in seen_timeouts)
