@@ -559,9 +559,9 @@ def run_legs(budget_s: float, ttl_s: float, min_leg_s: float = 240.0,
         line = build_line(results, ref, {"bench_elapsed_s": elapsed,
                                          "bench_budget_s": budget_s,
                                          "bench_device_probe":
-                                         kind or ("unreachable"
-                                                  if reason == "error"
-                                                  else "probe-timeout")})
+                                         kind or {"error": "unreachable",
+                                                  "timeout": "probe-timeout"}
+                                         .get(reason, "unknown")})
         print(json.dumps(line), flush=True)
         return line
 

@@ -36,8 +36,10 @@ for r in range(int(args.comm_round)):
     api._train_round(r)
 
 # ONE forward over the test set; score the same logits at both IoUs
+import numpy as np
+
 logits = collect_detection_logits(bundle, api.global_params, ds.test_x)
-targets = [t for t in ds.test_y]
+targets = [np.asarray(t, np.float32) for t in ds.test_y]
 m50 = map_at_50(logits, targets)
 m25 = map_at_50(logits, targets, iou_thresh=0.25)
 print(f"federated detection: mAP@0.5={m50['map50']:.3f} "

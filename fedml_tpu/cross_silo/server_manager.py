@@ -68,6 +68,29 @@ class FedMLServerManager(FedMLCommManager):
         )
         self.final_metrics: Optional[dict] = None
         self.done = threading.Event()
+        # round checkpoint/resume (the reference restarts every killed run
+        # from round 0 — SURVEY §5): with args.checkpoint_dir the aggregated
+        # global + round index persist via Orbax after every round, and a
+        # restarted server resumes the federation where it died — clients
+        # re-joining get the restored global in their INIT
+        self._ckpt = None
+        ckpt_dir = str(getattr(args, "checkpoint_dir", "") or "")
+        if ckpt_dir:
+            from ..checkpoint import CheckpointManager
+
+            self._ckpt = CheckpointManager(ckpt_dir)
+            step = self._ckpt.latest_step()
+            if step is not None:
+                restored = self._ckpt.restore_latest(
+                    {"global_params": self.global_params}
+                )
+                self.global_params = restored["global_params"]
+                self.aggregator.set_model_params(self.global_params)
+                self.round_idx = step + 1
+                logger.info(
+                    "server: resumed federation at round %d from %s",
+                    self.round_idx, ckpt_dir,
+                )
 
     # -- FSM ----------------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -111,7 +134,28 @@ class FedMLServerManager(FedMLCommManager):
             )
             if ready:
                 self._init_sent = True
-        if ready:
+        if ready and self.round_idx >= self.round_num:
+            # a RESTART of an already-completed federation (resumed
+            # round_idx == comm_round): do not train an extra round past
+            # the budget — deliver the final model and finish
+            leaves = [np.asarray(l)
+                      for l in jax.tree.leaves(self.global_params)]
+            for client_rank in range(1, self.size):
+                msg = Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank,
+                              client_rank)
+                msg.set_arrays(leaves)
+                self._send_or_mark_dead(client_rank, msg)
+            if self.ds is not None and self.final_metrics is None:
+                self.final_metrics = make_eval_fn(self.bundle)(
+                    self.global_params, self.ds.test_x, self.ds.test_y
+                )
+            logger.info("server: federation already complete (round %d)",
+                        self.round_idx)
+            if self._ckpt is not None:
+                self._ckpt.close()
+            self.done.set()
+            self.finish()
+        elif ready:
             self._send_init_msg()
         elif finish:
             self._finish_round()
@@ -248,6 +292,12 @@ class FedMLServerManager(FedMLCommManager):
         agg = self.aggregator.on_after_aggregation(agg)
         self.global_params = agg
         self.aggregator.set_model_params(agg)
+        if self._ckpt is not None:
+            every = int(getattr(self.args, "checkpoint_every_rounds", 1) or 1)
+            # the save blocks the FSM thread (Orbax wait_until_finished) —
+            # checkpoint_every_rounds bounds that cost, same as the sp engine
+            if (round_r + 1) % every == 0 or round_r == self.round_num - 1:
+                self._ckpt.save({"global_params": agg}, step=round_r)
 
         if self.ds is not None:
             freq = max(int(getattr(self.args, "frequency_of_the_test", 1)), 1)
@@ -282,6 +332,8 @@ class FedMLServerManager(FedMLCommManager):
                 msg.set_arrays(leaves)
                 self._send_or_mark_dead(client_rank, msg)
             logger.info("server: training finished after %d rounds", self.round_num)
+            if self._ckpt is not None:
+                self._ckpt.close()
             self.done.set()
             self.finish()
 

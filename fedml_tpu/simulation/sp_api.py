@@ -406,31 +406,88 @@ class FedAvgAPI:
         return self.custom_aggregator.on_after_aggregation(agg)
 
     # -- the training loop (reference: fedavg_api.py:65-123) ----------------
+    # -- round checkpoint / resume ------------------------------------------
+    # The reference has NO round-resume anywhere (SURVEY §5); killed runs
+    # restart from round 0. With args.checkpoint_dir set, the global model
+    # (+ round index, and the server optimizer / SCAFFOLD variates when
+    # present) persists via Orbax every checkpoint_every_rounds rounds and
+    # train() resumes mid-federation after a crash.
+    def _ckpt_state(self) -> Dict:
+        state = {"global_params": self.global_params}
+        if self.server_opt_state is not None:
+            state["server_opt_state"] = self.server_opt_state
+        if self.scaffold:
+            state["c_global"] = self.c_global
+            state["c_locals"] = self.c_locals
+        return state
+
+    def _maybe_resume(self, ckpt) -> int:
+        """Restore the newest round checkpoint; returns the round to START."""
+        step = ckpt.latest_step()
+        if step is None:
+            return 0
+        restored = ckpt.restore_latest(self._ckpt_state())
+        self.global_params = restored["global_params"]
+        if "server_opt_state" in restored:
+            self.server_opt_state = restored["server_opt_state"]
+        if self.scaffold:
+            self.c_global = restored["c_global"]
+            self.c_locals = restored["c_locals"]
+        logger.info("sp engine: resumed federation at round %d", step + 1)
+        return step + 1
+
     def train(self) -> Dict[str, float]:
         from ..core import mlops
 
         rounds = int(self.args.comm_round)
         freq = max(int(getattr(self.args, "frequency_of_the_test", 5)), 1)
+        ckpt = None
+        start_round = 0
+        ckpt_dir = str(getattr(self.args, "checkpoint_dir", "") or "")
+        every = int(getattr(self.args, "checkpoint_every_rounds", 1) or 1)
+        if ckpt_dir:
+            from ..checkpoint import CheckpointManager
+
+            ckpt = CheckpointManager(ckpt_dir)
+            start_round = self._maybe_resume(ckpt)
         last_eval: Dict[str, float] = {}
-        for round_idx in range(rounds):
-            self.args.round_idx = round_idx
-            mlops.log_round_info(round_idx, rounds)
-            t0 = time.perf_counter()
-            with mlops.MLOpsProfilerEvent("train"):
-                train_metrics = self._train_round(round_idx)
-            dt = time.perf_counter() - t0
-            entry = {"round": round_idx, "round_time_s": dt, **train_metrics}
-            if round_idx % freq == 0 or round_idx == rounds - 1:
+        try:
+            if start_round >= rounds:
+                # re-invoking a COMPLETED federation: evaluate the restored
+                # model instead of returning an empty dict to consumers
                 last_eval = self.evaluate(
                     self.global_params, self.ds.test_x, self.ds.test_y
                 )
-                entry.update(last_eval)
-                mlops.log({"round": round_idx, **last_eval}, step=round_idx)
-                logger.info(
-                    "round %d: loss=%.4f acc=%.4f (%.3fs)",
-                    round_idx, last_eval["test_loss"], last_eval["test_acc"], dt,
-                )
-            self.history.append(entry)
+                return last_eval
+            for round_idx in range(start_round, rounds):
+                self.args.round_idx = round_idx
+                mlops.log_round_info(round_idx, rounds)
+                t0 = time.perf_counter()
+                with mlops.MLOpsProfilerEvent("train"):
+                    train_metrics = self._train_round(round_idx)
+                dt = time.perf_counter() - t0
+                entry = {"round": round_idx, "round_time_s": dt,
+                         **train_metrics}
+                if round_idx % freq == 0 or round_idx == rounds - 1:
+                    last_eval = self.evaluate(
+                        self.global_params, self.ds.test_x, self.ds.test_y
+                    )
+                    entry.update(last_eval)
+                    mlops.log({"round": round_idx, **last_eval},
+                              step=round_idx)
+                    logger.info(
+                        "round %d: loss=%.4f acc=%.4f (%.3fs)",
+                        round_idx, last_eval["test_loss"],
+                        last_eval["test_acc"], dt,
+                    )
+                self.history.append(entry)
+                if ckpt is not None and (
+                    (round_idx + 1) % every == 0 or round_idx == rounds - 1
+                ):
+                    ckpt.save(self._ckpt_state(), step=round_idx)
+        finally:
+            if ckpt is not None:  # release Orbax threads even on a crash
+                ckpt.close()
         return last_eval
 
 

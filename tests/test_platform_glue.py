@@ -362,11 +362,11 @@ def test_reproduce_baselines_harness_fixture_run(tmp_path):
     real = run("--row", "stackoverflow_lr", "--cache-dir", fixture,
                "--rounds", "2")
     assert real["data"] == "real" and real["reproduces"] is None
-    # the repo STAGES real MNIST (the t10k files at data_cache/ — see
+    # the repo STAGES real MNIST (the t10k files at data_real/ — see
     # BASELINE.md): the default-cache run is real data under the disclosed
     # t10k-split protocol, never an unqualified reproduces claim
     staged = run("--row", "mnist_lr", "--rounds", "2",
-                 "--cache-dir", os.path.join(repo, "data_cache"))
+                 "--cache-dir", os.path.join(repo, "data_real"))
     assert staged["data"] == "real"
     assert staged["protocol"] == "mnist_t10k_split"
     assert staged["reproduces"] is None
