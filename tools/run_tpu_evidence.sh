@@ -1,0 +1,11 @@
+#!/bin/bash
+# One-shot TPU evidence run — everything round 5 could not measure because
+# the axon tunnel was down (ROUND5_NOTES.md). Run on a host where
+# `python -c "import jax; print(jax.devices())"` shows the TPU.
+set -x
+cd "$(dirname "$0")/.."
+python bench.py                         # full ladder -> BENCH_PARTIAL.json
+python tools/bench_ring_kernel.py       # block sweep + CP train step
+python tools/check_7b_readiness.py      # v5e:8,v5e:16,v5p:32 AOT rows
+git add BENCH_PARTIAL.json RING_KERNEL_BENCH.json SEVENB_READINESS.json
+git commit -m "TPU evidence: bench ladder, ring sweep, 7B readiness rows"
