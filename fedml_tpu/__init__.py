@@ -40,6 +40,7 @@ def init(args: Optional[Arguments] = None, should_init_logs: bool = True) -> Arg
         args = load_arguments()
     args.rng = seed_everything(int(args.random_seed))
     _update_client_id_list(args)
+    _maybe_enable_compilation_cache(args)
     from .core import mlops
 
     mlops.init(args)
@@ -51,6 +52,32 @@ def init(args: Optional[Arguments] = None, should_init_logs: bool = True) -> Arg
         args.federated_optimizer,
     )
     return args
+
+
+def _maybe_enable_compilation_cache(args: Arguments) -> None:
+    """Point XLA's persistent compilation cache at ``compilation_cache_dir``.
+
+    Repeat runs — and the driver's bench legs — then deserialize compiled
+    executables instead of re-lowering them, which removes the compile wall
+    that made BENCH legs time out (ISSUE 1). A low min-compile-time floor
+    keeps even mid-sized programs cached; disk is the only cost.
+    """
+    cache_dir = str(getattr(args, "compilation_cache_dir", "") or "")
+    if not cache_dir:
+        return
+    import os
+
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
+        # don't clobber an explicitly configured floor (e.g. raised to keep
+        # a slow shared cache dir from thrashing on tiny entries)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    logging.getLogger(__name__).info(
+        "init: persistent XLA compilation cache at %s", cache_dir
+    )
 
 
 def _update_client_id_list(args: Arguments) -> None:

@@ -100,6 +100,21 @@ _SCHEMA: Dict[str, tuple] = {
     "checkpoint_dir": (str, ""),
     "checkpoint_every_rounds": (int, 0),
     "resume": (bool, False),
+    # round engine (simulation/round_engine.py)
+    # round_fusion: auto fuses the FedAvg-family round into ONE donated XLA
+    # program whenever no host-side hook blocks it; on demands it; off keeps
+    # the legacy multi-dispatch path (the parity reference).
+    "round_fusion": (str, "auto"),  # auto | on | off
+    # superround_k > 1 runs K rounds per device-program launch under
+    # lax.scan with ON-DEVICE client sampling (needs the HBM-resident
+    # single-device path; cohort trajectory differs from host sampling
+    # except under full participation). 0/1 = off.
+    "superround_k": (int, 0),
+    # sp cohort execution: vmap | map | auto (see FedAvgAPI.cohort_impl)
+    "sp_cohort_impl": (str, ""),
+    # persistent XLA compilation cache — repeat runs (and bench legs) skip
+    # the compile wall entirely. Empty = disabled. Wired in fedml.init().
+    "compilation_cache_dir": (str, ""),
 }
 
 
@@ -248,6 +263,11 @@ def add_args() -> argparse.Namespace:
     parser.add_argument(
         "--silo_device_indices", type=int, nargs="*", default=None,
         help="chips this silo trains over (intra-silo data parallelism)",
+    )
+    parser.add_argument(
+        "--compilation_cache_dir", type=str, default=None,
+        help="persistent XLA compilation cache dir (repeat runs skip the "
+        "compile wall); also settable via YAML common_args",
     )
     args, _ = parser.parse_known_args()
     return args

@@ -35,6 +35,15 @@ class CheckpointManager:
     def save(self, state: PyTree, step: Optional[int] = None) -> int:
         if step is None:
             step = int(getattr(state, "step", 0))
+        # Copy every leaf to host FIRST: the fused round engine (simulation/
+        # round_engine.py) donates the state buffers to the next round's XLA
+        # program, so a device reference held across the next dispatch would
+        # be read-after-donate. device_get blocks until the values are
+        # computed and materializes them as numpy — safe no matter when the
+        # caller dispatches the next round.
+        import jax
+
+        state = jax.device_get(state)
         self._mgr.save(step, args=ocp.args.StandardSave(state))
         self._mgr.wait_until_finished()
         logger.info("checkpoint: saved step %d to %s", step, self.directory)

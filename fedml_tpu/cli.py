@@ -158,6 +158,50 @@ def cmd_agent(args) -> int:
     return 0
 
 
+def cmd_cache(args) -> int:
+    """Inspect / clear the persistent XLA compilation cache.
+
+    The cache is what lets repeat runs (and the driver's bench legs) skip
+    the compile wall — wire it into a run with ``--compilation_cache_dir``
+    (or the ``compilation_cache_dir`` YAML key; see fedml_tpu.init).
+    """
+    from . import constants
+
+    cache_dir = (
+        args.dir
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or os.environ.get("BENCH_COMPILE_CACHE_DIR")
+        # bench.py's default cache — the one the documented bench workflow
+        # actually writes to
+        or constants.BENCH_COMPILE_CACHE_DIR_DEFAULT
+    )
+    if not os.path.isdir(cache_dir):
+        print(f"compilation cache: {cache_dir} (empty — no directory)")
+        return 0
+    entries, total = [], 0
+    for root, _dirs, files in os.walk(cache_dir):
+        for fn in files:
+            full = os.path.join(root, fn)
+            try:
+                total += os.path.getsize(full)
+                entries.append(full)
+            except OSError:
+                pass
+    if args.clear:
+        for full in entries:
+            try:
+                os.remove(full)
+            except OSError:
+                pass
+        print(f"compilation cache: cleared {len(entries)} entries "
+              f"({total / 1e6:.1f} MB) from {cache_dir}")
+        return 0
+    print(f"compilation cache: {cache_dir}")
+    print(f"  entries: {len(entries)}")
+    print(f"  size:    {total / 1e6:.1f} MB")
+    return 0
+
+
 def cmd_multihost(args) -> int:
     """Spawn N coordinated worker processes (analog: mpirun -np N).
 
@@ -230,6 +274,16 @@ def main(argv=None) -> int:
                          help="claim and run at most one job, then exit")
     p_agent.add_argument("--max_jobs", type=int, default=None)
 
+    p_cache = sub.add_parser(
+        "cache", help="inspect/clear the persistent XLA compilation cache"
+    )
+    p_cache.add_argument("--dir", default="",
+                         help="cache dir (default: $JAX_COMPILATION_CACHE_DIR,"
+                         " $BENCH_COMPILE_CACHE_DIR, or the bench default "
+                         "/tmp/fedml_tpu_bench_jax_cache)")
+    p_cache.add_argument("--clear", action="store_true",
+                         help="delete every cache entry")
+
     p_mh = sub.add_parser(
         "multihost", help="spawn N coordinated worker processes",
         usage="%(prog)s [-np N] [--local_devices D] script [script_args ...]",
@@ -253,6 +307,7 @@ def main(argv=None) -> int:
         "logout": cmd_logout,
         "launch": cmd_launch,
         "agent": cmd_agent,
+        "cache": cmd_cache,
         "multihost": cmd_multihost,
     }
     if args.command is None:

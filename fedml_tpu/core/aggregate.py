@@ -70,6 +70,22 @@ class FedMLAggOperator:
         return weighted_average(stacked, weights)
 
 
+def fednova_normalized_direction(
+    global_params: PyTree, stacked: PyTree, tau: jax.Array
+) -> PyTree:
+    """Per-client normalized direction (w_g - w_i)/tau_i, leaf-wise.
+
+    The single definition shared by the unfused round loop and the fused
+    round engine — FedNova's fused-vs-unfused parity depends on both paths
+    computing this identically.
+    """
+    return jax.tree.map(
+        lambda g, s: (g[None] - s) / tau.reshape((-1,) + (1,) * (s.ndim - 1)),
+        global_params,
+        stacked,
+    )
+
+
 def pseudo_gradient(w_global: PyTree, w_aggregated: PyTree) -> PyTree:
     """Server pseudo-gradient: g = w_global - avg(w_clients).
 

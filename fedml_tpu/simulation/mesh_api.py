@@ -88,9 +88,15 @@ class MeshFedAvgAPI(FedAvgAPI):
     def _place(self, arr):
         return jax.device_put(jax.device_get(arr), self._shard)
 
-    def _train_round(self, round_idx: int):
+    def _prepare_round(self):
         # keep global params replicated across the mesh so the cohort program
         # reads them without broadcast inside the hot loop
         self.global_params = jax.device_put(self.global_params, self._repl)
-        metrics = super()._train_round(round_idx)
-        return metrics
+
+    def _place_state(self, state):
+        # the fused program's donated state must live on the SAME device set
+        # as the sharded cohort inputs: commit every leaf replicated over the
+        # mesh (a no-op copy once steady state re-feeds program outputs).
+        # XLA then propagates the input shardings through the fused round and
+        # lowers the cross-shard reduction to collectives over ICI.
+        return jax.tree.map(lambda x: jax.device_put(x, self._repl), state)

@@ -1,0 +1,247 @@
+"""Fused, donated round engine: one XLA program per FedAvg-family round.
+
+The unfused path (``FedAvgAPI._train_round``) drives every round from Python:
+separate dispatches for the cohort step, aggregation, the server optimizer and
+DP, with fresh HBM allocations for the model / optimizer / control-variate
+state each round. This module collapses all of it into a single ``jax.jit``
+with ``donate_argnums`` on the round state, so
+
+- a steady-state round is ONE device-program launch (the recompilation guard
+  in ``tests/test_round_fusion.py`` pins exactly one compile per config);
+- the model, server-optimizer and SCAFFOLD control-variate buffers are
+  donated — XLA updates them in place instead of holding the 2x HBM copy of
+  the stacked ``[cohort, ...]`` leaves plus old-and-new state;
+- central/local DP noising and the jit-safe attack/defense kernels run inside
+  the same program (FL-WBC keeps host-side per-client history and a custom
+  ``ServerAggregator`` is arbitrary Python — both fall back to the unfused
+  path, see ``FedAvgAPI._fusion_blockers``).
+
+Superround mode (``make_superround_step``) additionally moves client sampling
+on-device (fold-in PRNG choice over client ids) and runs K rounds under
+``jax.lax.scan`` — steady-state throughput is then bounded by device compute,
+not Python dispatch. It requires the HBM-resident dataset (the cohort gather
+happens inside the program) and uses device-side sampling, so its cohort
+trajectory differs from the host-side ``np.random.RandomState(round_idx)``
+reference semantics EXCEPT under full participation, where both degenerate to
+``arange`` and the trajectories coincide exactly (the parity tests rely on
+this).
+
+Round state is a flat dict — ``{"global_params", "server_opt_state"?,
+"c_global"?, "c_locals"?}`` — matching ``FedAvgAPI._round_state``. Callers
+must treat the state they passed in as CONSUMED (donation invalidates the
+buffers) and adopt the returned state; ``checkpoint.CheckpointManager.save``
+copies leaves to host before the next round can be dispatched.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .. import constants
+from ..core.aggregate import (
+    fednova_normalized_direction,
+    pseudo_gradient,
+    weighted_average,
+)
+from ..utils.tree import tree_flatten_to_vector, tree_unflatten_from_vector
+
+PyTree = Any
+RoundState = Dict[str, PyTree]
+
+
+def _masked_mean(values, wmask):
+    """Device-side twin of ``sp_api._masked_mean`` (same math, no host pull)."""
+    if values is None:
+        return jnp.float32(jnp.nan)
+    if wmask is None:
+        return jnp.mean(values)
+    return (values * wmask).sum() / jnp.maximum(wmask.sum(), 1.0)
+
+
+def build_round_core(api, n_cohort: int, n_valid: int):
+    """Build the pure round function for ``api``'s config.
+
+    ``n_cohort`` is the (padded) cohort length, ``n_valid`` the number of real
+    clients — both static per config, so the zero-weight-padding slices
+    compile to static slicing exactly like the unfused path.
+
+    Returns ``core(state, cohort_idx, cx, cy, cn, rngs, wmask, round_rng) ->
+    (state, metrics)``. The attack/defense hook order and every PRNG fold-in
+    mirror ``FedAvgAPI._train_round`` / ``_aggregate`` bit for bit — the
+    parity tests compare the two paths to atol 1e-5 over multiple rounds.
+
+    jit-safety note: the attacker's host-side ``np.random`` mask draws are
+    seeded by config only (``random_seed``; ``attack_model``'s round offset
+    defaults to 0 on both paths), so under trace they bake into compile-time
+    constants IDENTICAL to what the unfused path recomputes every round.
+    """
+    attacker, defender, dp = api.attacker, api.defender, api.dp
+    fedsgd, fednova, scaffold = api.fedsgd, api.fednova, api.scaffold
+    fedopt = api.opt_name == constants.FEDML_FEDERATED_OPTIMIZER_FEDOPT
+    server_opt = api.server_opt
+    cohort_fn = api.cohort_fn
+    client_num = api.ds.client_num
+
+    def aggregate(gp, stacked, weights, rng):
+        # mirror of FedAvgAPI._aggregate minus the unfusable paths (custom
+        # aggregator, FL-WBC) which are excluded by _fusion_blockers
+        if dp is not None and dp.dp_type == "ldp":
+            keys = jax.random.split(jax.random.fold_in(rng, 3), n_cohort)
+            stacked = jax.vmap(dp.randomize)(stacked, keys)
+        elif dp is not None and dp.dp_type == "cdp":
+            stacked = dp.clip_client_updates(stacked, gp)
+
+        needs_flat = attacker.is_model_attack() or defender.is_defense_enabled()
+        if not needs_flat:
+            return weighted_average(stacked, weights)
+
+        if n_valid < n_cohort:  # drop zero-weight padding for rank defenses
+            stacked = jax.tree.map(lambda x: x[:n_valid], stacked)
+            weights = weights[:n_valid]
+        _, treedef, shapes = tree_flatten_to_vector(gp)
+        flat = jax.vmap(lambda t: tree_flatten_to_vector(t)[0])(stacked)
+        gvec, _, _ = tree_flatten_to_vector(gp)
+        if attacker.is_model_attack():
+            flat = attacker.attack_model(
+                flat, weights, jax.random.fold_in(rng, 1)
+            )
+        if defender.is_defense_enabled():
+            agg_vec = defender.defend(
+                flat, weights, gvec, jax.random.fold_in(rng, 2),
+                client_ids=None,
+            )
+        else:
+            w = weights / jnp.maximum(weights.sum(), 1e-12)
+            agg_vec = (w[:, None] * flat).sum(0)
+        return tree_unflatten_from_vector(agg_vec, treedef, shapes)
+
+    def core(state: RoundState, cohort_idx, cx, cy, cn, rngs, wmask,
+             round_rng) -> Tuple[RoundState, Dict[str, jax.Array]]:
+        gp = state["global_params"]
+        if attacker.is_data_attack():
+            cx, cy = attacker.attack_data(cx, cy, n_valid)
+
+        if fedsgd:
+            grads, metrics = cohort_fn(gp, cx, cy, cn, rngs)
+            weights = (metrics["num_samples"] if wmask is None
+                       else metrics["num_samples"] * wmask)
+            agg_grad = aggregate(gp, grads, weights, round_rng)
+            updates, opt_state = server_opt.update(
+                agg_grad, state["server_opt_state"], gp
+            )
+            gp = optax.apply_updates(gp, updates)
+            new_state = dict(state, global_params=gp,
+                             server_opt_state=opt_state)
+            # (the unfused path applies no central-DP noise on FedSGD either)
+            return new_state, {
+                "train_loss": _masked_mean(metrics["train_loss"], wmask)
+            }
+
+        if scaffold:
+            c_cohort = jax.tree.map(lambda x: x[cohort_idx], state["c_locals"])
+            stacked, metrics, new_c = cohort_fn(
+                gp, cx, cy, cn, rngs, state["c_global"], c_cohort
+            )
+            real = cohort_idx[:n_valid]
+            new_c_r = jax.tree.map(lambda x: x[:n_valid], new_c)
+            c_cohort_r = jax.tree.map(lambda x: x[:n_valid], c_cohort)
+            delta_c = jax.tree.map(
+                lambda n, o: (n - o).mean(0), new_c_r, c_cohort_r
+            )
+            scale = n_valid / client_num
+            c_global = jax.tree.map(
+                lambda cg, d: cg + scale * d, state["c_global"], delta_c
+            )
+            c_locals = jax.tree.map(
+                lambda all_c, nc: all_c.at[real].set(nc),
+                state["c_locals"], new_c_r,
+            )
+            state = dict(state, c_global=c_global, c_locals=c_locals)
+        else:
+            stacked, metrics = cohort_fn(gp, cx, cy, cn, rngs)
+
+        weights = (metrics["num_samples"] if wmask is None
+                   else metrics["num_samples"] * wmask)
+
+        if fednova:
+            tau = metrics["tau"]
+            p = weights / jnp.maximum(weights.sum(), 1e-12)
+            tau_eff = (p * tau).sum()
+            norm_dir = fednova_normalized_direction(gp, stacked, tau)
+            d = weighted_average(norm_dir, weights)
+            gp = jax.tree.map(lambda g, dd: g - tau_eff * dd, gp, d)
+        elif fedopt:
+            w_agg = aggregate(gp, stacked, weights, round_rng)
+            pg = pseudo_gradient(gp, w_agg)
+            updates, opt_state = server_opt.update(
+                pg, state["server_opt_state"], gp
+            )
+            gp = optax.apply_updates(gp, updates)
+            state = dict(state, server_opt_state=opt_state)
+        else:
+            gp = aggregate(gp, stacked, weights, round_rng)
+
+        if dp is not None and dp.dp_type == "cdp":
+            gp = dp.randomize_global(gp, jax.random.fold_in(round_rng, 7))
+        new_state = dict(state, global_params=gp)
+        return new_state, {
+            "train_loss": _masked_mean(metrics["train_loss"], wmask)
+        }
+
+    return core
+
+
+def make_fused_round_step(api, n_cohort: int, n_valid: int):
+    """One jit'd, donated program per round.
+
+    ``donate_argnums=(0,)`` donates every leaf of the round state — the old
+    global params / optimizer state / control variates are updated in place.
+    The caller must adopt the returned state and never touch the donated one.
+    """
+    core = build_round_core(api, n_cohort, n_valid)
+    return jax.jit(core, donate_argnums=(0,))
+
+
+def make_superround_step(api, k: int, n_cohort: int):
+    """K rounds per launch: on-device sampling + ``lax.scan`` pipelining.
+
+    Requires the HBM-resident dataset (``api._dev_x`` et al.) — the per-round
+    cohort gather is a device-side ``jnp.take`` inside the scan body, so the
+    host does nothing between rounds. Client sampling is a fold-in PRNG
+    ``jax.random.choice`` over client ids (without replacement), keyed by the
+    same per-round key the single-round path uses for everything else.
+
+    Returns ``superround(state, start_round) -> (state, losses[k])``, jit'd
+    with the state donated.
+    """
+    core = build_round_core(api, n_cohort, n_valid=n_cohort)
+    dev_x, dev_y, dev_counts = api._dev_x, api._dev_y, api._dev_counts
+    total = int(api.ds.client_num)
+    per = int(n_cohort)
+    root_rng = api.root_rng
+
+    def superround(state: RoundState, start_round):
+        def body(st, r):
+            rkey = jax.random.fold_in(root_rng, r)
+            if total == per:  # full participation: matches the host path
+                cohort = jnp.arange(per, dtype=jnp.int32)
+            else:
+                cohort = jax.random.choice(
+                    jax.random.fold_in(rkey, 13), total, (per,), replace=False
+                ).astype(jnp.int32)
+            cx = jnp.take(dev_x, cohort, axis=0)
+            cy = jnp.take(dev_y, cohort, axis=0)
+            cn = jnp.take(dev_counts, cohort, axis=0)
+            rngs = jax.random.split(rkey, per)
+            st, metrics = core(st, cohort, cx, cy, cn, rngs, None, rkey)
+            return st, metrics["train_loss"]
+
+        rr = start_round + jnp.arange(k, dtype=jnp.int32)
+        state, losses = jax.lax.scan(body, state, rr)
+        return state, losses
+
+    return jax.jit(superround, donate_argnums=(0,))

@@ -30,7 +30,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import constants
-from ..core.aggregate import pseudo_gradient, weighted_average
+from ..core.aggregate import (
+    fednova_normalized_direction,
+    pseudo_gradient,
+    weighted_average,
+)
 from ..core.dp import FedPrivacyMechanism
 from ..core.security.attacker import FedMLAttacker
 from ..core.security.defender import FedMLDefender
@@ -214,6 +218,29 @@ class FedAvgAPI:
         )
         self.history: List[Dict[str, float]] = []
 
+        # -- fused round engine (round_engine.py): one donated XLA program per
+        # round. "auto" fuses whenever the config has no host-side hook that
+        # must run between cohort step and aggregation; "on" demands it (and
+        # errors on a blocked config); "off" keeps the legacy multi-dispatch
+        # path. Built lazily on first run_round so subclass __init__ (mesh's
+        # sharding setup) has completed.
+        self._round_step = None
+        self._superround_step = None
+        self._superround_k = max(int(getattr(args, "superround_k", 0) or 0), 0)
+        self._fusion_ready = False
+        mode = str(getattr(args, "round_fusion", "auto") or "auto").lower()
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(f"round_fusion must be auto|on|off, got {mode!r}")
+        blockers = self._fusion_blockers()
+        if mode == "on" and blockers:
+            raise ValueError(
+                "round_fusion='on' but this config cannot fuse: "
+                + "; ".join(blockers)
+            )
+        self._fusion_enabled = mode != "off" and not blockers
+        if blockers and mode != "off":
+            logger.info("round fusion off: %s", "; ".join(blockers))
+
     # -- sampling (reference: fedavg_api.py:125-140) ------------------------
     def _client_sampling(self, round_idx: int) -> np.ndarray:
         total = self.ds.client_num
@@ -258,8 +285,132 @@ class FedAvgAPI:
         """Place a per-client array (leading cohort dim); mesh shards it."""
         return arr
 
-    # -- one round ----------------------------------------------------------
+    def _prepare_round(self) -> None:
+        """Pre-round placement hook (mesh re-commits params replicated)."""
+
+    def _place_state(self, state):
+        """Commit the round state's placement (mesh: replicated)."""
+        return state
+
+    # -- fused round engine (round_engine.py) -------------------------------
+    def _fusion_blockers(self) -> List[str]:
+        """Host-side hooks that cannot live inside one jit'd program."""
+        blockers = []
+        if self.custom_aggregator is not None:
+            blockers.append("custom ServerAggregator (arbitrary Python)")
+        if (self.defender.is_defense_enabled()
+                and self.defender.defense_type == "wbc"):
+            blockers.append("FL-WBC defense (host-side per-client history)")
+        if type(self)._train_round is not FedAvgAPI._train_round:
+            blockers.append(
+                f"{type(self).__name__} overrides _train_round"
+            )
+        # round_engine inlines THIS class's aggregation; a subclass override
+        # (e.g. TurboAggregate's additive-share aggregation) would be
+        # silently bypassed by the fused mirror
+        if type(self)._aggregate is not FedAvgAPI._aggregate:
+            blockers.append(
+                f"{type(self).__name__} overrides _aggregate"
+            )
+        return blockers
+
+    def _setup_round_fusion(self) -> None:
+        """Build the jit'd round programs once (lazily, post-subclass-init)."""
+        self._fusion_ready = True
+        if not self._fusion_enabled:
+            return
+        from .round_engine import make_fused_round_step, make_superround_step
+
+        per = min(int(self.args.client_num_per_round), self.ds.client_num)
+        cohort0, wmask0 = self._pad_cohort(np.arange(per))
+        self._round_step = make_fused_round_step(
+            self, n_cohort=len(cohort0), n_valid=per
+        )
+        if self._superround_k > 1:
+            if self.hbm_resident and wmask0 is None:
+                self._superround_step = make_superround_step(
+                    self, self._superround_k, n_cohort=per
+                )
+            else:
+                logger.info(
+                    "superround off: needs the HBM-resident single-device "
+                    "path (hbm_resident=%s, padded=%s)",
+                    self.hbm_resident, wmask0 is not None,
+                )
+                self._superround_k = 0
+
+    def _round_state(self) -> Dict:
+        """The donated round state (also the checkpoint payload)."""
+        state = {"global_params": self.global_params}
+        if self.server_opt_state is not None:
+            state["server_opt_state"] = self.server_opt_state
+        if self.scaffold:
+            state["c_global"] = self.c_global
+            state["c_locals"] = self.c_locals
+        return state
+
+    def _set_round_state(self, state: Dict) -> None:
+        """Adopt the round state returned by a donated program. The previous
+        buffers are CONSUMED by donation — never read them again."""
+        self.global_params = state["global_params"]
+        if "server_opt_state" in state:
+            self.server_opt_state = state["server_opt_state"]
+        if self.scaffold:
+            self.c_global = state["c_global"]
+            self.c_locals = state["c_locals"]
+
+    def run_round(self, round_idx: int) -> Dict[str, float]:
+        """One federated round: the fused single-program path when the config
+        allows it, the legacy multi-dispatch ``_train_round`` otherwise."""
+        if not self._fusion_ready:
+            self._setup_round_fusion()
+        if self._round_step is None:
+            return self._train_round(round_idx)
+        return self._train_round_fused(round_idx)
+
+    def run_rounds(self, start_round: int, k: int) -> Dict[str, Any]:
+        """Run rounds [start_round, start_round + k) — ONE superround launch
+        when the config compiled one for exactly ``k`` rounds, else a Python
+        loop of single rounds. Returns ``{"train_loss": losses}`` with one
+        (device-resident) loss per round."""
+        if not self._fusion_ready:
+            self._setup_round_fusion()
+        if self._superround_step is not None and k == self._superround_k:
+            self._prepare_round()
+            state, losses = self._superround_step(
+                self._place_state(self._round_state()), jnp.int32(start_round)
+            )
+            self._set_round_state(state)
+            return {"train_loss": losses}
+        return {"train_loss": [
+            self.run_round(start_round + j)["train_loss"] for j in range(k)
+        ]}
+
+    def _train_round_fused(self, round_idx: int) -> Dict[str, float]:
+        """One round as ONE donated device program (round_engine.py).
+
+        Returns train_loss as a DEVICE scalar — no host sync. train() keeps
+        dispatch asynchronous: while the device executes round r, the host
+        already samples and gathers round r+1's cohort.
+        """
+        self._prepare_round()
+        cohort, wmask = self._pad_cohort(self._client_sampling(round_idx))
+        cx, cy, cn = self._gather_cohort(cohort)
+        round_rng = jax.random.fold_in(self.root_rng, round_idx)
+        rngs = self._place(jax.random.split(round_rng, len(cohort)))
+        wm = None if wmask is None else self._place(jnp.asarray(wmask))
+        cohort_idx = jnp.asarray(cohort, jnp.int32)
+        state, metrics = self._round_step(
+            self._place_state(self._round_state()),
+            cohort_idx, cx, cy, cn, rngs, wm, round_rng,
+        )
+        self._set_round_state(state)
+        return {"train_loss": metrics["train_loss"]}
+
+    # -- one round (legacy multi-dispatch path; kept as the numerical
+    # -- reference the fusion parity tests compare against) -----------------
     def _train_round(self, round_idx: int) -> Dict[str, float]:
+        self._prepare_round()
         cohort, wmask = self._pad_cohort(self._client_sampling(round_idx))
         n_valid = len(cohort) if wmask is None else int(wmask.sum())
         cx, cy, cn = self._gather_cohort(cohort)
@@ -313,7 +464,7 @@ class FedAvgAPI:
             tau = metrics["tau"]
             p = weights / jnp.maximum(weights.sum(), 1e-12)
             tau_eff = (p * tau).sum()
-            norm_dir = _fednova_normalized_direction(self.global_params, stacked, tau)
+            norm_dir = fednova_normalized_direction(self.global_params, stacked, tau)
             d = weighted_average(norm_dir, weights)
             self.global_params = jax.tree.map(
                 lambda g, dd: g - tau_eff * dd, self.global_params, d
@@ -413,13 +564,10 @@ class FedAvgAPI:
     # present) persists via Orbax every checkpoint_every_rounds rounds and
     # train() resumes mid-federation after a crash.
     def _ckpt_state(self) -> Dict:
-        state = {"global_params": self.global_params}
-        if self.server_opt_state is not None:
-            state["server_opt_state"] = self.server_opt_state
-        if self.scaffold:
-            state["c_global"] = self.c_global
-            state["c_locals"] = self.c_locals
-        return state
+        # same structure as the donated round state; CheckpointManager.save
+        # copies every leaf to host BEFORE the next round's donation can
+        # invalidate these buffers (tested in test_round_fusion.py)
+        return self._round_state()
 
     def _maybe_resume(self, ckpt) -> int:
         """Restore the newest round checkpoint; returns the round to START."""
@@ -459,36 +607,89 @@ class FedAvgAPI:
                     self.global_params, self.ds.test_x, self.ds.test_y
                 )
                 return last_eval
-            for round_idx in range(start_round, rounds):
-                self.args.round_idx = round_idx
-                mlops.log_round_info(round_idx, rounds)
+            round_idx = start_round
+            while round_idx < rounds:
+                k = self._chunk_len(round_idx, rounds, freq,
+                                    every if ckpt is not None else 0)
+                self.args.round_idx = round_idx + k - 1
                 t0 = time.perf_counter()
-                with mlops.MLOpsProfilerEvent("train"):
-                    train_metrics = self._train_round(round_idx)
-                dt = time.perf_counter() - t0
-                entry = {"round": round_idx, "round_time_s": dt,
-                         **train_metrics}
-                if round_idx % freq == 0 or round_idx == rounds - 1:
+                if k > 1:
+                    # superround: K rounds in one donated scan program;
+                    # per-round losses come back stacked [K]
+                    with mlops.MLOpsProfilerEvent("train"):
+                        losses = self.run_rounds(round_idx, k)["train_loss"]
+                    dt = time.perf_counter() - t0
+                    for j in range(k):
+                        mlops.log_round_info(round_idx + j, rounds)
+                        self.history.append({
+                            "round": round_idx + j, "round_time_s": dt / k,
+                            "train_loss": losses[j],
+                        })
+                else:
+                    mlops.log_round_info(round_idx, rounds)
+                    with mlops.MLOpsProfilerEvent("train"):
+                        train_metrics = self.run_round(round_idx)
+                    dt = time.perf_counter() - t0
+                    self.history.append({
+                        "round": round_idx, "round_time_s": dt,
+                        **train_metrics,
+                    })
+                last_round = round_idx + k - 1
+                entry = self.history[-1]
+                if last_round % freq == 0 or last_round == rounds - 1:
                     last_eval = self.evaluate(
                         self.global_params, self.ds.test_x, self.ds.test_y
                     )
                     entry.update(last_eval)
-                    mlops.log({"round": round_idx, **last_eval},
-                              step=round_idx)
+                    mlops.log({"round": last_round, **last_eval},
+                              step=last_round)
                     logger.info(
                         "round %d: loss=%.4f acc=%.4f (%.3fs)",
-                        round_idx, last_eval["test_loss"],
-                        last_eval["test_acc"], dt,
+                        last_round, last_eval["test_loss"],
+                        last_eval["test_acc"], dt / k,
                     )
-                self.history.append(entry)
                 if ckpt is not None and (
-                    (round_idx + 1) % every == 0 or round_idx == rounds - 1
+                    (last_round + 1) % every == 0 or last_round == rounds - 1
                 ):
-                    ckpt.save(self._ckpt_state(), step=round_idx)
+                    ckpt.save(self._ckpt_state(), step=last_round)
+                round_idx += k
         finally:
             if ckpt is not None:  # release Orbax threads even on a crash
                 ckpt.close()
+            self._finalize_history()
         return last_eval
+
+    def _chunk_len(self, r: int, rounds: int, freq: int, every: int) -> int:
+        """Superround chunk length starting at round ``r``.
+
+        Returns the configured K only when no round STRICTLY INSIDE the chunk
+        needs a host-side action (eval or checkpoint) — those may only land on
+        the chunk's last round, where the scan has already returned. Anything
+        else runs as a single round, so the observable eval/checkpoint
+        schedule is identical to the unchunked loop. At most two programs ever
+        compile: the K-scan and the single round.
+        """
+        k = self._superround_k
+        if k <= 1 or r + k > rounds:
+            return 1
+        if not self._fusion_ready:
+            self._setup_round_fusion()
+        if self._superround_step is None:
+            return 1
+        for ri in range(r, r + k - 1):
+            if ri % freq == 0:
+                return 1
+            if every and (ri + 1) % every == 0:
+                return 1
+        return k
+
+    def _finalize_history(self) -> None:
+        """Realize any still-on-device train_loss scalars (the fused path
+        keeps dispatch async — metrics are only pulled here or at evals)."""
+        for e in self.history:
+            tl = e.get("train_loss")
+            if tl is not None and not isinstance(tl, float):
+                e["train_loss"] = float(np.asarray(tl))
 
 
 def _masked_mean(values, wmask) -> float:
@@ -499,11 +700,3 @@ def _masked_mean(values, wmask) -> float:
         return float(jnp.mean(values))
     return float((values * wmask).sum() / jnp.maximum(wmask.sum(), 1.0))
 
-
-def _fednova_normalized_direction(global_params, stacked, tau):
-    """Per-client normalized direction (w_g - w_i)/tau_i, leaf-wise."""
-    return jax.tree.map(
-        lambda g, s: (g[None] - s) / tau.reshape((-1,) + (1,) * (s.ndim - 1)),
-        global_params,
-        stacked,
-    )

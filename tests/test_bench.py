@@ -200,6 +200,48 @@ def test_crashed_leg_records_error_and_continues(partial_path, capsys):
     assert "oom" in final["cheetah_moe_error"]
 
 
+def test_bench_legs_env_filters_legs(partial_path, capsys, monkeypatch):
+    calls = []
+
+    def runner(argv, timeout):
+        calls.append(argv)
+        return _tpu_runner(argv, timeout)
+
+    monkeypatch.setenv("BENCH_LEGS", "fedavg")
+    final = bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=runner,
+                           device_prober=V5E)
+    assert len(calls) == 1 and "--leg fedavg" in " ".join(calls[0])
+    assert final["value"] == 1.25
+    assert "cheetah_mfu" not in final  # unselected legs neither run nor skip
+    assert "cheetah_skipped" not in final
+
+
+def test_fedavg_compile_fields_pass_through(partial_path, capsys):
+    """Compile wall and steady-state rounds/s are separate fields, so cache
+    wins are visible in BENCH_*.json (ISSUE 1 satellite)."""
+
+    def runner(argv, timeout):
+        if "--leg fedavg" in " ".join(argv):
+            return {"rounds_per_sec": 2.5, "platform": "tpu",
+                    "device_kind": "TPU v5 lite", "fedavg_compile_s": 61.2,
+                    "fedavg_round_fused": True, "fedavg_superround_k": 10}
+        return _tpu_runner(argv, timeout)
+
+    final = bench.run_legs(budget_s=1e6, ttl_s=1e6, runner=runner,
+                           device_prober=V5E)
+    assert final["value"] == 2.5
+    assert final["fedavg_compile_s"] == 61.2
+    assert final["fedavg_round_fused"] is True
+    assert final["fedavg_superround_k"] == 10
+
+    # the CPU smoke translation keeps them too (bench_smoke.sh reads them)
+    res, platform = bench._translate_fedavg(
+        {"rounds_per_sec": 9.0, "platform": "cpu", "device_kind": "cpu",
+         "fedavg_compile_s": 1.5, "fedavg_round_fused": True})
+    assert platform == "cpu"
+    assert res["fedavg_compile_s"] == 1.5 and res["fedavg_round_fused"] is True
+
+
 def test_unreachable_tunnel_fails_fast_with_parseable_tail(partial_path,
                                                            capsys):
     """Tunnel down (probe fails FAST with an error) + empty cache: legs

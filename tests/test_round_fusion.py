@@ -1,0 +1,304 @@
+"""Fused round engine tests (simulation/round_engine.py — ISSUE 1).
+
+Pins the four guarantees of the fused, donated, cache-warm round engine:
+
+1. **Numerical parity**: the fused single-program round produces the same
+   global params as the legacy multi-dispatch ``_train_round`` (atol 1e-5,
+   and in practice bitwise on most paths) for every FedAvg-family optimizer
+   and the DP/attack/defense trust paths, on both the sp and mesh backends.
+2. **Donation safety**: the round state really is donated (use-after-donate
+   raises), and ``CheckpointManager.save`` copies every leaf to host BEFORE
+   the next round's dispatch can invalidate the buffers — so checkpoint /
+   resume under fusion matches an uninterrupted run exactly.
+3. **Recompilation regression guard**: steady state is ONE compile of the
+   fused ``round_step`` per (backend, optimizer) config — 5 rounds, cache
+   size 1 (lowering-cache inspection via ``jit._cache_size()``).
+4. **Superround**: K rounds per launch under ``lax.scan`` with on-device
+   sampling — under full participation (sampling degenerates to ``arange``
+   on both paths) it matches the unfused reference exactly; eval/checkpoint
+   schedules are preserved by the chunker; at most two programs compile.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+import pytest
+
+import fedml_tpu as fedml
+from fedml_tpu import data as data_mod
+from fedml_tpu import models as model_mod
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.simulation.mesh_api import MeshFedAvgAPI
+from fedml_tpu.simulation.sp_api import FedAvgAPI
+
+
+def make_api(fusion="auto", backend="sp", **kw):
+    base = dict(
+        dataset="synthetic", model="lr", client_num_in_total=16,
+        client_num_per_round=8, comm_round=3, epochs=1, batch_size=16,
+        learning_rate=0.1, frequency_of_the_test=100, round_fusion=fusion,
+    )
+    base.update(kw)
+    args = fedml.init(Arguments(overrides=base), should_init_logs=False)
+    ds, od = data_mod.load(args)
+    cls = MeshFedAvgAPI if backend == "mesh" else FedAvgAPI
+    return cls(args, fedml.get_device(args), ds, model_mod.create(args, od))
+
+
+def max_param_diff(a, b) -> float:
+    la = jax.tree.leaves(a.global_params)
+    lb = jax.tree.leaves(b.global_params)
+    return max(
+        float(np.abs(np.asarray(x) - np.asarray(y)).max())
+        for x, y in zip(la, lb)
+    )
+
+
+class TestFusionParity:
+    """Fused round_step vs the unfused reference, 3 rounds, atol 1e-5."""
+
+    @pytest.mark.parametrize(
+        "opt", ["FedAvg", "FedProx", "FedOpt", "FedNova", "SCAFFOLD", "FedSGD"]
+    )
+    def test_optimizer_parity(self, opt):
+        kw = dict(federated_optimizer=opt)
+        if opt == "FedOpt":
+            kw.update(server_optimizer="adam", server_lr=0.03)
+        ref = make_api("off", **kw)
+        fused = make_api("on", **kw)
+        assert fused._round_step is None  # built lazily
+        for r in range(3):
+            mr = ref.run_round(r)
+            mf = fused.run_round(r)
+            assert np.isclose(
+                float(np.asarray(mf["train_loss"])), mr["train_loss"],
+                atol=1e-5,
+            )
+        assert fused._round_step is not None
+        assert ref._round_step is None  # "off" stays on the legacy path
+        assert max_param_diff(ref, fused) < 1e-5
+
+    @pytest.mark.parametrize("dp_type", ["cdp", "ldp"])
+    def test_dp_parity(self, dp_type):
+        kw = dict(enable_dp=True, dp_type=dp_type, mechanism_type="gaussian",
+                  epsilon=5.0)
+        ref = make_api("off", **kw)
+        fused = make_api("on", **kw)
+        for r in range(3):
+            ref.run_round(r)
+            fused.run_round(r)
+        assert max_param_diff(ref, fused) < 1e-5
+
+    def test_attack_defense_parity(self):
+        kw = dict(enable_attack=True, attack_type="byzantine_random",
+                  byzantine_client_frac=0.3, byzantine_scale=30.0,
+                  enable_defense=True, defense_type="multikrum",
+                  byzantine_client_num=3)
+        ref = make_api("off", **kw)
+        fused = make_api("on", **kw)
+        for r in range(3):
+            ref.run_round(r)
+            fused.run_round(r)
+        assert max_param_diff(ref, fused) < 1e-5
+
+    @pytest.mark.parametrize("kw", [
+        dict(),
+        dict(client_num_per_round=6),  # cohort padding + zero-weight mask
+        dict(federated_optimizer="SCAFFOLD"),
+    ])
+    def test_mesh_parity(self, kw):
+        ref = make_api("off", backend="mesh", **kw)
+        fused = make_api("on", backend="mesh", **kw)
+        for r in range(3):
+            ref.run_round(r)
+            fused.run_round(r)
+        assert max_param_diff(ref, fused) < 1e-5
+
+    def test_blocked_configs_fall_back_and_on_raises(self):
+        from fedml_tpu.ml.aggregator import DefaultServerAggregator
+
+        base = dict(
+            dataset="synthetic", model="lr", client_num_in_total=8,
+            client_num_per_round=4, comm_round=1, epochs=1, batch_size=16,
+            learning_rate=0.1,
+        )
+        # auto + custom aggregator: silently unfused
+        args = fedml.init(Arguments(overrides=base), should_init_logs=False)
+        ds, od = data_mod.load(args)
+        bundle = model_mod.create(args, od)
+        api = FedAvgAPI(args, fedml.get_device(args), ds, bundle,
+                        server_aggregator=DefaultServerAggregator(bundle, args))
+        api.run_round(0)
+        assert api._round_step is None
+        # on + custom aggregator: loud error
+        args2 = fedml.init(
+            Arguments(overrides=dict(base, round_fusion="on")),
+            should_init_logs=False,
+        )
+        with pytest.raises(ValueError, match="cannot fuse"):
+            FedAvgAPI(args2, fedml.get_device(args2), ds, bundle,
+                      server_aggregator=DefaultServerAggregator(bundle, args2))
+        # bad mode string: loud error
+        with pytest.raises(ValueError, match="round_fusion"):
+            make_api("sideways")
+
+    def test_aggregate_override_blocks_fusion(self):
+        """TurboAggregate's additive-share _aggregate must never be bypassed
+        by the fused mirror — a fused round would silently degrade secure
+        aggregation to a trusted-server weighted average."""
+        from fedml_tpu.simulation.turboaggregate_api import TurboAggregateAPI
+
+        base = dict(
+            dataset="synthetic", model="lr", client_num_in_total=8,
+            client_num_per_round=4, comm_round=1, epochs=1, batch_size=16,
+            learning_rate=0.1,
+        )
+        args = fedml.init(Arguments(overrides=base), should_init_logs=False)
+        ds, od = data_mod.load(args)
+        bundle = model_mod.create(args, od)
+        api = TurboAggregateAPI(args, fedml.get_device(args), ds, bundle)
+        assert any("_aggregate" in b for b in api._fusion_blockers())
+        api.run_round(0)
+        assert api._round_step is None  # auto fell back to the unfused path
+
+
+class TestDonationSafety:
+    def test_state_is_donated(self):
+        api = make_api("on")
+        api.run_round(0)  # builds the program; state now holds round-0 output
+        old_leaf = jax.tree.leaves(api.global_params)[0]
+        api.run_round(1)  # donates round-0 buffers
+        with pytest.raises(RuntimeError):
+            np.asarray(old_leaf)  # use-after-donate must raise, not read junk
+
+    def test_checkpoint_copies_to_host_before_next_dispatch(self, tmp_path):
+        from fedml_tpu.checkpoint import CheckpointManager
+
+        api = make_api("on", federated_optimizer="SCAFFOLD")
+        api.run_round(0)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        received = {}
+        orig_save = mgr._mgr.save
+
+        def spy(step, args=None, **kw):
+            received["state"] = args.item
+            return orig_save(step, args=args, **kw)
+
+        mgr._mgr.save = spy
+        try:
+            mgr.save(api._ckpt_state(), step=0)
+            # every leaf orbax sees must already be a HOST array — a device
+            # reference would be invalidated by the next round's donation
+            assert all(
+                isinstance(leaf, np.ndarray)
+                for leaf in jax.tree.leaves(received["state"])
+            )
+            api.run_round(1)  # donates the checkpointed device buffers
+            restored = mgr.restore_latest(api._ckpt_state())
+            assert restored is not None  # checkpoint survives the donation
+            for leaf in jax.tree.leaves(restored):
+                np.asarray(leaf)  # every restored leaf is readable
+        finally:
+            mgr.close()
+
+    @pytest.mark.parametrize("opt", ["FedAvg", "FedOpt", "SCAFFOLD"])
+    def test_fused_resume_matches_uninterrupted(self, tmp_path, opt):
+        kw = dict(federated_optimizer=opt, round_fusion="on")
+        if opt == "FedOpt":
+            kw.update(server_optimizer="adam", server_lr=0.03)
+        ref = make_api(comm_round=6, **kw)
+        ref.train()
+
+        ck = dict(kw, checkpoint_dir=str(tmp_path / f"ck_{opt}"))
+        api1 = make_api(comm_round=3, **ck)
+        api1.train()  # "crash" after 3 rounds
+        api2 = make_api(comm_round=6, **ck)
+        api2.train()
+        assert [e["round"] for e in api2.history] == [3, 4, 5]
+        assert max_param_diff(ref, api2) < 1e-6
+
+
+class TestRecompilationGuard:
+    """Steady state = ONE compile of round_step per (backend, optimizer)."""
+
+    @pytest.mark.parametrize("backend", ["sp", "mesh"])
+    @pytest.mark.parametrize("opt", ["FedAvg", "FedOpt"])
+    def test_one_compile_across_five_rounds(self, backend, opt):
+        kw = dict(federated_optimizer=opt, comm_round=5,
+                  frequency_of_the_test=2)
+        if opt == "FedOpt":
+            kw.update(server_optimizer="adam", server_lr=0.03)
+        api = make_api("on", backend=backend, **kw)
+        api.train()
+        assert len(api.history) == 5
+        # lowering-cache inspection: one entry == one compile of round_step
+        assert api._round_step._cache_size() == 1
+
+    def test_losses_realized_as_floats(self):
+        api = make_api("on", comm_round=4)
+        api.train()
+        for e in api.history:
+            assert isinstance(e["train_loss"], float)
+            assert np.isfinite(e["train_loss"])
+
+
+class TestSuperround:
+    def _mk(self, fusion="on", **kw):
+        base = dict(client_num_in_total=8, client_num_per_round=8,
+                    frequency_of_the_test=1000)
+        base.update(kw)
+        return make_api(fusion, **base)
+
+    def test_full_participation_matches_unfused_exactly(self):
+        # full participation: both the host sampler and the on-device sampler
+        # degenerate to arange, so the trajectories must coincide bit for bit
+        ref = self._mk("off", comm_round=7)
+        for r in range(7):
+            ref.run_round(r)
+        sup = self._mk("on", comm_round=7, superround_k=3)
+        sup.train()
+        assert [e["round"] for e in sup.history] == list(range(7))
+        assert max_param_diff(ref, sup) < 1e-6
+        # at most two programs: the K-scan and the single-round step
+        assert sup._superround_step._cache_size() == 1
+        assert sup._round_step._cache_size() <= 1
+
+    def test_partial_participation_trains_and_is_deterministic(self):
+        a = make_api("on", client_num_in_total=16, client_num_per_round=4,
+                     comm_round=9, superround_k=4, frequency_of_the_test=1000)
+        res_a = a.train()
+        b = make_api("on", client_num_in_total=16, client_num_per_round=4,
+                     comm_round=9, superround_k=4, frequency_of_the_test=1000)
+        res_b = b.train()
+        assert res_a["test_acc"] == pytest.approx(res_b["test_acc"])
+        assert res_a["test_acc"] > 0.5
+        assert [e["round"] for e in a.history] == list(range(9))
+
+    def test_eval_schedule_preserved_under_chunking(self):
+        # freq=2: an eval lands inside any 4-round chunk, so the chunker must
+        # fall back to single rounds — and every eval round gets its metrics
+        api = self._mk("on", comm_round=6, superround_k=4,
+                       frequency_of_the_test=2)
+        api.train()
+        evaled = [e["round"] for e in api.history if "test_acc" in e]
+        assert evaled == [0, 2, 4, 5]
+
+    def test_superround_respects_checkpoint_schedule(self, tmp_path):
+        api = self._mk("on", comm_round=8, superround_k=4,
+                       checkpoint_dir=str(tmp_path / "ck"),
+                       checkpoint_every_rounds=8)
+        api.train()
+        mgr = ocp.CheckpointManager(str(tmp_path / "ck"))
+        try:
+            assert mgr.latest_step() == 7
+        finally:
+            mgr.close()
+
+    def test_run_rounds_helper_falls_back_without_superround(self):
+        api = make_api("on", client_num_in_total=16, client_num_per_round=4,
+                       comm_round=4)
+        out = api.run_rounds(0, 3)  # no compiled K=3 scan: python loop
+        assert len(out["train_loss"]) == 3
+        assert api._superround_step is None

@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Smoke-check the bench harness itself: a 2-round lr leg on XLA:CPU through
+# the FULL orchestrator (probe -> leg subprocess -> cumulative JSON line),
+# under a hard 120 s timeout. Guards the one failure mode that zeroed round 4
+# (rc=124 with an empty tail): whatever happens, the bench must exit 0-ish
+# fast and leave a parseable JSON tail.
+#
+# Usage: tools/bench_smoke.sh          (CI: exits non-zero on any regression)
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+out=$(timeout -k 10 120 env \
+    BENCH_PLATFORM=cpu \
+    BENCH_SMOKE=1 \
+    BENCH_LEGS=fedavg \
+    BENCH_BUDGET_S=110 \
+    BENCH_MIN_LEG_S=5 \
+    BENCH_LEG_TIMEOUT_S=100 \
+    BENCH_CACHE_TTL_S=0 \
+    python bench.py 2>/dev/null)
+rc=$?
+
+if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    echo "bench_smoke: FAIL — bench hit the hard timeout (rc=$rc)" >&2
+    exit 1
+fi
+if [ "$rc" -ne 0 ]; then
+    echo "bench_smoke: FAIL — bench exited rc=$rc" >&2
+    exit 1
+fi
+
+tail_line=$(printf '%s\n' "$out" | tail -n 1)
+python - "$tail_line" <<'EOF'
+import json
+import sys
+
+line = json.loads(sys.argv[1])
+assert line["metric"] == "fedavg_rounds_per_sec_100clients_cifar10_resnet56", line
+# the CPU smoke leg must have completed (not errored, not skipped)
+ok = ("fedavg_cpu_smoke_rounds_per_sec" in line
+      and "fedavg_error" not in line
+      and "fedavg_skipped" not in line)
+assert ok, f"fedavg smoke leg did not complete: {line}"
+print("bench_smoke: OK —",
+      f"{line['fedavg_cpu_smoke_rounds_per_sec']:.2f} rounds/s,",
+      f"compile {line.get('fedavg_compile_s', '?')}s,",
+      f"fused={line.get('fedavg_round_fused')}")
+EOF
