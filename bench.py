@@ -142,7 +142,7 @@ _CHEETAH_SOURCES = [
 ]
 _FEDAVG_SOURCES = [
     "fedml_tpu/simulation/sp_api.py", "fedml_tpu/simulation/round_engine.py",
-    "fedml_tpu/ml/local_train.py",
+    "fedml_tpu/ml/local_train.py", "fedml_tpu/core/mlops/telemetry.py",
     "fedml_tpu/models/vision.py", "fedml_tpu/data/datasets.py", "bench.py",
 ]
 
@@ -225,7 +225,12 @@ def bench_fedavg() -> dict:
     from fedml_tpu import data as data_mod
     from fedml_tpu import models as model_mod
     from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core.mlops import telemetry
     from fedml_tpu.simulation.sp_api import FedAvgAPI
+
+    # count compiles + compilation-cache hits from the very first jit, so
+    # the telemetry the leg reports covers the compile wall too
+    telemetry.install_jax_listeners()
 
     platform = jax.devices()[0].platform
     if platform == "tpu":
@@ -281,11 +286,45 @@ def bench_fedavg() -> dict:
     api.run_rounds(warmup * n_rounds, n_rounds)
     _sync(api.global_params)
     dt = time.perf_counter() - t0
+
+    # tracked pass (telemetry plane): runs AFTER the timed window so
+    # tracking can never tax the steady-state number. One RoundRecord per
+    # round supplies the per-phase breakdown BENCH_*.json carries; the
+    # JSONL log + metrics exposition land in BENCH_TRACKING_DIR when set
+    # (tools/bench_smoke.sh asserts both parse), a temp dir otherwise.
+    import tempfile
+
+    from fedml_tpu.core import mlops
+
+    track_dir = (os.environ.get("BENCH_TRACKING_DIR")
+                 or tempfile.mkdtemp(prefix="fedml_bench_track_"))
+    args.enable_tracking = True
+    args.tracking_dir = track_dir
+    # pid-unique run id: a persistent BENCH_TRACKING_DIR must not append
+    # this run's records onto a previous run's JSONL (read_events would
+    # then sum stale rounds into the phase breakdown)
+    args.run_id = f"bench_fedavg_{os.getpid()}"
+    args.metrics_file = os.path.join(track_dir, "metrics.prom")
+    mlops.init(args)
+    t0 = time.perf_counter()
+    api.run_rounds((warmup + 1) * n_rounds, n_rounds)
+    tracked_wall = time.perf_counter() - t0
+    phases, n_records = mlops.phase_totals(mlops.read_events())
+    counters = telemetry.registry().snapshot()["counters"]
+    mlops.close()  # emits the telemetry summary + forces the metrics file
+
     return {
         "rounds_per_sec": n_rounds / dt,
         "fedavg_compile_s": round(compile_s, 3),
         "fedavg_round_fused": api._round_step is not None,
         "fedavg_superround_k": api._superround_k or 0,
+        "fedavg_phases": {k: round(v, 4) for k, v in phases.items()},
+        "fedavg_phase_rounds": n_records,
+        "fedavg_tracked_wall_s": round(tracked_wall, 4),
+        "fedavg_compile_cache_hits": int(
+            counters.get("jax.compilation_cache.hits", 0)),
+        "fedavg_compile_cache_misses": int(
+            counters.get("jax.compilation_cache.misses", 0)),
         "platform": platform,
         "device_kind": jax.devices()[0].device_kind,
     }
@@ -366,6 +405,34 @@ def bench_cheetah() -> dict:
     _sync(metrics["loss"])
     dt = time.perf_counter() - t0
 
+    # tracked pass: two telemetry-instrumented steps AFTER the timed window
+    # give the leg its data/step/loss_sync phase breakdown
+    import tempfile
+    import types
+
+    from fedml_tpu.core import mlops
+    from fedml_tpu.core.mlops import telemetry
+
+    targs = types.SimpleNamespace(
+        enable_tracking=True, run_id=f"bench_cheetah_{os.getpid()}", rank=0,
+        tracking_dir=(os.environ.get("BENCH_TRACKING_DIR")
+                      or tempfile.mkdtemp(prefix="fedml_bench_track_")),
+    )
+    mlops.init(targs)
+    for i in range(2):
+        rec = telemetry.begin_round(i)
+        with telemetry.phase("data"):
+            tok = batch_tokens()
+        with telemetry.phase("step"):
+            state, metrics = trainer.train_step(state, tok, mask)
+        with telemetry.phase("loss_sync"):
+            _sync(metrics["loss"])
+        if rec is not None:
+            rec.lazy["examples"] = tok.size
+        telemetry.end_round(rec)
+    phases, _ = mlops.phase_totals(mlops.read_events())
+    mlops.close()
+
     tokens = steps * batch * seq
     tps = tokens / dt
     # model FLOPs per token (fwd+bwd): 6N matmul + 12·L·layers·d_model attn
@@ -380,6 +447,7 @@ def bench_cheetah() -> dict:
         "cheetah_seq_len": seq,
         "cheetah_device_kind": kind,
         "cheetah_remat": cfg.remat_policy if cfg.remat else "none",
+        "cheetah_phases": {k: round(v, 4) for k, v in phases.items()},
         "platform": platform,
     }
     if peak:
@@ -459,7 +527,9 @@ def _translate_fedavg(parsed: dict):
     extras = {
         k: parsed[k]
         for k in ("fedavg_compile_s", "fedavg_round_fused",
-                  "fedavg_superround_k")
+                  "fedavg_superround_k", "fedavg_phases",
+                  "fedavg_phase_rounds", "fedavg_tracked_wall_s",
+                  "fedavg_compile_cache_hits", "fedavg_compile_cache_misses")
         if k in parsed
     }
     if platform != "tpu":

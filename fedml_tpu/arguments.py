@@ -79,10 +79,23 @@ _SCHEMA: Dict[str, tuple] = {
     "grpc_ipconfig_path": (str, ""),
     "comm_host": (str, "127.0.0.1"),
     "comm_port": (int, 8890),
-    # tracking
+    # tracking / telemetry (core/mlops/telemetry.py)
     "enable_tracking": (bool, False),
+    "tracking_dir": (str, ""),  # JSONL event sink dir (default .fedml_tpu_runs)
+    "enable_wandb": (bool, False),
+    # Prometheus-style text exposition of the metrics registry, refreshed
+    # during the run and at exit. Empty = no file.
+    "metrics_file": (str, ""),
+    # jax.profiler trace window over rounds/steps [N, M): "N:M" (bare "N"
+    # traces one round). Works with or without enable_tracking.
+    "profile_rounds": (str, ""),
+    "profile_dir": (str, ""),  # trace output dir (default: tracking dir)
+    # periodic host CPU/RSS + HBM sampler (daemon thread); 0 = off
+    "sys_perf_interval_s": (float, 0.0),
     "run_id": (str, "0"),
     "rank": (int, 0),
+    "local_rank": (int, 0),
+    "node_rank": (int, 0),
     "role": (str, "client"),
     # security
     "enable_attack": (bool, False),
@@ -136,16 +149,21 @@ class Arguments:
         # defaults first
         for key, (_, default) in _SCHEMA.items():
             setattr(self, key, default)
-        # YAML config
+        # YAML config, then explicitly passed CLI flags back on top: an
+        # absent flag (None) defers to the YAML key, a passed flag wins
         if cmd_args is not None:
-            for k, v in vars(cmd_args).items():
-                if v is not None:
-                    setattr(self, k, v)
+            passed = {k: v for k, v in vars(cmd_args).items()
+                      if v is not None}
+            for k, v in passed.items():
+                setattr(self, k, v)
             cf = getattr(cmd_args, "yaml_config_file", None) or getattr(
                 cmd_args, "cf", None
             )
             if cf:
                 self.load_yaml_config(cf)
+                for k, v in passed.items():
+                    if k not in ("yaml_config_file", "cf"):
+                        self._set_typed(k, v)
         if training_type:
             self.training_type = training_type
         if comm_backend:
@@ -255,11 +273,14 @@ def add_args() -> argparse.Namespace:
     parser.add_argument(
         "--yaml_config_file", "--cf", type=str, default="", help="yaml config file"
     )
-    parser.add_argument("--run_id", type=str, default="0")
-    parser.add_argument("--rank", type=int, default=0)
-    parser.add_argument("--local_rank", type=int, default=0)
-    parser.add_argument("--node_rank", type=int, default=0)
-    parser.add_argument("--role", type=str, default="client")
+    # defaults None throughout: _SCHEMA supplies the real defaults, and a
+    # None means "not passed" so YAML keys win only for absent flags (an
+    # explicitly passed flag beats YAML — see Arguments.__init__)
+    parser.add_argument("--run_id", type=str, default=None)
+    parser.add_argument("--rank", type=int, default=None)
+    parser.add_argument("--local_rank", type=int, default=None)
+    parser.add_argument("--node_rank", type=int, default=None)
+    parser.add_argument("--role", type=str, default=None)
     parser.add_argument(
         "--silo_device_indices", type=int, nargs="*", default=None,
         help="chips this silo trains over (intra-silo data parallelism)",
@@ -268,6 +289,31 @@ def add_args() -> argparse.Namespace:
         "--compilation_cache_dir", type=str, default=None,
         help="persistent XLA compilation cache dir (repeat runs skip the "
         "compile wall); also settable via YAML common_args",
+    )
+    # telemetry plane (defaults None so YAML keys win when the flag is absent)
+    parser.add_argument(
+        "--enable_tracking", action="store_true", default=None,
+        help="emit JSONL events + per-round telemetry RoundRecords",
+    )
+    parser.add_argument(
+        "--tracking_dir", type=str, default=None,
+        help="JSONL event sink directory (default .fedml_tpu_runs)",
+    )
+    parser.add_argument(
+        "--metrics_file", type=str, default=None,
+        help="write the metrics registry as Prometheus text exposition here",
+    )
+    parser.add_argument(
+        "--profile_rounds", type=str, default=None, metavar="N:M",
+        help="open a jax.profiler trace window over rounds [N, M)",
+    )
+    parser.add_argument(
+        "--profile_dir", type=str, default=None,
+        help="profiler trace output dir (default: tracking dir)",
+    )
+    parser.add_argument(
+        "--sys_perf_interval_s", type=float, default=None,
+        help="sample host CPU/RSS + HBM every N seconds (0 = off)",
     )
     args, _ = parser.parse_known_args()
     return args

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.mlops import telemetry
 from ..parallel.sharding import make_mesh
 from ..parallel.train_step import CheetahTrainer, make_optimizer
 from ..parallel.transformer import TransformerConfig
@@ -173,23 +174,51 @@ class CheetahRunner:
         t0 = time.perf_counter()
         tokens_done = 0
         every = int(getattr(self.args, "checkpoint_every_rounds", 0) or 0)
+        # per-step telemetry denominators (the Cheetah "round" is a step):
+        # model FLOPs/token for the live MFU gauge, chip peak by device kind
+        n_params = sum(int(p.size) for p in jax.tree.leaves(state.params))
+        flops_tok = telemetry.flops_per_token(
+            n_params, self.seq_len, self.cfg.n_layers, self.cfg.d_model
+        )
+        device_kind = str(getattr(jax.devices()[0], "device_kind", "?"))
+        n_chips = jax.device_count()
         for step in range(start_step, self.total_steps):
-            tokens = next(gen)
-            mask = np.ones_like(tokens)
-            state, metrics = self.trainer.train_step(
-                state, jnp.asarray(tokens), jnp.asarray(mask)
-            )
-            losses.append(float(metrics["loss"]))
+            telemetry.on_round_start(step)
+            rec = telemetry.begin_round(step)
+            with telemetry.phase("data"):
+                tokens = next(gen)
+                mask = np.ones_like(tokens)
+            with telemetry.phase("step"):
+                state, metrics = self.trainer.train_step(
+                    state, jnp.asarray(tokens), jnp.asarray(mask)
+                )
+            with telemetry.phase("loss_sync"):
+                losses.append(float(metrics["loss"]))
             tokens_done += tokens.size
+            if rec is not None:
+                rec.lazy["examples"] = tokens.size
+            telemetry.end_round(rec, train_loss=losses[-1])
+            if rec is not None and rec.wall_s > 0:
+                tps = tokens.size / rec.wall_s
+                telemetry.gauge_set("cheetah.tokens_per_sec", tps)
+                mfu = telemetry.mfu_estimate(tps, flops_tok, device_kind,
+                                             n_chips)
+                if mfu is not None:
+                    telemetry.gauge_set("cheetah.mfu_estimate", mfu)
+            telemetry.on_round_end(step)
             if every and (step + 1) % every == 0 and self.checkpoint_dir:
                 ckpt.save(state)
         jax.block_until_ready(state.params)
         dt = time.perf_counter() - t0
+        tps = tokens_done / max(dt, 1e-9)
         result = {
             "final_loss": losses[-1] if losses else float("nan"),
             "steps": self.total_steps - start_step,
-            "tokens_per_sec": tokens_done / max(dt, 1e-9),
+            "tokens_per_sec": tps,
         }
+        mfu = telemetry.mfu_estimate(tps, flops_tok, device_kind, n_chips)
+        if mfu is not None:
+            result["mfu_estimate"] = round(mfu, 4)
         if self.checkpoint_dir:
             ckpt.save(state)
         logger.info("cheetah: %s", result)

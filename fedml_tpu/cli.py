@@ -73,20 +73,115 @@ def cmd_status(_args) -> int:
     return 0
 
 
+def _resolve_run_file(path: str) -> str:
+    """Explicit path, else the newest (by mtime — lexicographic order lies
+    once run ids pass one digit) ``.jsonl`` in the default runs dir ('')."""
+    if path:
+        return path
+    runs_dir = ".fedml_tpu_runs"
+    if not os.path.isdir(runs_dir):
+        return ""
+    files = [os.path.join(runs_dir, f) for f in os.listdir(runs_dir)
+             if f.endswith(".jsonl")]
+    return max(files, key=os.path.getmtime) if files else ""
+
+
 def cmd_logs(args) -> int:
     """Tail a run's event log (reference: fedml logs)."""
-    path = args.file or ""
+    path = _resolve_run_file(args.file)
     if not path:
-        runs_dir = ".fedml_tpu_runs"
-        files = sorted(os.listdir(runs_dir)) if os.path.isdir(runs_dir) else []
-        if not files:
-            print("no logs found")
-            return 1
-        path = os.path.join(runs_dir, files[-1])
+        print("no logs found")
+        return 1
     with open(path) as f:
         lines = f.readlines()
     for line in lines[-args.n:]:
         print(line.rstrip())
+    return 0
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def cmd_top(args) -> int:
+    """Phase-latency breakdown for a finished run's RoundRecords.
+
+    Reads the JSONL event log a tracked run wrote (``--enable_tracking``)
+    and prints, per phase, call count / total / mean / p50 / p95 and the
+    share of total round wall-clock — the "where does a round's time go"
+    table the 2,217-LoC reference MLOps plane never had.
+    """
+    from .core.mlops import read_events
+
+    path = _resolve_run_file(args.file)
+    if not path or not os.path.exists(path):
+        print("no run event log found (run with --enable_tracking)")
+        return 1
+    events = read_events(path)
+    records = [e for e in events if e.get("kind") == "round_record"]
+    if not records:
+        print(f"{path}: {len(events)} events but no round_record entries "
+              "(tracked runs emit one per round)")
+        return 1
+
+    phases = {}
+    # dispatch→ready latency overlaps the dispatch+device_wait spans, so it
+    # stays OUT of the phase table (whose % wall must not double-count) and
+    # is summarised separately below
+    dispatch_lat = []
+    for r in records:
+        for name, dur in (r.get("phases") or {}).items():
+            phases.setdefault(name, []).append(float(dur))
+        dl = r.get("dispatch_latency_s")
+        if dl is not None:
+            dispatch_lat.append(float(dl))
+    wall = sum(float(r.get("wall_s") or 0.0) for r in records)
+    rounds = len(records)
+
+    print(f"run: {path}")
+    print(f"rounds: {rounds}   wall: {wall:.3f}s   "
+          f"rounds/s: {rounds / wall if wall else float('nan'):.2f}")
+    examples = sum(float(r.get("examples") or 0.0) for r in records)
+    if examples:
+        print(f"examples: {examples:.0f}   examples/s: "
+              f"{examples / wall if wall else float('nan'):.0f}")
+    compiles = sum(int(r.get("compiles") or 0) for r in records)
+    fused = sum(1 for r in records if r.get("fused"))
+    hbm_peaks = [r.get("hbm_peak_mb") for r in records
+                 if r.get("hbm_peak_mb") is not None]
+    print(f"fused rounds: {fused}/{rounds}   compile events: {compiles}"
+          + (f"   hbm peak: {max(hbm_peaks):.1f} MB" if hbm_peaks else ""))
+    if dispatch_lat:
+        ds = sorted(dispatch_lat)
+        print(f"dispatch→ready: mean "
+              f"{1e3 * sum(ds) / len(ds):.3f}ms   "
+              f"p50 {1e3 * _percentile(ds, 0.5):.3f}ms   "
+              f"p95 {1e3 * _percentile(ds, 0.95):.3f}ms")
+    print()
+    header = (f"{'phase':<18} {'calls':>6} {'total s':>9} {'mean ms':>9} "
+              f"{'p50 ms':>8} {'p95 ms':>8} {'% wall':>7}")
+    print(header)
+    print("-" * len(header))
+    for name, vals in sorted(phases.items(), key=lambda kv: -sum(kv[1])):
+        vs = sorted(vals)
+        total = sum(vals)
+        pct = 100.0 * total / wall if wall else 0.0
+        print(f"{name:<18} {len(vals):>6} {total:>9.3f} "
+              f"{1e3 * total / len(vals):>9.3f} "
+              f"{1e3 * _percentile(vs, 0.5):>8.3f} "
+              f"{1e3 * _percentile(vs, 0.95):>8.3f} {pct:>6.1f}%")
+    summary = next((e for e in reversed(events)
+                    if e.get("kind") == "telemetry_summary"), None)
+    if summary:
+        counters = (summary.get("metrics") or {}).get("counters", {})
+        hits = counters.get("jax.compilation_cache.hits", 0)
+        misses = counters.get("jax.compilation_cache.misses", 0)
+        if hits or misses:
+            print(f"\ncompilation cache: {hits:.0f} hits / "
+                  f"{misses:.0f} misses")
     return 0
 
 
@@ -177,6 +272,7 @@ def cmd_cache(args) -> int:
     )
     if not os.path.isdir(cache_dir):
         print(f"compilation cache: {cache_dir} (empty — no directory)")
+        _report_cache_telemetry(getattr(args, "run_file", ""))
         return 0
     entries, total = [], 0
     for root, _dirs, files in os.walk(cache_dir):
@@ -199,7 +295,34 @@ def cmd_cache(args) -> int:
     print(f"compilation cache: {cache_dir}")
     print(f"  entries: {len(entries)}")
     print(f"  size:    {total / 1e6:.1f} MB")
+    _report_cache_telemetry(getattr(args, "run_file", ""))
     return 0
+
+
+def _report_cache_telemetry(run_file: str) -> None:
+    """Hit/miss counts from the newest tracked run's telemetry summary, so
+    repeat-run compile savings are visible next to the cache's disk state."""
+    from .core.mlops import read_events
+
+    path = _resolve_run_file(run_file)
+    if not path or not os.path.exists(path):
+        return
+    summary = next(
+        (e for e in reversed(read_events(path))
+         if e.get("kind") == "telemetry_summary"), None)
+    if summary is None:
+        return
+    counters = (summary.get("metrics") or {}).get("counters", {})
+    hits = counters.get("jax.compilation_cache.hits", 0)
+    misses = counters.get("jax.compilation_cache.misses", 0)
+    saved = counters.get("jax.compilation_cache.time_saved_s", 0.0)
+    compiles = counters.get("jax.compiles", 0)
+    if not (hits or misses or compiles):
+        return
+    print(f"  last tracked run ({os.path.basename(path)}):")
+    print(f"    cache hits/misses: {hits:.0f}/{misses:.0f}"
+          + (f", ~{saved:.1f}s compile time saved" if saved else ""))
+    print(f"    backend compiles:  {compiles:.0f}")
 
 
 def cmd_multihost(args) -> int:
@@ -237,6 +360,12 @@ def main(argv=None) -> int:
     p_logs = sub.add_parser("logs", help="show run event logs")
     p_logs.add_argument("--file", default="", help="specific event file")
     p_logs.add_argument("-n", type=int, default=20, help="tail lines")
+
+    p_top = sub.add_parser(
+        "top", help="phase-latency breakdown of a tracked run"
+    )
+    p_top.add_argument("file", nargs="?", default="",
+                       help="run JSONL event file (default: newest run)")
 
     p_build = sub.add_parser("build", help="package a training dir")
     p_build.add_argument("--type", "-t", choices=("client", "server"),
@@ -283,6 +412,9 @@ def main(argv=None) -> int:
                          "/tmp/fedml_tpu_bench_jax_cache)")
     p_cache.add_argument("--clear", action="store_true",
                          help="delete every cache entry")
+    p_cache.add_argument("--run_file", default="",
+                         help="run JSONL to read hit/miss telemetry from "
+                         "(default: newest run)")
 
     p_mh = sub.add_parser(
         "multihost", help="spawn N coordinated worker processes",
@@ -302,6 +434,7 @@ def main(argv=None) -> int:
         "env": cmd_env,
         "status": cmd_status,
         "logs": cmd_logs,
+        "top": cmd_top,
         "build": cmd_build,
         "login": cmd_login,
         "logout": cmd_logout,

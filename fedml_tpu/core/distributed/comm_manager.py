@@ -75,6 +75,7 @@ class FedMLCommManager(Observer):
         return self._thread
 
     def send_message(self, message: Message) -> None:
+        from ..mlops import telemetry
         from .payload_store import PAYLOAD_REF_KEY
 
         if (
@@ -84,6 +85,11 @@ class FedMLCommManager(Observer):
         ):
             # content-addressed: an N-client broadcast of the same model
             # writes one blob; stale blobs age out via TTL sweep
+            telemetry.counter_inc("comm.payload_offloads")
+            telemetry.counter_inc(
+                "comm.payload_offload_bytes",
+                sum(a.nbytes for a in message.arrays),
+            )
             key = self.payload_store.put_dedup(message.arrays)
             message.add(PAYLOAD_REF_KEY, key)
             message.set_arrays([])
@@ -93,10 +99,12 @@ class FedMLCommManager(Observer):
         self.com_manager.send_message(message)
 
     def receive_message(self, msg_type: str, msg: Message) -> None:
+        from ..mlops import telemetry
         from .payload_store import PAYLOAD_REF_KEY
 
         ref = msg.get(PAYLOAD_REF_KEY)
         if ref:
+            telemetry.counter_inc("comm.payload_fetches")
             if self.payload_store is None:
                 # fail HERE, loudly — otherwise the handler sees an empty
                 # array list and dies far away in tree_unflatten

@@ -19,10 +19,15 @@ from typing import Dict, List, Optional, Tuple
 
 import grpc
 
+from ..mlops import telemetry
 from .base_com_manager import BaseCommunicationManager, CommunicationConstants, Observer
 from .message import Message
 
 logger = logging.getLogger(__name__)
+
+# one transient UNAVAILABLE retry per send: a peer mid-restart (crash-drop
+# recovery, rolling deploy) costs a counter bump instead of a dead round
+SEND_RETRIES = 1
 
 MAX_MESSAGE_BYTES = 1024 * 1024 * 1024  # 1 GB, reference parity
 _SERVICE = "fedml_tpu.Comm"
@@ -78,11 +83,16 @@ class GRPCCommManager(BaseCommunicationManager):
         self._lock = threading.Lock()
 
         def handle_send(request: bytes, context) -> bytes:
+            telemetry.counter_inc("comm.grpc.messages_received")
+            telemetry.counter_inc("comm.grpc.bytes_received", len(request))
             self._queue.put(request)
             return b"ok"
 
         def handle_send_stream(request_iter, context) -> bytes:
-            self._queue.put(b"".join(request_iter))
+            data = b"".join(request_iter)
+            telemetry.counter_inc("comm.grpc.messages_received")
+            telemetry.counter_inc("comm.grpc.bytes_received", len(data))
+            self._queue.put(data)
             return b"ok"
 
         handlers = grpc.method_handlers_generic_handler(
@@ -140,14 +150,27 @@ class GRPCCommManager(BaseCommunicationManager):
     def send_message(self, msg: Message) -> None:
         msg.wire_format = self.wire_format
         payload = msg.serialize()
-        if len(payload) > self.stream_threshold:
-            from .tensor_transport import iter_chunks
+        telemetry.counter_inc("comm.grpc.messages_sent")
+        telemetry.counter_inc("comm.grpc.bytes_sent", len(payload))
+        for attempt in range(SEND_RETRIES + 1):
+            try:
+                if len(payload) > self.stream_threshold:
+                    from .tensor_transport import iter_chunks
 
-            self._stream_stub(msg.get_receiver_id())(
-                iter_chunks(payload), timeout=300
-            )
-        else:
-            self._stub(msg.get_receiver_id())(payload, timeout=300)
+                    self._stream_stub(msg.get_receiver_id())(
+                        iter_chunks(payload), timeout=300
+                    )
+                else:
+                    self._stub(msg.get_receiver_id())(payload, timeout=300)
+                return
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                if (attempt < SEND_RETRIES
+                        and code == grpc.StatusCode.UNAVAILABLE):
+                    telemetry.counter_inc("comm.grpc.send_retries")
+                    continue
+                telemetry.counter_inc("comm.grpc.send_failures")
+                raise
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)

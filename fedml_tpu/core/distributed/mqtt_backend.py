@@ -25,6 +25,7 @@ import queue
 import threading
 from typing import List
 
+from ..mlops import telemetry
 from .base_com_manager import BaseCommunicationManager, CommunicationConstants, Observer
 from .message import Message
 
@@ -99,13 +100,23 @@ class MqttCommManager(BaseCommunicationManager):
         return f"fedml/{self.run_id}/{rank}"
 
     def _on_mqtt_message(self, client, userdata, msg) -> None:
-        self._queue.put(base64.b64decode(msg.payload))
+        data = base64.b64decode(msg.payload)
+        telemetry.counter_inc("comm.mqtt.messages_received")
+        telemetry.counter_inc("comm.mqtt.bytes_received", len(data))
+        self._queue.put(data)
 
     def send_message(self, msg: Message) -> None:
-        self._client.publish(
+        payload = msg.serialize()
+        telemetry.counter_inc("comm.mqtt.messages_sent")
+        telemetry.counter_inc("comm.mqtt.bytes_sent", len(payload))
+        info = self._client.publish(
             self._topic(msg.get_receiver_id()),
-            base64.b64encode(msg.serialize()), qos=self.qos,
+            base64.b64encode(payload), qos=self.qos,
         )
+        # paho queues on a down broker and republishes after reconnect —
+        # count those as retries so flaky-broker runs are visible
+        if getattr(info, "rc", 0) != 0:
+            telemetry.counter_inc("comm.mqtt.send_retries")
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)

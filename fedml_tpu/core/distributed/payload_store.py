@@ -26,6 +26,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..mlops import telemetry
+
 logger = logging.getLogger(__name__)
 
 # message param carrying the reference (absent = inline payload)
@@ -77,11 +79,14 @@ class PayloadStore:
         if os.path.exists(path):
             # refresh the TTL clock: a dedup hit on a near-expired blob must
             # not leave an in-flight reference pointing at a sweep target
+            telemetry.counter_inc("payload_store.dedup_hits")
             try:
                 os.utime(path, None)
             except OSError:
                 pass
         else:
+            telemetry.counter_inc("payload_store.puts")
+            telemetry.counter_inc("payload_store.put_bytes", len(data))
             tmp = f"{path}.tmp-{uuid.uuid4().hex}"
             with open(tmp, "wb") as f:
                 f.write(data)
@@ -105,12 +110,16 @@ class PayloadStore:
                     dropped += 1
             except OSError:
                 continue
+        if dropped:
+            telemetry.counter_inc("payload_store.swept", dropped)
         return dropped
 
     def get(self, key: str, delete: bool = False) -> List[np.ndarray]:
         path = self._path(key)
         with open(path, "rb") as f:
             data = f.read()
+        telemetry.counter_inc("payload_store.gets")
+        telemetry.counter_inc("payload_store.get_bytes", len(data))
         with np.load(io.BytesIO(data)) as z:
             arrays = [z[k] for k in z.files]
         if delete:
@@ -193,6 +202,7 @@ class HttpPayloadStore(PayloadStore):
                 # could reference a sweep target. Fresh blobs skip the upload.
                 age = self._age_seconds(resp)
                 if age is not None and age < self.dedup_refresh_age_s:
+                    telemetry.counter_inc("payload_store.dedup_hits")
                     return key
                 if age is None and not self._warned_no_age:
                     # correctness over bandwidth, but never silently: a
@@ -204,6 +214,8 @@ class HttpPayloadStore(PayloadStore):
                         "put_dedup re-uploads on every hit (dedup degraded)")
         except urllib.error.HTTPError:
             pass
+        telemetry.counter_inc("payload_store.puts")
+        telemetry.counter_inc("payload_store.put_bytes", len(data))
         with self._request("PUT", key, data):
             pass
         return key
@@ -228,6 +240,8 @@ class HttpPayloadStore(PayloadStore):
         try:
             with self._request("GET", key) as resp:
                 data = resp.read()
+            telemetry.counter_inc("payload_store.gets")
+            telemetry.counter_inc("payload_store.get_bytes", len(data))
             with np.load(io.BytesIO(data)) as z:
                 arrays = [z[k] for k in z.files]
         except OSError:

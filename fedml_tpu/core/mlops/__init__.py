@@ -15,6 +15,7 @@ profiling. Event names used by the runtimes are kept from the reference
 
 from __future__ import annotations
 
+import atexit
 import json
 import logging
 import os
@@ -34,29 +35,67 @@ class MLOpsStore:
     _jsonl_file = None
     use_wandb: bool = False
     _wandb = None
+    _atexit_registered: bool = False
 
 
 def init(args) -> None:
     """reference: mlops.init(args) — binds run/edge ids, opens sinks."""
+    if MLOpsStore._jsonl_file is not None:
+        # re-init (tests, bench's post-measurement tracked pass): never leak
+        # the previous run's file handle
+        close()
     MLOpsStore.enabled = bool(getattr(args, "enable_tracking", False))
     MLOpsStore.run_id = str(getattr(args, "run_id", "0"))
     MLOpsStore.edge_id = int(getattr(args, "rank", 0))
-    if not MLOpsStore.enabled:
-        return
-    out_dir = str(getattr(args, "tracking_dir", "") or ".fedml_tpu_runs")
-    os.makedirs(out_dir, exist_ok=True)
-    MLOpsStore.jsonl_path = os.path.join(
-        out_dir, f"run_{MLOpsStore.run_id}_edge_{MLOpsStore.edge_id}.jsonl"
-    )
-    MLOpsStore._jsonl_file = open(MLOpsStore.jsonl_path, "a")
-    if bool(getattr(args, "enable_wandb", False)):
-        try:
-            import wandb
+    MLOpsStore.jsonl_path = None  # never point at a previous run's file
+    MLOpsStore.use_wandb = False
+    if MLOpsStore.enabled:
+        out_dir = str(getattr(args, "tracking_dir", "") or ".fedml_tpu_runs")
+        os.makedirs(out_dir, exist_ok=True)
+        MLOpsStore.jsonl_path = os.path.join(
+            out_dir, f"run_{MLOpsStore.run_id}_edge_{MLOpsStore.edge_id}.jsonl"
+        )
+        MLOpsStore._jsonl_file = open(MLOpsStore.jsonl_path, "a")
+        if bool(getattr(args, "enable_wandb", False)):
+            try:
+                import wandb
 
-            MLOpsStore._wandb = wandb
-            MLOpsStore.use_wandb = True
-        except ImportError:
-            logger.warning("wandb requested but not importable; skipping")
+                MLOpsStore._wandb = wandb
+                MLOpsStore.use_wandb = True
+            except ImportError:
+                logger.warning("wandb requested but not importable; skipping")
+    from . import telemetry
+
+    telemetry.init(args)
+    if not MLOpsStore._atexit_registered:
+        # durability: short runs must not lose their JSONL tail, and a
+        # --profile_rounds window or --metrics_file configured WITHOUT
+        # tracking still needs its trace stopped / exposition flushed when
+        # the interpreter exits — so the hook registers regardless of
+        # enable_tracking
+        atexit.register(close)
+        MLOpsStore._atexit_registered = True
+
+
+def close() -> None:
+    """Flush telemetry and close the JSONL sink (atexit-registered).
+
+    Runs even when tracking is off: an open ``--profile_rounds`` trace must
+    be stopped and a ``--metrics_file`` exposition force-written whether or
+    not a JSONL sink exists."""
+    from . import telemetry
+
+    try:
+        telemetry.close()  # summary event must land before the file shuts
+    except Exception:  # pragma: no cover - shutdown must never raise
+        logger.exception("telemetry close failed")
+    if MLOpsStore._jsonl_file is not None:
+        f, MLOpsStore._jsonl_file = MLOpsStore._jsonl_file, None
+        try:
+            f.flush()
+            f.close()
+        except OSError:
+            pass
 
 
 def _emit(record: Dict[str, Any]) -> None:
@@ -185,3 +224,19 @@ def read_events(path: Optional[str] = None) -> List[Dict[str, Any]]:
         return []
     with open(p) as f:
         return [json.loads(line) for line in f if line.strip()]
+
+
+def phase_totals(events: List[Dict[str, Any]]) -> tuple:
+    """Sum ``round_record`` phase durations over an event list.
+
+    Returns ``({phase: total_seconds}, record_count)`` — the per-phase
+    breakdown bench legs attach to BENCH_*.json."""
+    totals: Dict[str, float] = {}
+    n = 0
+    for e in events:
+        if e.get("kind") != "round_record":
+            continue
+        n += 1
+        for name, dur in (e.get("phases") or {}).items():
+            totals[name] = totals.get(name, 0.0) + float(dur)
+    return totals, n

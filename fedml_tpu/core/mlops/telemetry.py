@@ -1,0 +1,681 @@
+"""Round telemetry plane: metrics registry, phase spans, profiler windows.
+
+The reference ships a 2,217-LoC MLOps plane (MLOpsProfilerEvent spans,
+MLOpsMetrics, SysStats) whose unit of observation is a *message* — fine for
+an actor federation, blind for this port where PR 1 collapsed a whole FedAvg
+round into one donated XLA dispatch. The unit of observation here is the
+**round** (or the Cheetah step): where inside it time goes (sample / gather /
+train / aggregate / device wait), how long dispatch→ready takes on the fused
+path, how HBM grows, and how often XLA recompiles.
+
+Three layers, all process-wide:
+
+- :class:`MetricsRegistry` — counters, gauges, and fixed-bucket histograms
+  with p50/p95/p99 interpolation. Counter bumps are a dict update under a
+  lock (always on — the comm plane counts bytes/messages whether or not a
+  run is tracked). Rendered as Prometheus text exposition to
+  ``--metrics_file``.
+- **RoundRecord** — one structured JSONL event per round: phase span
+  durations, dispatch→``block_until_ready`` latency (fused path), HBM
+  used/peak from :func:`device_stats`, examples processed, a rounds/s EMA,
+  and compile events (via ``jax.monitoring`` listeners, which also count
+  persistent-compilation-cache hits/misses).
+- **Profiler windows** — ``--profile_rounds N:M`` opens a ``jax.profiler``
+  trace for rounds [N, M) and closes it after, no code changes in the run.
+
+Zero-cost contract: with tracking disabled, :func:`begin_round` returns
+``None`` after one boolean check, :func:`phase` returns a shared no-op
+context manager, and the fused round path performs NO extra host sync
+(``block_until_ready`` only runs under an active record) — pinned by
+``tests/test_telemetry.py``.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Metrics registry
+# ---------------------------------------------------------------------------
+
+# latency buckets in seconds: 100 µs .. 2 min, the dispatch-to-superround span
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
+# peak bf16 FLOPs/s per chip by device kind (public spec sheets) — the MFU
+# denominator for the Cheetah runner's live estimate (bench.py keeps its own
+# copy because its parent process must never import this package's deps)
+PEAK_BF16_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+class Histogram:
+    """Fixed-bucket histogram with interpolated quantiles."""
+
+    __slots__ = ("buckets", "counts", "count", "sum")
+
+    def __init__(self, buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)  # +1 overflow bucket
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, value: float) -> None:
+        self.counts[bisect.bisect_left(self.buckets, value)] += 1
+        self.count += 1
+        self.sum += value
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Linear interpolation inside the bucket holding quantile ``q``."""
+        if self.count == 0:
+            return None
+        target = q * self.count
+        acc = 0.0
+        lo = 0.0
+        for i, c in enumerate(self.counts):
+            hi = self.buckets[i] if i < len(self.buckets) else self.buckets[-1]
+            if c and acc + c >= target:
+                if i >= len(self.buckets):  # overflow: no upper bound
+                    return max(hi, self.sum / self.count)
+                return lo + (hi - lo) * ((target - acc) / c)
+            acc += c
+            lo = hi
+        return self.buckets[-1]
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "count": self.count,
+            "sum": round(self.sum, 6),
+            "p50": self.quantile(0.50),
+            "p95": self.quantile(0.95),
+            "p99": self.quantile(0.99),
+        }
+
+
+class MetricsRegistry:
+    """Process-wide counters / gauges / histograms (thread-safe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, Histogram] = {}
+
+    # -- write side ---------------------------------------------------------
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + value
+
+    def gauge_set(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def observe(self, name: str, value: float,
+                buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> None:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram(buckets)
+            h.observe(float(value))
+
+    # -- read side ----------------------------------------------------------
+    def counter(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: h.summary() for k, h in self._hists.items()},
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+    # -- Prometheus text exposition ----------------------------------------
+    @staticmethod
+    def _prom_name(name: str) -> str:
+        safe = "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+        return f"fedml_{safe}"
+
+    def render_prometheus(self) -> str:
+        """Text exposition: counters/gauges as single samples, histograms as
+        cumulative ``_bucket{le=...}`` series only — a histogram family must
+        not mix in summary-style quantile samples or expfmt parsers reject
+        the whole file (quantiles stay available via ``snapshot()`` and
+        ``histogram_quantile()`` server-side)."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {
+                k: (h.buckets, list(h.counts), h.count, h.sum)
+                for k, h in self._hists.items()
+            }
+        lines: List[str] = []
+        for name, v in sorted(counters.items()):
+            pn = self._prom_name(name) + "_total"
+            lines += [f"# TYPE {pn} counter", f"{pn} {v:g}"]
+        for name, v in sorted(gauges.items()):
+            pn = self._prom_name(name)
+            lines += [f"# TYPE {pn} gauge", f"{pn} {v:g}"]
+        for name, (buckets, counts, count, total) in sorted(hists.items()):
+            pn = self._prom_name(name)
+            lines.append(f"# TYPE {pn} histogram")
+            acc = 0
+            for le, c in zip(buckets, counts):
+                acc += c
+                lines.append(f'{pn}_bucket{{le="{le:g}"}} {acc}')
+            lines.append(f'{pn}_bucket{{le="+Inf"}} {count}')
+            lines.append(f"{pn}_sum {total:g}")
+            lines.append(f"{pn}_count {count}")
+        return "\n".join(lines) + "\n"
+
+
+_REG = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _REG
+
+
+def counter_inc(name: str, value: float = 1.0) -> None:
+    _REG.inc(name, value)
+
+
+def gauge_set(name: str, value: float) -> None:
+    _REG.gauge_set(name, value)
+
+
+def observe(name: str, value: float) -> None:
+    _REG.observe(name, value)
+
+
+# ---------------------------------------------------------------------------
+# Process state + init
+# ---------------------------------------------------------------------------
+
+
+class _State:
+    enabled: bool = False
+    metrics_file: Optional[str] = None
+    profiler: Optional["ProfilerWindow"] = None
+    ema_rounds_per_sec: Optional[float] = None
+    last_metrics_write: float = 0.0
+    metrics_write_interval_s: float = 2.0
+
+
+_TLS = threading.local()  # .record — the in-flight RoundRecord, if any
+
+
+def enabled() -> bool:
+    return _State.enabled
+
+
+def set_enabled(flag: bool) -> None:
+    """Test / embedding hook; normal runs go through :func:`init`."""
+    _State.enabled = bool(flag)
+
+
+def init(args) -> None:
+    """Configure the plane from a run's args (called by ``mlops.init``)."""
+    _State.enabled = bool(getattr(args, "enable_tracking", False))
+    _State.metrics_file = str(getattr(args, "metrics_file", "") or "") or None
+    _State.ema_rounds_per_sec = None
+    _State.last_metrics_write = 0.0
+    _TLS.record = None
+    spec = str(getattr(args, "profile_rounds", "") or "")
+    if spec:
+        log_dir = (str(getattr(args, "profile_dir", "") or "")
+                   or str(getattr(args, "tracking_dir", "") or "")
+                   or ".fedml_tpu_runs")
+        _State.profiler = ProfilerWindow.parse(spec, log_dir)
+    else:
+        _State.profiler = None
+    if _State.enabled:
+        install_jax_listeners()
+
+
+def close() -> None:
+    """Flush-and-summarise hook (run at ``mlops`` shutdown, before the JSONL
+    sink closes): force the metrics file out and emit one summary event with
+    the full registry snapshot so ``fedml cache`` / post-mortems can read
+    compile-cache hit rates from the run log alone."""
+    prof = _State.profiler
+    if prof is not None and prof.active:
+        prof.force_stop()
+    if _State.enabled:
+        from . import _emit
+
+        _emit({"kind": "telemetry_summary", "metrics": _REG.snapshot(),
+               "rounds_per_sec_ema": _State.ema_rounds_per_sec})
+    write_metrics_file(force=True)
+
+
+def write_metrics_file(force: bool = False) -> Optional[str]:
+    """Write the Prometheus exposition to ``--metrics_file`` (throttled)."""
+    path = _State.metrics_file
+    if path is None:
+        return None
+    now = time.monotonic()
+    if not force and now - _State.last_metrics_write < _State.metrics_write_interval_s:
+        return None
+    _State.last_metrics_write = now
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(_REG.render_prometheus())
+    import os
+
+    os.replace(tmp, path)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# jax.monitoring listeners: compile events + compilation-cache hit/miss
+# ---------------------------------------------------------------------------
+
+_LISTENERS_INSTALLED = False
+
+_EVENT_COUNTERS = {
+    "/jax/compilation_cache/cache_hits": "jax.compilation_cache.hits",
+    "/jax/compilation_cache/cache_misses": "jax.compilation_cache.misses",
+}
+
+
+def install_jax_listeners() -> bool:
+    """Count XLA compiles and persistent-cache hits/misses into the registry.
+
+    ``jax.monitoring`` has no unregister API, so this installs once per
+    process; the listeners only touch the registry (no jax state)."""
+    global _LISTENERS_INSTALLED
+    if _LISTENERS_INSTALLED:
+        return True
+    try:
+        from jax import monitoring
+    except ImportError:  # pragma: no cover - jax is a hard dep in practice
+        return False
+
+    def on_event(event: str, **kw) -> None:
+        name = _EVENT_COUNTERS.get(event)
+        if name is not None:
+            _REG.inc(name)
+
+    def on_duration(event: str, duration_secs: float, **kw) -> None:
+        if event == "/jax/core/compile/backend_compile_duration":
+            _REG.inc("jax.compiles")
+            _REG.observe("jax.compile.seconds", duration_secs)
+        elif event == "/jax/compilation_cache/compile_time_saved_sec":
+            _REG.inc("jax.compilation_cache.time_saved_s", duration_secs)
+
+    monitoring.register_event_listener(on_event)
+    monitoring.register_event_duration_secs_listener(on_duration)
+    _LISTENERS_INSTALLED = True
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Phase spans
+# ---------------------------------------------------------------------------
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "t0", "record")
+
+    def __init__(self, name: str, record: bool = True):
+        self.name = name
+        self.t0 = 0.0
+        self.record = record
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self.t0
+        if self.record:
+            rec = getattr(_TLS, "record", None)
+            if rec is not None:
+                rec.phases[self.name] = rec.phases.get(self.name, 0.0) + dt
+        _REG.observe(f"phase.{self.name}.seconds", dt)
+        return False
+
+
+def phase(name: str, record: bool = True):
+    """Span context manager: attributes its duration to the in-flight
+    RoundRecord (if any) and the ``phase.<name>.seconds`` histogram.
+    A shared no-op when tracking is disabled.
+
+    ``record=False`` keeps the histogram but stays out of the RoundRecord —
+    for sub-spans nested inside a recorded phase (the mesh engine's
+    placement spans run inside the sp base's sample/prep spans), whose
+    double-counted time would push a record's phase sum past its wall."""
+    if not _State.enabled:
+        return _NULL_SPAN
+    return _Span(name, record)
+
+
+# ---------------------------------------------------------------------------
+# RoundRecord lifecycle
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RoundRecord:
+    """One round's (or one Cheetah step's) structured telemetry."""
+
+    round_idx: int
+    fused: bool = False
+    superround: bool = False
+    phases: Dict[str, float] = dataclasses.field(default_factory=dict)
+    wall_s: float = 0.0
+    dispatch_latency_s: Optional[float] = None  # dispatch → block_until_ready
+    examples: Optional[float] = None
+    train_loss: Optional[float] = None
+    rounds_per_sec_ema: Optional[float] = None
+    hbm_used_mb: Optional[float] = None
+    hbm_peak_mb: Optional[float] = None
+    compiles: int = 0
+    # lazy device scalars realized at end_round (one sync, tracking-on only)
+    lazy: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    t0: float = 0.0
+    _compiles0: float = 0.0
+
+    def to_event(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d.pop("lazy", None)
+        d.pop("t0", None)
+        d.pop("_compiles0", None)
+        d["phases"] = {k: round(v, 6) for k, v in self.phases.items()}
+        d["wall_s"] = round(self.wall_s, 6)
+        return {"kind": "round_record", **d}
+
+
+def current_record() -> Optional[RoundRecord]:
+    return getattr(_TLS, "record", None)
+
+
+def record_lazy(name: str, value: Any) -> None:
+    """Stash a device scalar on the in-flight record; realized (ONE host
+    sync) at :func:`end_round`. No-op without an active record."""
+    rec = getattr(_TLS, "record", None)
+    if rec is not None:
+        rec.lazy[name] = value
+
+
+def begin_round(round_idx: int, fused: bool = False,
+                superround: bool = False) -> Optional[RoundRecord]:
+    """Open a RoundRecord; ``None`` (after one bool check) when disabled."""
+    if not _State.enabled:
+        return None
+    rec = RoundRecord(round_idx=int(round_idx), fused=fused,
+                      superround=superround)
+    rec.t0 = time.perf_counter()
+    rec._compiles0 = _REG.counter("jax.compiles")
+    _TLS.record = rec
+    return rec
+
+
+def _update_ema(inst_rounds_per_sec: float) -> float:
+    prev = _State.ema_rounds_per_sec
+    ema = (inst_rounds_per_sec if prev is None
+           else 0.9 * prev + 0.1 * inst_rounds_per_sec)
+    _State.ema_rounds_per_sec = ema
+    return ema
+
+
+def _hbm_fields(rec: RoundRecord) -> None:
+    from . import device_stats
+
+    stats = device_stats()
+    if stats:
+        rec.hbm_used_mb = stats[0].get("mem_used_mb")
+        rec.hbm_peak_mb = stats[0].get("peak_mb")
+
+
+def _realize(value: Any) -> Optional[float]:
+    if value is None:
+        return None
+    try:
+        import numpy as np
+
+        return float(np.asarray(value))
+    except Exception:
+        return None
+
+
+def end_round(rec: Optional[RoundRecord],
+              train_loss: Any = None, wall_s: Optional[float] = None) -> None:
+    """Close a RoundRecord: realize lazy device scalars (the one host sync
+    tracking buys), stamp HBM + EMA + compile count, emit the JSONL event,
+    bump registry counters, and maybe refresh the metrics file."""
+    if rec is None:
+        return
+    from . import _emit
+
+    rec.wall_s = (time.perf_counter() - rec.t0) if wall_s is None else wall_s
+    rec.train_loss = _realize(train_loss if train_loss is not None
+                              else rec.lazy.get("train_loss"))
+    rec.examples = _realize(rec.lazy.get("examples"))
+    rec.compiles = int(_REG.counter("jax.compiles") - rec._compiles0)
+    rec.rounds_per_sec_ema = _update_ema(1.0 / max(rec.wall_s, 1e-9))
+    _hbm_fields(rec)
+    _TLS.record = None
+    _REG.inc("rounds.total")
+    if rec.examples:
+        _REG.inc("examples.total", rec.examples)
+    _REG.observe("round.wall.seconds", rec.wall_s)
+    _emit(rec.to_event())
+    write_metrics_file()
+
+
+def emit_superround(start_round: int, k: int, wall_s: float,
+                    scan_metrics: Dict[str, Any]) -> None:
+    """One RoundRecord per scanned round, unpacked host-side from the scan's
+    stacked per-round outputs (``train_loss[k]``, ``examples[k]``). The scan
+    is one device program, so per-round wall/phase attribution is the scan
+    wall divided evenly — honest about what a fused superround can know."""
+    if not _State.enabled:
+        return
+    import numpy as np
+
+    from . import _emit
+
+    losses = np.asarray(scan_metrics.get("train_loss"))
+    ex = scan_metrics.get("examples")
+    ex = None if ex is None else np.asarray(ex)
+    per = wall_s / max(k, 1)
+    hbm_probe = RoundRecord(round_idx=-1)
+    _hbm_fields(hbm_probe)
+    for j in range(k):
+        rec = RoundRecord(round_idx=start_round + j, fused=True,
+                          superround=True)
+        rec.wall_s = per
+        rec.phases = {"superround_scan": per}
+        rec.train_loss = float(losses[j]) if losses.shape else float(losses)
+        rec.examples = None if ex is None else float(ex[j])
+        rec.rounds_per_sec_ema = _update_ema(1.0 / max(per, 1e-9))
+        rec.hbm_used_mb = hbm_probe.hbm_used_mb
+        rec.hbm_peak_mb = hbm_probe.hbm_peak_mb
+        _REG.inc("rounds.total")
+        if rec.examples:
+            _REG.inc("examples.total", rec.examples)
+        _REG.observe("round.wall.seconds", per)
+        _emit(rec.to_event())
+    write_metrics_file()
+
+
+# ---------------------------------------------------------------------------
+# Profiler windows (--profile_rounds N:M)
+# ---------------------------------------------------------------------------
+
+
+def _start_trace(log_dir: str) -> None:  # monkeypatchable in tests
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+
+
+def _stop_trace() -> None:
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+class ProfilerWindow:
+    """``jax.profiler`` trace over rounds [start, stop) — device-level truth
+    (op timelines, HBM traffic) for the window the host-side spans flag."""
+
+    def __init__(self, start_round: int, stop_round: int, log_dir: str):
+        self.start_round = int(start_round)
+        self.stop_round = int(stop_round)
+        self.log_dir = log_dir
+        self.active = False
+        self.done = False
+
+    @classmethod
+    def parse(cls, spec: str, log_dir: str) -> "ProfilerWindow":
+        """``"N:M"`` traces rounds [N, M); bare ``"N"`` traces round N."""
+        lo, _, hi = str(spec).partition(":")
+        start = int(lo)
+        stop = int(hi) if hi else start + 1
+        if stop <= start:
+            raise ValueError(
+                f"profile_rounds expects N:M with M > N, got {spec!r}")
+        return cls(start, stop, log_dir)
+
+    def on_round_start(self, round_idx: int) -> None:
+        if (not self.done and not self.active
+                and self.start_round <= round_idx < self.stop_round):
+            _start_trace(self.log_dir)
+            self.active = True
+
+    def on_round_end(self, round_idx: int) -> None:
+        if self.active and round_idx + 1 >= self.stop_round:
+            self.force_stop()
+
+    def force_stop(self) -> None:
+        if self.active:
+            _stop_trace()
+            self.active = False
+            self.done = True
+
+    def intersects(self, lo: int, hi: int) -> bool:
+        """Does [lo, hi) overlap the (not yet finished) window?"""
+        return (not self.done and lo < self.stop_round
+                and hi > self.start_round)
+
+
+def on_round_start(round_idx: int) -> None:
+    p = _State.profiler
+    if p is not None:
+        p.on_round_start(round_idx)
+
+
+def on_round_end(round_idx: int) -> None:
+    p = _State.profiler
+    if p is not None:
+        p.on_round_end(round_idx)
+
+
+def profiler_blocks_chunk(lo: int, hi: int) -> bool:
+    """True when a K-round scan over [lo, hi) would swallow a profiler
+    boundary — the chunker then falls back to single rounds so the trace
+    starts/stops exactly on the requested rounds."""
+    p = _State.profiler
+    return p is not None and p.intersects(lo, hi)
+
+
+# ---------------------------------------------------------------------------
+# Periodic host/device sampler (daemon thread)
+# ---------------------------------------------------------------------------
+
+
+class SysPerfSampler:
+    """Periodic ``log_sys_perf()`` on a daemon thread: host CPU/RSS + HBM
+    time series for long runs, no calls sprinkled through scenario code."""
+
+    def __init__(self, interval_s: float):
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "SysPerfSampler":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="sys-perf-sampler", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        from . import log_sys_perf
+
+        while not self._stop.wait(self.interval_s):
+            try:
+                log_sys_perf()
+            except Exception:  # sampling must never kill a run
+                pass
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+
+def start_sys_perf_sampler(args) -> Optional[SysPerfSampler]:
+    """Start the sampler when tracking is on and ``--sys_perf_interval_s``
+    is positive; else ``None`` (the runner calls this unconditionally)."""
+    interval = float(getattr(args, "sys_perf_interval_s", 0.0) or 0.0)
+    if not _State.enabled or interval <= 0:
+        return None
+    return SysPerfSampler(interval).start()
+
+
+# ---------------------------------------------------------------------------
+# MFU estimate (Cheetah)
+# ---------------------------------------------------------------------------
+
+
+def flops_per_token(n_params: int, seq_len: int, n_layers: int,
+                    d_model: int) -> float:
+    """Model FLOPs per token, fwd+bwd (PaLM appendix B convention)."""
+    return 6.0 * n_params + 12.0 * seq_len * n_layers * d_model
+
+
+def mfu_estimate(tokens_per_sec: float, flops_per_tok: float,
+                 device_kind: str, n_chips: int = 1) -> Optional[float]:
+    peak = PEAK_BF16_FLOPS.get(str(device_kind))
+    if not peak or n_chips <= 0:
+        return None
+    return (tokens_per_sec * flops_per_tok) / (peak * n_chips)

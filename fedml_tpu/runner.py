@@ -77,4 +77,13 @@ class FedMLRunner:
         return ServerMNN(args, device, dataset, model, server_aggregator)
 
     def run(self):
-        return self.runner.run()
+        from .core.mlops import telemetry
+
+        # periodic host CPU/RSS + HBM sampling on a daemon thread (off by
+        # default; --sys_perf_interval_s N with tracking enabled turns it on)
+        sampler = telemetry.start_sys_perf_sampler(self.args)
+        try:
+            return self.runner.run()
+        finally:
+            if sampler is not None:
+                sampler.stop()

@@ -33,6 +33,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import constants
+from ..core.mlops import telemetry
 from ..device import build_mesh
 from .sp_api import FedAvgAPI
 
@@ -78,6 +79,9 @@ class MeshFedAvgAPI(FedAvgAPI):
         return cohort, wmask
 
     def _gather_cohort(self, cohort: np.ndarray):
+        # host-side gather + sharded device_put: the mesh path's own
+        # "gather" phase (the sp base times this callsite — this shard
+        # placement is what its span measures here)
         cx = jax.device_put(self.ds.train_x[cohort], self._shard)
         cy = jax.device_put(self.ds.train_y[cohort], self._shard)
         cn = jax.device_put(
@@ -91,7 +95,8 @@ class MeshFedAvgAPI(FedAvgAPI):
     def _prepare_round(self):
         # keep global params replicated across the mesh so the cohort program
         # reads them without broadcast inside the hot loop
-        self.global_params = jax.device_put(self.global_params, self._repl)
+        with telemetry.phase("place_params", record=False):
+            self.global_params = jax.device_put(self.global_params, self._repl)
 
     def _place_state(self, state):
         # the fused program's donated state must live on the SAME device set
@@ -99,4 +104,7 @@ class MeshFedAvgAPI(FedAvgAPI):
         # mesh (a no-op copy once steady state re-feeds program outputs).
         # XLA then propagates the input shardings through the fused round and
         # lowers the cross-shard reduction to collectives over ICI.
-        return jax.tree.map(lambda x: jax.device_put(x, self._repl), state)
+        with telemetry.phase("place_state", record=False):
+            return jax.tree.map(
+                lambda x: jax.device_put(x, self._repl), state
+            )

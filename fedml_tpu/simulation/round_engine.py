@@ -138,7 +138,10 @@ def build_round_core(api, n_cohort: int, n_valid: int):
                              server_opt_state=opt_state)
             # (the unfused path applies no central-DP noise on FedSGD either)
             return new_state, {
-                "train_loss": _masked_mean(metrics["train_loss"], wmask)
+                "train_loss": _masked_mean(metrics["train_loss"], wmask),
+                # on-device round counter: telemetry RoundRecords realize it
+                # host-side AFTER the round (no sync on the dispatch path)
+                "examples": weights.sum(),
             }
 
         if scaffold:
@@ -189,7 +192,8 @@ def build_round_core(api, n_cohort: int, n_valid: int):
             gp = dp.randomize_global(gp, jax.random.fold_in(round_rng, 7))
         new_state = dict(state, global_params=gp)
         return new_state, {
-            "train_loss": _masked_mean(metrics["train_loss"], wmask)
+            "train_loss": _masked_mean(metrics["train_loss"], wmask),
+            "examples": weights.sum(),
         }
 
     return core
@@ -215,8 +219,10 @@ def make_superround_step(api, k: int, n_cohort: int):
     ``jax.random.choice`` over client ids (without replacement), keyed by the
     same per-round key the single-round path uses for everything else.
 
-    Returns ``superround(state, start_round) -> (state, losses[k])``, jit'd
-    with the state donated.
+    Returns ``superround(state, start_round) -> (state, metrics)`` where
+    ``metrics`` holds stacked per-round outputs (``train_loss[k]``,
+    ``examples[k]``) — the host-side unpack point for per-round telemetry —
+    jit'd with the state donated.
     """
     core = build_round_core(api, n_cohort, n_valid=n_cohort)
     dev_x, dev_y, dev_counts = api._dev_x, api._dev_y, api._dev_counts
@@ -238,10 +244,11 @@ def make_superround_step(api, k: int, n_cohort: int):
             cn = jnp.take(dev_counts, cohort, axis=0)
             rngs = jax.random.split(rkey, per)
             st, metrics = core(st, cohort, cx, cy, cn, rngs, None, rkey)
-            return st, metrics["train_loss"]
+            return st, {"train_loss": metrics["train_loss"],
+                        "examples": metrics["examples"]}
 
         rr = start_round + jnp.arange(k, dtype=jnp.int32)
-        state, losses = jax.lax.scan(body, state, rr)
-        return state, losses
+        state, scan_metrics = jax.lax.scan(body, state, rr)
+        return state, scan_metrics
 
     return jax.jit(superround, donate_argnums=(0,))

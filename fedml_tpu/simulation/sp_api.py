@@ -36,6 +36,7 @@ from ..core.aggregate import (
     weighted_average,
 )
 from ..core.dp import FedPrivacyMechanism
+from ..core.mlops import telemetry
 from ..core.security.attacker import FedMLAttacker
 from ..core.security.defender import FedMLDefender
 from ..ml.evaluate import make_eval_fn
@@ -361,12 +362,25 @@ class FedAvgAPI:
 
     def run_round(self, round_idx: int) -> Dict[str, float]:
         """One federated round: the fused single-program path when the config
-        allows it, the legacy multi-dispatch ``_train_round`` otherwise."""
+        allows it, the legacy multi-dispatch ``_train_round`` otherwise.
+
+        With ``--enable_tracking`` each round opens a telemetry RoundRecord
+        (phase spans, dispatch latency, HBM, compile events) and may open or
+        close a ``--profile_rounds`` jax.profiler window. Disabled, both are
+        one boolean check."""
         if not self._fusion_ready:
             self._setup_round_fusion()
+        telemetry.on_round_start(round_idx)
+        rec = telemetry.begin_round(
+            round_idx, fused=self._round_step is not None
+        )
         if self._round_step is None:
-            return self._train_round(round_idx)
-        return self._train_round_fused(round_idx)
+            out = self._train_round(round_idx)
+        else:
+            out = self._train_round_fused(round_idx)
+        telemetry.end_round(rec, train_loss=out.get("train_loss"))
+        telemetry.on_round_end(round_idx)
+        return out
 
     def run_rounds(self, start_round: int, k: int) -> Dict[str, Any]:
         """Run rounds [start_round, start_round + k) — ONE superround launch
@@ -376,12 +390,24 @@ class FedAvgAPI:
         if not self._fusion_ready:
             self._setup_round_fusion()
         if self._superround_step is not None and k == self._superround_k:
+            telemetry.on_round_start(start_round)
+            tracked = telemetry.enabled()
+            t0 = time.perf_counter() if tracked else 0.0
             self._prepare_round()
-            state, losses = self._superround_step(
+            state, scan_metrics = self._superround_step(
                 self._place_state(self._round_state()), jnp.int32(start_round)
             )
             self._set_round_state(state)
-            return {"train_loss": losses}
+            if tracked:
+                # one record per scanned round, unpacked from the scan's
+                # stacked on-device counters (the only host sync tracking
+                # adds — the untracked path stays fully asynchronous)
+                jax.block_until_ready(state)
+                telemetry.emit_superround(
+                    start_round, k, time.perf_counter() - t0, scan_metrics
+                )
+            telemetry.on_round_end(start_round + k - 1)
+            return {"train_loss": scan_metrics["train_loss"]}
         return {"train_loss": [
             self.run_round(start_round + j)["train_loss"] for j in range(k)
         ]}
@@ -391,29 +417,44 @@ class FedAvgAPI:
 
         Returns train_loss as a DEVICE scalar — no host sync. train() keeps
         dispatch asynchronous: while the device executes round r, the host
-        already samples and gathers round r+1's cohort.
+        already samples and gathers round r+1's cohort. Only under an active
+        telemetry record does the round block for dispatch→ready latency.
         """
-        self._prepare_round()
-        cohort, wmask = self._pad_cohort(self._client_sampling(round_idx))
-        cx, cy, cn = self._gather_cohort(cohort)
-        round_rng = jax.random.fold_in(self.root_rng, round_idx)
-        rngs = self._place(jax.random.split(round_rng, len(cohort)))
-        wm = None if wmask is None else self._place(jnp.asarray(wmask))
-        cohort_idx = jnp.asarray(cohort, jnp.int32)
-        state, metrics = self._round_step(
-            self._place_state(self._round_state()),
-            cohort_idx, cx, cy, cn, rngs, wm, round_rng,
-        )
+        rec = telemetry.current_record()
+        with telemetry.phase("sample"):
+            self._prepare_round()
+            cohort, wmask = self._pad_cohort(self._client_sampling(round_idx))
+        with telemetry.phase("gather"):
+            cx, cy, cn = self._gather_cohort(cohort)
+        with telemetry.phase("prep"):
+            round_rng = jax.random.fold_in(self.root_rng, round_idx)
+            rngs = self._place(jax.random.split(round_rng, len(cohort)))
+            wm = None if wmask is None else self._place(jnp.asarray(wmask))
+            cohort_idx = jnp.asarray(cohort, jnp.int32)
+            st = self._place_state(self._round_state())
+        t_dispatch = time.perf_counter()
+        with telemetry.phase("dispatch"):
+            state, metrics = self._round_step(
+                st, cohort_idx, cx, cy, cn, rngs, wm, round_rng,
+            )
         self._set_round_state(state)
+        if rec is not None:
+            rec.lazy["examples"] = metrics.get("examples")
+            with telemetry.phase("device_wait"):
+                jax.block_until_ready(state)
+            rec.dispatch_latency_s = time.perf_counter() - t_dispatch
         return {"train_loss": metrics["train_loss"]}
 
     # -- one round (legacy multi-dispatch path; kept as the numerical
     # -- reference the fusion parity tests compare against) -----------------
     def _train_round(self, round_idx: int) -> Dict[str, float]:
-        self._prepare_round()
-        cohort, wmask = self._pad_cohort(self._client_sampling(round_idx))
-        n_valid = len(cohort) if wmask is None else int(wmask.sum())
-        cx, cy, cn = self._gather_cohort(cohort)
+        rec = telemetry.current_record()
+        with telemetry.phase("sample"):
+            self._prepare_round()
+            cohort, wmask = self._pad_cohort(self._client_sampling(round_idx))
+            n_valid = len(cohort) if wmask is None else int(wmask.sum())
+        with telemetry.phase("gather"):
+            cx, cy, cn = self._gather_cohort(cohort)
         if self.attacker.is_data_attack():
             cx, cy = self.attacker.attack_data(cx, cy, n_valid)
 
@@ -422,8 +463,11 @@ class FedAvgAPI:
         wm = None if wmask is None else self._place(jnp.asarray(wmask))
 
         if self.fedsgd:
-            grads, metrics = self.cohort_fn(self.global_params, cx, cy, cn, rngs)
+            with telemetry.phase("train"):
+                grads, metrics = self.cohort_fn(self.global_params, cx, cy, cn, rngs)
             weights = metrics["num_samples"] if wm is None else metrics["num_samples"] * wm
+            if rec is not None:
+                rec.lazy["examples"] = weights.sum()
             agg_grad = self._aggregate(grads, weights, round_rng, n_valid, cohort)
             updates, self.server_opt_state = self.server_opt.update(
                 agg_grad, self.server_opt_state, self.global_params
@@ -431,13 +475,15 @@ class FedAvgAPI:
             import optax
 
             self.global_params = optax.apply_updates(self.global_params, updates)
-            return {"train_loss": _masked_mean(metrics["train_loss"], wm)}
+            with telemetry.phase("loss_sync"):
+                return {"train_loss": _masked_mean(metrics["train_loss"], wm)}
 
         if self.scaffold:
             c_cohort = jax.tree.map(lambda x: x[cohort], self.c_locals)
-            stacked, metrics, new_c = self.cohort_fn(
-                self.global_params, cx, cy, cn, rngs, self.c_global, c_cohort
-            )
+            with telemetry.phase("train"):
+                stacked, metrics, new_c = self.cohort_fn(
+                    self.global_params, cx, cy, cn, rngs, self.c_global, c_cohort
+                )
             # scatter back new control variates; update c_global by the mean
             # delta scaled by cohort/total (SCAFFOLD option II). Only the
             # n_valid real clients participate — padded rows are dropped.
@@ -455,9 +501,12 @@ class FedAvgAPI:
                 lambda all_c, nc: all_c.at[real].set(nc), self.c_locals, new_c_r
             )
         else:
-            stacked, metrics = self.cohort_fn(self.global_params, cx, cy, cn, rngs)
+            with telemetry.phase("train"):
+                stacked, metrics = self.cohort_fn(self.global_params, cx, cy, cn, rngs)
 
         weights = metrics["num_samples"] if wm is None else metrics["num_samples"] * wm
+        if rec is not None:
+            rec.lazy["examples"] = weights.sum()
 
         if self.fednova:
             # w_new = w_g - tau_eff * Σ p_i (w_g - w_i)/tau_i
@@ -486,7 +535,10 @@ class FedAvgAPI:
             self.global_params = self.dp.randomize_global(
                 self.global_params, jax.random.fold_in(round_rng, 7)
             )
-        return {"train_loss": _masked_mean(metrics.get("train_loss"), wm)}
+        with telemetry.phase("loss_sync"):
+            # _masked_mean pulls a host float, so this span absorbs the
+            # device wait for everything dispatched above
+            return {"train_loss": _masked_mean(metrics.get("train_loss"), wm)}
 
     # -- aggregation with trust hooks ---------------------------------------
     def _aggregate(
@@ -501,6 +553,14 @@ class FedAvgAPI:
         (Krum, median, ...) and the attack kernels see every row — so the
         trust paths slice to the real cohort first.
         """
+        with telemetry.phase("aggregate"):
+            return self._aggregate_impl(stacked, weights, rng, n_valid,
+                                        client_ids)
+
+    def _aggregate_impl(
+        self, stacked: PyTree, weights: jax.Array, rng, n_valid: int = None,
+        client_ids=None,
+    ) -> PyTree:
         if self.dp is not None and self.dp.dp_type == "ldp":
             keys = jax.random.split(jax.random.fold_in(rng, 3), weights.shape[0])
             stacked = jax.vmap(self.dp.randomize)(stacked, keys)
@@ -637,9 +697,12 @@ class FedAvgAPI:
                 last_round = round_idx + k - 1
                 entry = self.history[-1]
                 if last_round % freq == 0 or last_round == rounds - 1:
-                    last_eval = self.evaluate(
-                        self.global_params, self.ds.test_x, self.ds.test_y
-                    )
+                    # runs BETWEEN rounds (the round's record is already
+                    # closed): registry histogram only, never a record phase
+                    with telemetry.phase("eval", record=False):
+                        last_eval = self.evaluate(
+                            self.global_params, self.ds.test_x, self.ds.test_y
+                        )
                     entry.update(last_eval)
                     mlops.log({"round": last_round, **last_eval},
                               step=last_round)
@@ -675,6 +738,10 @@ class FedAvgAPI:
         if not self._fusion_ready:
             self._setup_round_fusion()
         if self._superround_step is None:
+            return 1
+        if telemetry.profiler_blocks_chunk(r, r + k):
+            # a --profile_rounds boundary inside the chunk: single rounds so
+            # the trace window opens/closes exactly on the requested rounds
             return 1
         for ri in range(r, r + k - 1):
             if ri % freq == 0:

@@ -5,9 +5,18 @@
 # (rc=124 with an empty tail): whatever happens, the bench must exit 0-ish
 # fast and leave a parseable JSON tail.
 #
+# The leg runs its telemetry pass into BENCH_TRACKING_DIR, so this also
+# asserts the observability contract: the tracked leg leaves a JSONL event
+# log that read_events round-trips (with per-round RoundRecords) and a
+# parseable Prometheus metrics exposition, and the bench line carries the
+# per-phase breakdown.
+#
 # Usage: tools/bench_smoke.sh          (CI: exits non-zero on any regression)
 set -uo pipefail
 cd "$(dirname "$0")/.."
+
+track_dir=$(mktemp -d /tmp/fedml_bench_smoke_track.XXXXXX)
+trap 'rm -rf "$track_dir"' EXIT
 
 out=$(timeout -k 10 120 env \
     BENCH_PLATFORM=cpu \
@@ -17,6 +26,7 @@ out=$(timeout -k 10 120 env \
     BENCH_MIN_LEG_S=5 \
     BENCH_LEG_TIMEOUT_S=100 \
     BENCH_CACHE_TTL_S=0 \
+    BENCH_TRACKING_DIR="$track_dir" \
     python bench.py 2>/dev/null)
 rc=$?
 
@@ -30,8 +40,9 @@ if [ "$rc" -ne 0 ]; then
 fi
 
 tail_line=$(printf '%s\n' "$out" | tail -n 1)
-python - "$tail_line" <<'EOF'
+TRACK_DIR="$track_dir" python - "$tail_line" <<'EOF'
 import json
+import os
 import sys
 
 line = json.loads(sys.argv[1])
@@ -41,8 +52,38 @@ ok = ("fedavg_cpu_smoke_rounds_per_sec" in line
       and "fedavg_error" not in line
       and "fedavg_skipped" not in line)
 assert ok, f"fedavg smoke leg did not complete: {line}"
+
+# telemetry contract: the tracked pass produced a per-phase breakdown...
+assert line.get("fedavg_phases"), f"no phase breakdown in line: {line}"
+assert line.get("fedavg_phase_rounds", 0) > 0, line
+
+# ...a JSONL event log that read_events round-trips, with RoundRecords...
+from fedml_tpu.core.mlops import read_events
+
+track_dir = os.environ["TRACK_DIR"]
+logs = [f for f in os.listdir(track_dir) if f.endswith(".jsonl")]
+assert logs, f"no JSONL event log in {track_dir}"
+events = read_events(os.path.join(track_dir, logs[0]))
+records = [e for e in events if e.get("kind") == "round_record"]
+assert records, f"no round_record events in {logs[0]}"
+
+# ...and a parseable Prometheus metrics exposition
+metrics_path = os.path.join(track_dir, "metrics.prom")
+assert os.path.exists(metrics_path), f"no metrics file at {metrics_path}"
+samples = 0
+with open(metrics_path) as f:
+    for raw in f:
+        raw = raw.strip()
+        if not raw or raw.startswith("#"):
+            continue
+        name, value = raw.rsplit(" ", 1)
+        float(value)  # every sample line must parse
+        samples += 1
+assert samples > 0, "metrics exposition is empty"
+
 print("bench_smoke: OK —",
       f"{line['fedavg_cpu_smoke_rounds_per_sec']:.2f} rounds/s,",
       f"compile {line.get('fedavg_compile_s', '?')}s,",
-      f"fused={line.get('fedavg_round_fused')}")
+      f"fused={line.get('fedavg_round_fused')},",
+      f"{len(records)} round records, {samples} metric samples")
 EOF
