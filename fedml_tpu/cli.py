@@ -325,6 +325,30 @@ def _report_cache_telemetry(run_file: str) -> None:
     print(f"    backend compiles:  {compiles:.0f}")
 
 
+def cmd_lint(args) -> int:
+    """Run graftlint (tools/graftlint), the JAX-aware static analyzer, over
+    the tree — trace-safety (G001), donation (G002), recompile (G003),
+    purity (G004) and thread-safety (G005) linting. Shells into the same
+    entry point CI uses (``python -m tools.graftlint``), anchored at the
+    repo root so results are identical from any cwd."""
+    import subprocess
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if not os.path.isdir(os.path.join(repo_root, "tools", "graftlint")):
+        print("fedml_tpu lint: tools/graftlint not found next to the "
+              f"package (looked in {repo_root}) — run from a source checkout")
+        return 2
+    # absolutize user paths: the subprocess runs with cwd=repo_root, which
+    # would otherwise re-resolve relative paths against the wrong directory
+    paths = [os.path.abspath(p) for p in args.paths] or ["fedml_tpu"]
+    cmd = [sys.executable, "-m", "tools.graftlint", *paths]
+    if args.format != "text":
+        cmd += ["--format", args.format]
+    if args.runtime:
+        cmd.append("--runtime")
+    return subprocess.call(cmd, cwd=repo_root)
+
+
 def cmd_multihost(args) -> int:
     """Spawn N coordinated worker processes (analog: mpirun -np N).
 
@@ -416,6 +440,15 @@ def main(argv=None) -> int:
                          help="run JSONL to read hit/miss telemetry from "
                          "(default: newest run)")
 
+    p_lint = sub.add_parser(
+        "lint", help="run graftlint (JAX-aware static analysis) over the tree"
+    )
+    p_lint.add_argument("paths", nargs="*", default=[],
+                        help="files/dirs to lint (default: fedml_tpu)")
+    p_lint.add_argument("--format", choices=("text", "json"), default="text")
+    p_lint.add_argument("--runtime", action="store_true",
+                        help="also trace the round engine under jax.make_jaxpr")
+
     p_mh = sub.add_parser(
         "multihost", help="spawn N coordinated worker processes",
         usage="%(prog)s [-np N] [--local_devices D] script [script_args ...]",
@@ -441,6 +474,7 @@ def main(argv=None) -> int:
         "launch": cmd_launch,
         "agent": cmd_agent,
         "cache": cmd_cache,
+        "lint": cmd_lint,
         "multihost": cmd_multihost,
     }
     if args.command is None:

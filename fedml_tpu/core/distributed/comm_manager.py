@@ -49,7 +49,9 @@ class FedMLCommManager(Observer):
 
     # -- registry (reference :52-63) ----------------------------------------
     def register_comm_manager(self, comm_manager: BaseCommunicationManager):
-        self.com_manager = comm_manager
+        # setup-phase setter: callers install the backend before run()/
+        # run_async() starts the receive loop, so no concurrent reader exists
+        self.com_manager = comm_manager  # graftlint: disable=G005
 
     def register_message_receive_handler(
         self, msg_type: str, handler: MessageHandler

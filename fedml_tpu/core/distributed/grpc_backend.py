@@ -74,9 +74,14 @@ class GRPCCommManager(BaseCommunicationManager):
         if ip_config is None and ip_config_path:
             ip_config = load_ip_config(ip_config_path)
         self.ip_config = ip_config or {i: "127.0.0.1" for i in range(world_size)}
+        # shared with the receive thread (graftlint G005): the observer list
+        # is snapshotted under its own lock, loop liveness is an Event — a
+        # plain bool write from stop_receive_message() has no happens-before
+        # edge with the loop's read
         self._observers: List[Observer] = []
+        self._obs_lock = threading.Lock()
+        self._stop_evt = threading.Event()
         self._queue: "queue.Queue[bytes]" = queue.Queue()
-        self._running = False
         self._channels: Dict[int, grpc.Channel] = {}
         self._stubs: Dict[int, grpc.UnaryUnaryMultiCallable] = {}
         self._stream_stubs: Dict[int, grpc.StreamUnaryMultiCallable] = {}
@@ -173,19 +178,20 @@ class GRPCCommManager(BaseCommunicationManager):
                 raise
 
     def add_observer(self, observer: Observer) -> None:
-        self._observers.append(observer)
+        with self._obs_lock:
+            self._observers.append(observer)
 
     def remove_observer(self, observer: Observer) -> None:
-        if observer in self._observers:
-            self._observers.remove(observer)
+        with self._obs_lock:
+            if observer in self._observers:
+                self._observers.remove(observer)
 
     def handle_receive_message(self) -> None:
-        self._running = True
         self._notify(
             Message(CommunicationConstants.MSG_TYPE_CONNECTION_IS_READY,
                     self.rank, self.rank)
         )
-        while self._running:
+        while not self._stop_evt.is_set():
             try:
                 data = self._queue.get(timeout=0.1)
             except queue.Empty:
@@ -193,7 +199,7 @@ class GRPCCommManager(BaseCommunicationManager):
             self._notify(Message.deserialize(data))
 
     def stop_receive_message(self) -> None:
-        self._running = False
+        self._stop_evt.set()
         self._server.stop(grace=0.5)
         with self._lock:
             for ch in self._channels.values():
@@ -202,5 +208,7 @@ class GRPCCommManager(BaseCommunicationManager):
             self._stubs.clear()
 
     def _notify(self, msg: Message) -> None:
-        for obs in list(self._observers):
+        with self._obs_lock:
+            observers = list(self._observers)
+        for obs in observers:
             obs.receive_message(msg.get_type(), msg)

@@ -62,28 +62,32 @@ class LoopbackCommManager(BaseCommunicationManager):
         self.world_size = int(world_size)
         self.world = world
         self.broker = _Broker.get(world)
+        # shared with the receive thread (graftlint G005) — same discipline
+        # as the network backends: locked observer snapshot, Event liveness
         self._observers: List[Observer] = []
-        self._running = False
+        self._obs_lock = threading.Lock()
+        self._stop_evt = threading.Event()
 
     def send_message(self, msg: Message) -> None:
         self.broker.queue_for(msg.get_receiver_id()).put(msg.serialize())
 
     def add_observer(self, observer: Observer) -> None:
-        self._observers.append(observer)
+        with self._obs_lock:
+            self._observers.append(observer)
 
     def remove_observer(self, observer: Observer) -> None:
-        if observer in self._observers:
-            self._observers.remove(observer)
+        with self._obs_lock:
+            if observer in self._observers:
+                self._observers.remove(observer)
 
     def handle_receive_message(self) -> None:
-        self._running = True
         # synthetic connection-ready event, like the MQTT/GRPC backends
         self._notify(
             Message(CommunicationConstants.MSG_TYPE_CONNECTION_IS_READY,
                     self.rank, self.rank)
         )
         q = self.broker.queue_for(self.rank)
-        while self._running:
+        while not self._stop_evt.is_set():
             try:
                 data = q.get(timeout=0.1)
             except queue.Empty:
@@ -91,8 +95,10 @@ class LoopbackCommManager(BaseCommunicationManager):
             self._notify(Message.deserialize(data))
 
     def stop_receive_message(self) -> None:
-        self._running = False
+        self._stop_evt.set()
 
     def _notify(self, msg: Message) -> None:
-        for obs in list(self._observers):
+        with self._obs_lock:
+            observers = list(self._observers)
+        for obs in observers:
             obs.receive_message(msg.get_type(), msg)

@@ -50,12 +50,18 @@ class MqttCommManager(BaseCommunicationManager):
         self.run_id = str(run_id)
         self.qos = int(qos)
         self._queue: "queue.Queue[bytes]" = queue.Queue()
+        # shared with the paho network thread and the receive thread
+        # (graftlint G005): observers snapshot under a lock, loop liveness
+        # is an Event instead of a cross-thread bool
         self._observers: List[Observer] = []
-        self._running = False
+        self._obs_lock = threading.Lock()
+        self._stop_evt = threading.Event()
         self._subscribed = threading.Event()
         # set on either outcome (subscribed OR refused) so waiters wake
         # immediately on a definitive broker refusal
         self._conn_resolved = threading.Event()
+        # written by the paho thread strictly BEFORE _conn_resolved.set();
+        # read strictly AFTER .wait() — the Event is the happens-before edge
         self._connect_error = None
         client_id = f"fedml-{run_id}-{rank}"
         try:  # paho-mqtt >= 2.0 requires the callback API version up front
@@ -119,14 +125,15 @@ class MqttCommManager(BaseCommunicationManager):
             telemetry.counter_inc("comm.mqtt.send_retries")
 
     def add_observer(self, observer: Observer) -> None:
-        self._observers.append(observer)
+        with self._obs_lock:
+            self._observers.append(observer)
 
     def remove_observer(self, observer: Observer) -> None:
-        if observer in self._observers:
-            self._observers.remove(observer)
+        with self._obs_lock:
+            if observer in self._observers:
+                self._observers.remove(observer)
 
     def handle_receive_message(self) -> None:
-        self._running = True
         # don't declare readiness before our SUBSCRIBE is acknowledged:
         # brokers drop publishes to subscriber-less topics, so an early
         # ONLINE handshake from a peer would vanish
@@ -142,7 +149,7 @@ class MqttCommManager(BaseCommunicationManager):
             Message(CommunicationConstants.MSG_TYPE_CONNECTION_IS_READY,
                     self.rank, self.rank)
         )
-        while self._running:
+        while not self._stop_evt.is_set():
             try:
                 data = self._queue.get(timeout=0.1)
             except queue.Empty:
@@ -150,7 +157,7 @@ class MqttCommManager(BaseCommunicationManager):
             self._notify(Message.deserialize(data))
 
     def stop_receive_message(self) -> None:
-        self._running = False
+        self._stop_evt.set()
         try:
             self._client.loop_stop()
             self._client.disconnect()
@@ -158,5 +165,7 @@ class MqttCommManager(BaseCommunicationManager):
             pass
 
     def _notify(self, msg: Message) -> None:
-        for obs in list(self._observers):
+        with self._obs_lock:
+            observers = list(self._observers)
+        for obs in observers:
             obs.receive_message(msg.get_type(), msg)

@@ -224,6 +224,12 @@ class _State:
 
 _TLS = threading.local()  # .record — the in-flight RoundRecord, if any
 
+# guards _State's mutable run-state (EMA, metrics-file throttle) and the
+# metrics tmp-file write: cross-silo rounds close on a comm receive thread
+# while close()/atexit and the sys-perf sampler touch the same state
+# (graftlint G005)
+_STATE_LOCK = threading.Lock()
+
 
 def enabled() -> bool:
     return _State.enabled
@@ -270,20 +276,26 @@ def close() -> None:
 
 
 def write_metrics_file(force: bool = False) -> Optional[str]:
-    """Write the Prometheus exposition to ``--metrics_file`` (throttled)."""
+    """Write the Prometheus exposition to ``--metrics_file`` (throttled).
+
+    The throttle check-and-set and the tmp-file write/replace both run under
+    ``_STATE_LOCK``: two threads racing the same ``.tmp`` path would corrupt
+    the exposition file."""
     path = _State.metrics_file
     if path is None:
         return None
-    now = time.monotonic()
-    if not force and now - _State.last_metrics_write < _State.metrics_write_interval_s:
-        return None
-    _State.last_metrics_write = now
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        f.write(_REG.render_prometheus())
     import os
 
-    os.replace(tmp, path)
+    now = time.monotonic()
+    with _STATE_LOCK:
+        if (not force and now - _State.last_metrics_write
+                < _State.metrics_write_interval_s):
+            return None
+        _State.last_metrics_write = now
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(_REG.render_prometheus())
+        os.replace(tmp, path)
     return path
 
 
@@ -446,11 +458,12 @@ def begin_round(round_idx: int, fused: bool = False,
 
 
 def _update_ema(inst_rounds_per_sec: float) -> float:
-    prev = _State.ema_rounds_per_sec
-    ema = (inst_rounds_per_sec if prev is None
-           else 0.9 * prev + 0.1 * inst_rounds_per_sec)
-    _State.ema_rounds_per_sec = ema
-    return ema
+    with _STATE_LOCK:  # read-modify-write shared with comm-thread rounds
+        prev = _State.ema_rounds_per_sec
+        ema = (inst_rounds_per_sec if prev is None
+               else 0.9 * prev + 0.1 * inst_rounds_per_sec)
+        _State.ema_rounds_per_sec = ema
+        return ema
 
 
 def _hbm_fields(rec: RoundRecord) -> None:

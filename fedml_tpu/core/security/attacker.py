@@ -98,9 +98,11 @@ class FedMLAttacker:
             )
         # backdoor_pattern: malicious clients poison poison_frac of samples
         n = labels.shape[0]
-        n_real = n if n_valid is None else min(int(n_valid), n)
+        # n_valid is a static Python int at trace time (the fused path bakes
+        # it per config), never a tracer — safe under jit
+        n_real = n if n_valid is None else min(int(n_valid), n)  # graftlint: disable=G001
         frac = float(getattr(self.args, "byzantine_client_frac", 0.2))
-        num_bad = int(round(n_real * frac))
+        num_bad = int(round(n_real * frac))  # graftlint: disable=G001 — static
         rng = np.random.RandomState(int(getattr(self.args, "random_seed", 0)))
         client_mask = np.zeros((n,), np.float32)
         if num_bad:

@@ -81,7 +81,9 @@ def trimmed_mean(updates: jax.Array, trim_ratio: float) -> jax.Array:
     """Coordinate-wise trimmed mean (reference: slsgd_defense.py 'option 2',
     drop b largest and b smallest per coordinate)."""
     n = updates.shape[0]
-    b = int(n * trim_ratio)
+    # trim_ratio is static config (a Python float), so b is a compile-time
+    # constant — the sort/slice below stays statically shaped under jit
+    b = int(n * trim_ratio)  # graftlint: disable=G001
     if 2 * b >= n:
         raise ValueError(f"trim_ratio {trim_ratio} removes all {n} clients")
     s = jnp.sort(updates, axis=0)

@@ -1,0 +1,23 @@
+"""G001 known-bad: host syncs inside a jit-traced function (never imported,
+only parsed by the analyzer — line numbers are asserted by the tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_step(x, y):
+    total = float(x.sum())        # line 11: float() on a traced value
+    print("loss", total)          # line 12: print at trace time
+    host = np.asarray(y)          # line 13: device->host pull
+    scalar = x.mean().item()      # line 14: .item() host sync
+    return total + host.sum() + scalar
+
+
+def make_scan(xs):
+    def body(carry, x):
+        v = int(x)                # line 20: int() inside a lax.scan body
+        return carry + v, x
+
+    return jax.lax.scan(body, 0, xs)

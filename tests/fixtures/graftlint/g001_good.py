@@ -1,0 +1,20 @@
+"""G001 known-good: everything stays on device; host casts only touch
+static metadata or config."""
+
+import jax
+import jax.numpy as jnp
+
+CONFIG_LR = "0.1"
+
+
+@jax.jit
+def good_step(x, y):
+    n = int(x.shape[0])           # static shape metadata — fine
+    lr = float(CONFIG_LR)         # module constant, not a tracer — fine
+    total = jnp.sum(x) / n
+    return total + lr * jnp.mean(y)
+
+
+def host_driver(x):
+    out = good_step(x, x)
+    return float(out)             # host sync OUTSIDE the traced region — fine

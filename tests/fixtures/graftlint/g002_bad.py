@@ -1,0 +1,27 @@
+"""G002 known-bad: use-after-donate."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _core(state, grads):
+    return jax.tree.map(lambda s, g: s - 0.1 * g, state, grads)
+
+
+step = jax.jit(_core, donate_argnums=(0,))
+
+
+def train(state, grads):
+    new_state = step(state, grads)    # line 15: `state` donated here
+    norm = jnp.linalg.norm(state)     # line 16: read of the donated buffer
+    return new_state, norm
+
+
+class Runner:
+    def __init__(self):
+        self._step = jax.jit(_core, donate_argnums=(0,))
+
+    def round(self, state, grads):
+        out = self._step(state, grads)   # line 25: donated via attribute
+        stale = state                    # line 26: use-after-donate
+        return out, stale

@@ -1,0 +1,21 @@
+"""G002 known-good: the donated name is rebound from the call's result."""
+
+import jax
+
+
+def _core(state, grads):
+    return jax.tree.map(lambda s, g: s - 0.1 * g, state, grads)
+
+
+step = jax.jit(_core, donate_argnums=(0,))
+
+
+def train(state, grads):
+    state = step(state, grads)    # rebind: the old buffer is never read
+    return state
+
+
+def branches(state, grads, fused):
+    if fused:
+        return step(state, grads)   # consumed, but this branch returns
+    return jax.tree.map(lambda s: s * 0.5, state)   # distinct path — fine

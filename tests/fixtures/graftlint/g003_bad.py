@@ -1,0 +1,24 @@
+"""G003 known-bad: recompile hazards at the jit boundary."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _core(x, n):
+    return x[:n].sum()
+
+
+step = jax.jit(_core)  # no static_argnums
+
+
+def run(batch):
+    return step(batch, len(batch))       # line 15: data-derived scalar
+
+
+def run_shape(batch):
+    return step(batch, batch.shape[0])   # line 19: shape fed dynamically
+
+
+def build_tree(names, batch):
+    params = {k: jnp.zeros(4) for k in set(names)}   # line 23: set order
+    return params, batch

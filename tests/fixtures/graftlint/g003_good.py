@@ -1,0 +1,21 @@
+"""G003 known-good: scalars enter via static_argnums; pytrees come from
+deterministically ordered containers."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _core(x, n):
+    return x[:n].sum()
+
+
+step = jax.jit(_core, static_argnums=(1,))
+
+
+def run(batch):
+    return step(batch, len(batch))   # static arg — recompile is intentional
+
+
+def build_tree(names, batch):
+    params = {k: jnp.zeros(4) for k in sorted(names)}   # ordered — fine
+    return params, batch

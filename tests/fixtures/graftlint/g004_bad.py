@@ -1,0 +1,19 @@
+"""G004 known-bad: side effects inside traced round functions."""
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.core.mlops import telemetry
+
+_HISTORY = []
+
+
+class Engine:
+    def build(self):
+        def core(state, grads):
+            self.last_state = state            # line 14: attribute write
+            telemetry.counter_inc("rounds")    # line 15: telemetry call
+            _HISTORY.append(grads)             # line 16: captured-list append
+            return jax.tree.map(lambda s, g: s - g, state, grads)
+
+        return jax.jit(core, donate_argnums=(0,))

@@ -1,0 +1,32 @@
+"""G005 known-bad: unguarded cross-thread state."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self.results = []
+        self._running = False
+        self._thread = threading.Thread(target=self._poll, daemon=True)
+
+    def _poll(self):
+        while self._running:
+            self.results.append(1)       # line 14: thread-side write
+
+    def start(self):
+        self._running = True             # line 17: main-side write
+        self._thread.start()
+
+    def stop(self):
+        self._running = False            # line 21: main-side write
+        return list(self.results)        # line 22: main-side read
+
+
+class Registry:
+    enabled = False
+    ema = None
+
+
+def update(value):
+    prev = Registry.ema                  # line 31: read
+    Registry.ema = value if prev is None else 0.5 * (prev + value)  # line 32

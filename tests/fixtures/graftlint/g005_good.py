@@ -1,0 +1,37 @@
+"""G005 known-good: Event liveness, locked shared containers."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self.results = []
+        self._lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._thread = threading.Thread(target=self._poll, daemon=True)
+
+    def _poll(self):
+        while not self._stop_evt.is_set():
+            with self._lock:
+                self.results.append(1)
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop_evt.set()
+        with self._lock:
+            return list(self.results)
+
+
+class Registry:
+    ema = None
+
+
+_REG_LOCK = threading.Lock()
+
+
+def update(value):
+    with _REG_LOCK:
+        prev = Registry.ema
+        Registry.ema = value if prev is None else 0.5 * (prev + value)

@@ -1,0 +1,11 @@
+"""File-level pragma fixture: the pragma sits in the prologue (after the
+module docstring, before any code) and suppresses G001 for the whole file."""
+# graftlint: disable=G001
+
+import jax
+
+
+@jax.jit
+def step(x):
+    n = int(x)        # suppressed by the file-level pragma
+    return float(x) + n

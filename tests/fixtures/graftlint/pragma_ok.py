@@ -1,0 +1,10 @@
+"""Pragma fixture: the same G001 pattern, suppressed inline."""
+
+import jax
+
+
+@jax.jit
+def step(x):
+    n = int(x)        # line 8: unsuppressed — must still be reported
+    m = int(x)        # graftlint: disable=G001 — suppressed
+    return n + m

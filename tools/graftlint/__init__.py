@@ -1,0 +1,36 @@
+"""graftlint — JAX-aware static analysis for the fedml_tpu codebase.
+
+The fused round engine (one donated XLA program per round) is a correctness
+property that dynamic tests only sample: every new algorithm or defense can
+silently reintroduce host syncs, recompiles, donation bugs or cross-thread
+races that the parity tests never exercise. graftlint checks the property
+statically over the whole tree, wired into CI as a tier-1 gate.
+
+Rules (see docs/graftlint.md):
+
+- **G001 host-sync-in-jit** — ``.item()``/``.tolist()``/``float()``/``int()``
+  /``bool()``/``np.asarray``/``print``/``jax.device_get`` on traced values,
+  reachable from any ``jax.jit``/``lax.scan``-traced function (call graph
+  seeded from ``round_engine.build_round_core``, the sp/mesh cohort programs
+  and the cheetah trainer).
+- **G002 donation-reuse** — a variable passed to a ``donate_argnums`` call
+  site and read again afterwards (use-after-donate).
+- **G003 recompile-hazard** — data-derived Python scalars/shapes fed to a jit
+  boundary without ``static_argnums``; set-iteration feeding pytree
+  construction (nondeterministic structure ⇒ recompile).
+- **G004 impure-round-fn** — side effects inside traced functions: attribute
+  /container writes on captured state, ``global`` writes, telemetry/logging
+  calls that aren't the no-op span.
+- **G005 unguarded-shared-state** — attributes mutated from both a thread
+  target (or callback) and main-thread code without a lock, plus unguarded
+  read-modify-write of module-level state in threaded modules.
+
+Run as ``python -m tools.graftlint fedml_tpu/`` (or ``fedml_tpu lint``).
+Suppress a single line with ``# graftlint: disable=G00X``; pre-existing
+findings live in the checked-in, repo-root-anchored
+``tools/graftlint/baseline.json``.
+"""
+
+from .findings import Finding, RULES  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,761 @@
+"""AST core: module index, jit call graph, and rules G001–G004.
+
+No imports of the analyzed code ever happen — everything is syntactic:
+
+1. **Index** every module under the scan roots (functions, classes, imports).
+2. **Trace roots**: functions that reach an XLA trace — ``@jax.jit``
+   decorators, ``jax.jit(f)`` / ``lax.scan(f, ...)`` sites, factory returns
+   (``return jax.jit(core, ...)`` where ``core`` came from a package factory
+   like ``round_engine.build_round_core``), plus the explicit seed list.
+3. **Propagation**: BFS over call edges (local names, package imports, and a
+   conservative class-hierarchy match on distinctive method names) marks the
+   trace-reachable set.
+4. **Checkers**: G001 (host syncs on tainted values inside traced code),
+   G002 (use-after-donate, in *any* function), G003 (recompile hazards at
+   jit boundaries), G004 (side effects inside traced code).
+
+G005 lives in :mod:`tools.graftlint.threads`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .findings import Finding
+
+# functions whose nested defs are always treated as traced, even if no jit
+# site is syntactically resolvable (the round engine's factory indirection)
+SEED_FACTORIES = ("build_round_core",)
+
+# single-function tracing transforms: transform(f) traces f
+TRACING_SINGLE = {
+    "jit", "vmap", "pmap", "grad", "value_and_grad", "checkpoint", "remat",
+    "make_jaxpr", "eval_shape", "custom_jvp", "custom_vjp", "jacrev",
+    "jacfwd", "hessian", "linearize",
+}
+
+# lax control-flow HOFs: which positional args are traced bodies
+LAX_HOF_POS = {
+    "scan": (0,), "map": (0,), "associative_scan": (0,),
+    "fori_loop": (2,), "while_loop": (0, 1), "cond": (1, 2, 3),
+    "switch": (1,),
+}
+
+# host-sync builtins flagged by G001 when fed a traced (tainted) value
+HOST_CASTS = {"float", "int", "bool", "complex"}
+
+# attribute reads that yield static (host) metadata — taint stops here
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "itemsize", "nbytes"}
+
+# method names too generic for class-hierarchy call-graph matching
+CHA_STOPLIST = {
+    "get", "put", "update", "add", "items", "keys", "values", "close",
+    "run", "start", "stop", "join", "send", "recv", "append", "pop",
+    "init", "save", "restore", "reset", "flush", "read", "write", "open",
+    "load", "serialize", "deserialize", "copy", "apply", "call", "sum",
+    "mean", "max", "min", "split", "replace", "count", "index", "extend",
+    "remove", "insert", "sort", "setdefault", "clear",
+}
+CHA_LIMIT = 8  # skip method names with more definitions than this
+
+MUTATORS_ATTR = {
+    # "update" stays out: optax GradientTransformation.update (pure, and all
+    # over the traced optimizer paths) is indistinguishable from dict.update
+    "append", "extend", "insert", "pop", "popitem", "clear",
+    "setdefault", "remove", "discard", "add", "write", "put",
+}
+MUTATORS_BARE = {"append", "extend", "insert", "popitem", "setdefault"}
+
+LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+               "critical", "log"}
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """Name/Attribute chain → ``a.b.c`` (None for anything else)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class FuncInfo:
+    __slots__ = (
+        "module", "node", "qualname", "parent", "class_name", "nested",
+        "returned", "returns_donated", "donate_argnums", "returns_strictjit",
+        "traced", "edges",
+    )
+
+    def __init__(self, module: "ModuleInfo", node: ast.AST, qualname: str,
+                 parent: Optional["FuncInfo"], class_name: Optional[str]):
+        self.module = module
+        self.node = node
+        self.qualname = qualname
+        self.parent = parent
+        self.class_name = class_name
+        self.nested: Dict[str, FuncInfo] = {}
+        self.returned: List[FuncInfo] = []
+        self.returns_donated = False
+        self.donate_argnums: Optional[Tuple[int, ...]] = None
+        self.returns_strictjit = False
+        self.traced = False
+        self.edges: Set[FuncInfo] = set()
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+    def params(self) -> List[str]:
+        a = self.node.args
+        names = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+
+class ModuleInfo:
+    def __init__(self, path: str, rel: str, name: str, tree: ast.Module,
+                 source: str, is_package: bool = False):
+        self.path = path
+        self.rel = rel
+        self.name = name
+        self.is_package = is_package
+        self.tree = tree
+        self.source = source
+        self.lines = source.splitlines()
+        self.imports: Dict[str, str] = {}        # alias -> dotted module
+        self.from_imports: Dict[str, Tuple[str, str]] = {}  # name -> (mod, orig)
+        self.funcs_by_node: Dict[int, FuncInfo] = {}
+        self.toplevel: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, Dict[str, FuncInfo]] = {}
+        self.class_bases: Dict[str, List[str]] = {}
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+class PackageIndex:
+    def __init__(self, modules: Dict[str, ModuleInfo]):
+        self.modules = modules
+        self.all_methods: Dict[str, List[FuncInfo]] = {}
+        for mod in modules.values():
+            for methods in mod.classes.values():
+                for m in methods.values():
+                    self.all_methods.setdefault(m.name, []).append(m)
+        # attr name -> donate_argnums for donated jit programs bound via
+        # ``self.attr = factory(...)`` (filled during fact passes)
+        self.donating_attrs: Dict[str, Optional[Tuple[int, ...]]] = {}
+        self.strictjit_attrs: Set[str] = set()
+
+
+# ---------------------------------------------------------------------------
+# Loading
+# ---------------------------------------------------------------------------
+
+
+def collect_files(paths: Sequence[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p) and p.endswith(".py"):
+            files.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git", ".venv")]
+                files.extend(os.path.join(root, n) for n in sorted(names)
+                             if n.endswith(".py"))
+    return sorted(set(files))
+
+
+def module_name_for(path: str, repo_root: str) -> str:
+    rel = os.path.relpath(path, repo_root)
+    parts = rel.replace(os.sep, "/").split("/")
+    if parts[-1] == "__init__.py":
+        parts = parts[:-1]
+    else:
+        parts[-1] = parts[-1][:-3]
+    return ".".join(parts)
+
+
+def load_modules(files: Sequence[str], repo_root: str
+                 ) -> Dict[str, ModuleInfo]:
+    modules: Dict[str, ModuleInfo] = {}
+    for path in files:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            continue
+        rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
+        name = module_name_for(path, repo_root)
+        mod = ModuleInfo(path, rel, name, tree, source,
+                         is_package=path.endswith("__init__.py"))
+        _collect_imports(mod)
+        _collect_funcs(mod)
+        modules[name] = mod
+    return modules
+
+
+def _collect_imports(mod: ModuleInfo) -> None:
+    # the package containing this module: for a/b/c.py that's a.b; for
+    # a/b/__init__.py the module name a.b IS the package — level 1 resolves
+    # against it directly, not against a
+    parts = mod.name.split(".")
+    pkg_parts = parts if mod.is_package else parts[:-1]
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                mod.imports[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base_parts = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                base = ".".join(base_parts)
+                if node.module:
+                    base = f"{base}.{node.module}" if base else node.module
+            else:
+                base = node.module or ""
+            for a in node.names:
+                local = a.asname or a.name
+                mod.from_imports[local] = (base, a.name)
+
+
+def _collect_funcs(mod: ModuleInfo) -> None:
+    def walk(node: ast.AST, parent: Optional[FuncInfo],
+             class_name: Optional[str], prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                fi = FuncInfo(mod, child, qual, parent, class_name)
+                mod.funcs_by_node[id(child)] = fi
+                if parent is not None:
+                    parent.nested[child.name] = fi
+                elif class_name is not None:
+                    mod.classes.setdefault(class_name, {})[child.name] = fi
+                else:
+                    mod.toplevel[child.name] = fi
+                walk(child, fi, None, qual + ".")
+            elif isinstance(child, ast.Lambda):
+                qual = f"{prefix}<lambda:{child.lineno}>"
+                fi = FuncInfo(mod, child, qual, parent, class_name)
+                mod.funcs_by_node[id(child)] = fi
+                if parent is not None:
+                    parent.nested[f"<lambda:{child.lineno}>"] = fi
+                walk(child, fi, None, qual + ".")
+            elif isinstance(child, ast.ClassDef):
+                mod.classes.setdefault(child.name, {})
+                mod.class_bases[child.name] = [
+                    d for d in (dotted(b) for b in child.bases) if d
+                ]
+                walk(child, parent, child.name, f"{prefix}{child.name}.")
+            else:
+                walk(child, parent, class_name, prefix)
+
+    walk(mod.tree, None, None, "")
+
+
+# ---------------------------------------------------------------------------
+# jax-name classification
+# ---------------------------------------------------------------------------
+
+
+def _is_jaxish(mod: ModuleInfo, head: str) -> bool:
+    if head == "jax":
+        return True
+    tgt = mod.imports.get(head, "")
+    if tgt.startswith("jax"):
+        return True
+    fi = mod.from_imports.get(head)
+    return bool(fi and fi[0].startswith("jax"))
+
+
+def _is_numpy(mod: ModuleInfo, head: str) -> bool:
+    return head == "numpy" or mod.imports.get(head, "") == "numpy"
+
+
+def _hof_positions(mod: ModuleInfo, ds: str) -> Optional[Tuple[int, ...]]:
+    parts = ds.split(".")
+    last = parts[-1]
+    if last in TRACING_SINGLE:
+        if len(parts) == 1:
+            fi = mod.from_imports.get(last)
+            if fi and fi[0].startswith("jax"):
+                return (0,)
+            return None
+        if _is_jaxish(mod, parts[0]):
+            return (0,)
+        return None
+    if last in LAX_HOF_POS:
+        if "lax" in parts[:-1]:
+            return LAX_HOF_POS[last]
+        if len(parts) >= 2 and _is_jaxish(mod, parts[0]):
+            tgt = mod.imports.get(parts[0], "")
+            if parts[-2] == "lax" or tgt.endswith("lax"):
+                return LAX_HOF_POS[last]
+    return None
+
+
+def _jit_call_info(mod: ModuleInfo, call: ast.Call
+                   ) -> Optional[Tuple[Optional[ast.expr], bool,
+                                       Optional[Tuple[int, ...]], bool]]:
+    """If ``call`` is a ``jax.jit(...)`` call: (fn_expr, has_static,
+    donate_argnums, is_donating). fn_expr is None for decorator factories."""
+    ds = dotted(call.func)
+    if ds is None:
+        return None
+    last = ds.split(".")[-1]
+    is_partial = last == "partial"
+    if is_partial:
+        if not call.args:
+            return None
+        inner = dotted(call.args[0])
+        if not inner or inner.split(".")[-1] != "jit":
+            return None
+        if not _is_jaxish(mod, inner.split(".")[0]) and inner != "jit":
+            return None
+        fn_expr = call.args[1] if len(call.args) > 1 else None
+    else:
+        if last != "jit" or _hof_positions(mod, ds) is None:
+            return None
+        fn_expr = call.args[0] if call.args else None
+    has_static = donating = False
+    argnums: Optional[Tuple[int, ...]] = None
+    for kw in call.keywords:
+        if kw.arg in ("static_argnums", "static_argnames"):
+            has_static = True
+        elif kw.arg in ("donate_argnums", "donate_argnames"):
+            donating = True
+            argnums = _parse_argnums(kw.value)
+    return fn_expr, has_static, argnums, donating
+
+
+def _parse_argnums(node: ast.expr) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Fact passes: returned funcs, donating callables, trace roots, call edges
+# ---------------------------------------------------------------------------
+
+
+class _Env:
+    """Per-function syntactic facts about local names."""
+
+    __slots__ = ("returned_locals", "donating_locals", "strictjit_locals")
+
+    def __init__(self):
+        self.returned_locals: Dict[str, List[FuncInfo]] = {}
+        # name -> donate_argnums (None = unknown positions, still donating)
+        self.donating_locals: Dict[str, Optional[Tuple[int, ...]]] = {}
+        self.strictjit_locals: Set[str] = set()
+
+
+class Analyzer:
+    def __init__(self, modules: Dict[str, ModuleInfo]):
+        self.modules = modules
+        self.index = PackageIndex(modules)
+        self.envs: Dict[FuncInfo, _Env] = {}
+        self.module_envs: Dict[ModuleInfo, _Env] = {}
+        self.findings: List[Finding] = []
+
+    # -- resolution ---------------------------------------------------------
+    def _all_funcs(self) -> List[FuncInfo]:
+        return [f for m in self.modules.values()
+                for f in m.funcs_by_node.values()]
+
+    def resolve_name(self, mod: ModuleInfo, scope: Optional[FuncInfo],
+                     name: str) -> List[FuncInfo]:
+        f = scope
+        while f is not None:
+            if name in f.nested:
+                return [f.nested[name]]
+            env = self.envs.get(f)
+            if env and name in env.returned_locals:
+                return env.returned_locals[name]
+            f = f.parent
+        menv = self.module_envs.get(mod)
+        if menv and name in menv.returned_locals:
+            return menv.returned_locals[name]
+        if name in mod.toplevel:
+            return [mod.toplevel[name]]
+        fi = mod.from_imports.get(name)
+        if fi:
+            target = self.modules.get(fi[0])
+            if target and fi[1] in target.toplevel:
+                return [target.toplevel[fi[1]]]
+        return []
+
+    def resolve_call_targets(self, mod: ModuleInfo, scope: Optional[FuncInfo],
+                             call: ast.Call) -> List[FuncInfo]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            return self.resolve_name(mod, scope, func.id)
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            # module-qualified package function: pkgmod.fn(...)
+            if isinstance(base, ast.Name):
+                tgt = mod.imports.get(base.id)
+                if tgt is None and base.id in mod.from_imports:
+                    b, orig = mod.from_imports[base.id]
+                    full = f"{b}.{orig}" if b else orig
+                    tgt = full if full in self.modules else None
+                if tgt and tgt in self.modules:
+                    target = self.modules[tgt]
+                    if func.attr in target.toplevel:
+                        return [target.toplevel[func.attr]]
+                    return []
+                # self.method(...) within a class
+                if base.id == "self" and scope is not None:
+                    f = scope
+                    while f is not None and f.class_name is None:
+                        f = f.parent
+                    if f is not None and f.class_name:
+                        methods = f.module.classes.get(f.class_name, {})
+                        if func.attr in methods:
+                            return [methods[func.attr]]
+            # conservative CHA on distinctive method names
+            m = func.attr
+            if (m not in CHA_STOPLIST and not m.startswith("__")):
+                # skip known-external receivers (jnp.mean, np.stack, ...)
+                if isinstance(base, ast.Name) and (
+                    _is_jaxish(mod, base.id) or _is_numpy(mod, base.id)
+                    or mod.imports.get(base.id, "").split(".")[0]
+                    in ("optax", "flax", "grpc", "orbax", "logging")
+                ):
+                    return []
+                cands = self.index.all_methods.get(m, [])
+                if 0 < len(cands) <= CHA_LIMIT:
+                    return list(cands)
+        return []
+
+    def _resolve_fn_expr(self, mod: ModuleInfo, scope: Optional[FuncInfo],
+                         expr: ast.expr) -> List[FuncInfo]:
+        """Resolve an expression in a traced-function position."""
+        if isinstance(expr, ast.Lambda):
+            fi = mod.funcs_by_node.get(id(expr))
+            return [fi] if fi else []
+        if isinstance(expr, ast.Name):
+            return self.resolve_name(mod, scope, expr.id)
+        if isinstance(expr, ast.Call):
+            ds = dotted(expr.func)
+            if ds is not None and _hof_positions(mod, ds) is not None:
+                out: List[FuncInfo] = []
+                for pos in _hof_positions(mod, ds):
+                    if pos < len(expr.args):
+                        out += self._resolve_fn_expr(mod, scope,
+                                                     expr.args[pos])
+                return out
+            # factory call: f() where f returns traced funcs
+            targets = self.resolve_call_targets(mod, scope, expr)
+            out = []
+            for t in targets:
+                out += t.returned
+            return out
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out = []
+            for e in expr.elts:
+                out += self._resolve_fn_expr(mod, scope, e)
+            return out
+        return []
+
+    # -- fixpoint fact computation -----------------------------------------
+    def compute_facts(self) -> None:
+        for _ in range(5):
+            changed = False
+            for mod in self.modules.values():
+                menv = self.module_envs.setdefault(mod, _Env())
+                for node in _walk_shallow(mod.tree):
+                    if (isinstance(node, ast.Assign)
+                            and len(node.targets) == 1):
+                        changed |= self._record_assignment(
+                            mod, None, menv, node.targets[0], node.value)
+                for fi in mod.funcs_by_node.values():
+                    changed |= self._func_facts(mod, fi)
+                changed |= self._scan_sites(mod, None, mod.tree)
+            if not changed:
+                break
+
+    def _func_facts(self, mod: ModuleInfo, fi: FuncInfo) -> bool:
+        changed = False
+        env = self.envs.setdefault(fi, _Env())
+        for node in _walk_shallow(fi.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                changed |= self._record_assignment(
+                    mod, fi, env, node.targets[0], node.value)
+            elif isinstance(node, ast.Return) and node.value is not None:
+                changed |= self._record_return(mod, fi, node.value)
+        changed |= self._scan_sites(mod, fi, fi.node)
+        return changed
+
+    def _record_assignment(self, mod: ModuleInfo, fi: FuncInfo, env: _Env,
+                           target: ast.expr, value: ast.expr) -> bool:
+        changed = False
+        info = (isinstance(value, ast.Call)
+                and _jit_call_info(mod, value)) or None
+        if info:
+            fn_expr, has_static, argnums, donating = info
+            if fn_expr is not None:
+                for t in self._resolve_fn_expr(mod, fi, fn_expr):
+                    if not t.traced:
+                        t.traced = changed = True
+            if isinstance(target, ast.Name):
+                if donating and target.id not in env.donating_locals:
+                    env.donating_locals[target.id] = argnums
+                    changed = True
+                if not has_static and target.id not in env.strictjit_locals:
+                    env.strictjit_locals.add(target.id)
+                    changed = True
+            elif (isinstance(target, ast.Attribute)
+                  and isinstance(target.value, ast.Name)
+                  and target.value.id == "self"):
+                if donating and target.attr not in self.index.donating_attrs:
+                    self.index.donating_attrs[target.attr] = argnums
+                    changed = True
+                if (not has_static
+                        and target.attr not in self.index.strictjit_attrs):
+                    self.index.strictjit_attrs.add(target.attr)
+                    changed = True
+            return changed
+        if isinstance(value, ast.Call):
+            targets = self.resolve_call_targets(mod, fi, value)
+            returned: List[FuncInfo] = []
+            donated = None
+            any_donating = any_strict = False
+            for t in targets:
+                returned += t.returned
+                if t.returns_donated:
+                    any_donating = True
+                    donated = t.donate_argnums
+                if t.returns_strictjit:
+                    any_strict = True
+            if isinstance(target, ast.Name):
+                if returned and target.id not in env.returned_locals:
+                    env.returned_locals[target.id] = returned
+                    changed = True
+                if any_donating and target.id not in env.donating_locals:
+                    env.donating_locals[target.id] = donated
+                    changed = True
+                if any_strict and target.id not in env.strictjit_locals:
+                    env.strictjit_locals.add(target.id)
+                    changed = True
+            elif (isinstance(target, ast.Attribute)
+                  and isinstance(target.value, ast.Name)
+                  and target.value.id == "self"):
+                if (any_donating
+                        and target.attr not in self.index.donating_attrs):
+                    self.index.donating_attrs[target.attr] = donated
+                    changed = True
+                if any_strict and target.attr not in self.index.strictjit_attrs:
+                    self.index.strictjit_attrs.add(target.attr)
+                    changed = True
+        return changed
+
+    def _record_return(self, mod: ModuleInfo, fi: FuncInfo,
+                       value: ast.expr) -> bool:
+        changed = False
+        if isinstance(value, ast.Call):
+            info = _jit_call_info(mod, value)
+            if info:
+                fn_expr, _has_static, argnums, donating = info
+                resolved = (self._resolve_fn_expr(mod, fi, fn_expr)
+                            if fn_expr is not None else [])
+                for t in resolved:
+                    if not t.traced:
+                        t.traced = changed = True
+                    if t not in fi.returned:
+                        fi.returned.append(t)
+                        changed = True
+                if donating and not fi.returns_donated:
+                    fi.returns_donated = True
+                    fi.donate_argnums = argnums
+                    changed = True
+                if not info[1] and not fi.returns_strictjit:
+                    fi.returns_strictjit = True
+                    changed = True
+                return changed
+        for t in self._resolve_fn_expr(mod, fi, value):
+            if t not in fi.returned:
+                fi.returned.append(t)
+                changed = True
+        return changed
+
+    def _scan_sites(self, mod: ModuleInfo, scope: Optional[FuncInfo],
+                    root: ast.AST) -> bool:
+        """Mark traced roots at jit/HOF sites + decorators under ``root``
+        (not descending into nested function bodies)."""
+        changed = False
+        for node in _walk_shallow(root):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = mod.funcs_by_node.get(id(node))
+                if fi is None:
+                    continue
+                for dec in node.decorator_list:
+                    if self._decorator_traces(mod, dec) and not fi.traced:
+                        fi.traced = changed = True
+                if (node.name in SEED_FACTORIES
+                        or fi.name in SEED_FACTORIES):
+                    for sub in fi.nested.values():
+                        if not sub.traced:
+                            sub.traced = changed = True
+            elif isinstance(node, ast.Call):
+                ds = dotted(node.func)
+                if ds is None:
+                    continue
+                positions = _hof_positions(mod, ds)
+                if positions is None:
+                    info = _jit_call_info(mod, node)
+                    if info and info[0] is not None:
+                        for t in self._resolve_fn_expr(mod, scope, info[0]):
+                            if not t.traced:
+                                t.traced = changed = True
+                    continue
+                for pos in positions:
+                    if pos < len(node.args):
+                        for t in self._resolve_fn_expr(mod, scope,
+                                                       node.args[pos]):
+                            if not t.traced:
+                                t.traced = changed = True
+        return changed
+
+    def _decorator_traces(self, mod: ModuleInfo, dec: ast.expr) -> bool:
+        ds = dotted(dec)
+        if ds is not None:
+            return _hof_positions(mod, ds) == (0,)
+        if isinstance(dec, ast.Call):
+            info = _jit_call_info(mod, dec)
+            return info is not None
+        return False
+
+    # -- traced propagation -------------------------------------------------
+    def propagate(self) -> None:
+        for mod in self.modules.values():
+            for fi in mod.funcs_by_node.values():
+                self._compute_edges(mod, fi)
+        work = [f for f in self._all_funcs() if f.traced]
+        seen = set(work)
+        while work:
+            f = work.pop()
+            # nested lambdas of a traced function execute during its trace
+            # (jax.tree.map(lambda ...) bodies etc.)
+            lambdas = [n for name, n in f.nested.items()
+                       if name.startswith("<lambda")]
+            for t in list(f.edges) + lambdas:
+                if not t.traced:
+                    t.traced = True
+                if t not in seen:
+                    seen.add(t)
+                    work.append(t)
+
+    def _compute_edges(self, mod: ModuleInfo, fi: FuncInfo) -> None:
+        for node in _walk_shallow(fi.node):
+            if isinstance(node, ast.Call):
+                for t in self.resolve_call_targets(mod, fi, node):
+                    fi.edges.add(t)
+                ds = dotted(node.func)
+                if ds is not None:
+                    positions = _hof_positions(mod, ds)
+                    if positions:
+                        for pos in positions:
+                            if pos < len(node.args):
+                                for t in self._resolve_fn_expr(
+                                        mod, fi, node.args[pos]):
+                                    fi.edges.add(t)
+
+    # -- entry --------------------------------------------------------------
+    def run(self) -> List[Finding]:
+        self.compute_facts()
+        self.propagate()
+        from .rules import check_function, check_untraced
+        for mod in self.modules.values():
+            for fi in mod.funcs_by_node.values():
+                if fi.traced:
+                    self.findings += check_function(self, mod, fi)
+                self.findings += check_untraced(self, mod, fi)
+        from .threads import check_module_threads
+        thread_entries = _collect_thread_entries(self.modules)
+        for mod in self.modules.values():
+            self.findings += check_module_threads(mod, thread_entries)
+        return self.findings
+
+
+def _walk_shallow(root: ast.AST):
+    """Yield nodes under ``root`` without entering nested function bodies."""
+    stack = [c for c in ast.iter_child_nodes(root)]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # decorators/defaults still belong to this scope
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                stack.extend(node.decorator_list)
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _collect_thread_entries(modules: Dict[str, ModuleInfo]) -> Set[str]:
+    """Method names used as ``threading.Thread(target=...)`` anywhere."""
+    names: Set[str] = set()
+    for mod in modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            ds = dotted(node.func)
+            if not ds or not ds.split(".")[-1] == "Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    tds = dotted(kw.value)
+                    if tds:
+                        names.add(tds.split(".")[-1])
+    return names
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+def analyze_paths(paths: Sequence[str],
+                  repo_root: Optional[str] = None) -> List[Finding]:
+    """Analyze files/dirs → pragma-filtered findings (baseline NOT applied)."""
+    from .baseline import find_repo_root
+    from .pragmas import is_suppressed, parse_pragmas
+
+    if repo_root is None:
+        repo_root = find_repo_root(paths[0] if paths else os.getcwd())
+    files = collect_files(paths)
+    modules = load_modules(files, repo_root)
+    findings = Analyzer(modules).run()
+    out: List[Finding] = []
+    pragma_cache: Dict[str, Dict] = {}
+    mods_by_rel = {m.rel: m for m in modules.values()}
+    for f in findings:
+        mod = mods_by_rel.get(f.path)
+        if mod is not None:
+            pragmas = pragma_cache.setdefault(f.path,
+                                              parse_pragmas(mod.source))
+            if is_suppressed(pragmas, f.rule, f.line):
+                continue
+        out.append(f)
+    return sorted(out, key=lambda f: (f.path, f.line, f.col, f.rule))

@@ -1,0 +1,79 @@
+"""Checked-in baseline: pre-existing findings, suppressed but visible.
+
+Keys are ``<repo-relative path>::<rule>::<stripped source line>`` with an
+occurrence count — line-number-free so edits elsewhere in a file don't churn
+the baseline, repo-root-anchored so results are identical from any cwd.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from typing import Iterable, List, Tuple
+
+from .findings import Finding
+
+DEFAULT_BASELINE_RELPATH = os.path.join("tools", "graftlint", "baseline.json")
+
+_ROOT_MARKERS = ("pyproject.toml", ".git")
+
+
+def find_repo_root(start: str) -> str:
+    """Walk up from ``start`` to the first dir holding a root marker."""
+    cur = os.path.abspath(start)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    while True:
+        if any(os.path.exists(os.path.join(cur, m)) for m in _ROOT_MARKERS):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start if os.path.isdir(start)
+                                   else os.path.dirname(start))
+        cur = parent
+
+
+def default_baseline_path(repo_root: str) -> str:
+    return os.path.join(repo_root, DEFAULT_BASELINE_RELPATH)
+
+
+def load(path: str) -> Counter:
+    if not path or not os.path.exists(path):
+        return Counter()
+    with open(path) as f:
+        data = json.load(f)
+    return Counter({str(k): int(v) for k, v in data.get("findings", {}).items()})
+
+
+def save(path: str, findings: Iterable[Finding]) -> None:
+    counts = Counter(f.baseline_key() for f in findings)
+    payload = {
+        "version": 1,
+        "comment": (
+            "graftlint baseline: pre-existing findings, suppressed but "
+            "visible. Regenerate with --write-baseline; shrink it, never "
+            "grow it."
+        ),
+        "findings": {k: counts[k] for k in sorted(counts)},
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def split(findings: List[Finding], baseline: Counter
+          ) -> Tuple[List[Finding], List[Finding]]:
+    """(new, baselined) — up to the baselined count per key is suppressed,
+    matched in line order."""
+    budget = Counter(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        k = f.baseline_key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old

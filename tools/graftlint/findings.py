@@ -1,0 +1,75 @@
+"""Finding record + rule registry (ids, one-line docs, autofix hints)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+# rule id -> (title, autofix hint)
+RULES: Dict[str, Tuple[str, str]] = {
+    "G001": (
+        "host-sync-in-jit",
+        "keep the value on device (jnp ops / _masked_mean-style kernels); "
+        "realize host floats only outside the traced region, or mark the "
+        "argument static and pragma the line if it is trace-time config",
+    ),
+    "G002": (
+        "donation-reuse",
+        "adopt the returned state and never read the donated argument again; "
+        "rebind the name from the call's result or copy-to-host first",
+    ),
+    "G003": (
+        "recompile-hazard",
+        "pass data-derived scalars via static_argnums (or hoist them out of "
+        "the call); build pytrees from deterministically ordered containers, "
+        "never from set iteration",
+    ),
+    "G004": (
+        "impure-round-fn",
+        "return new state instead of mutating captured objects; move "
+        "telemetry/logging to the host-side wrapper around the dispatch",
+    ),
+    "G005": (
+        "unguarded-shared-state",
+        "guard the attribute with a threading.Lock, replace boolean flags "
+        "with threading.Event, or document the happens-before edge and "
+        "pragma the line",
+    ),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-root-relative posix path
+    line: int
+    col: int
+    message: str
+    line_text: str = ""  # stripped source line, used for baseline matching
+
+    @property
+    def title(self) -> str:
+        return RULES.get(self.rule, ("?", ""))[0]
+
+    @property
+    def hint(self) -> str:
+        return RULES.get(self.rule, ("?", ""))[1]
+
+    def baseline_key(self) -> str:
+        # line-number-free so unrelated edits above don't churn the baseline
+        return f"{self.path}::{self.rule}::{self.line_text}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule} [{self.title}] {self.message}")
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "title": self.title,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "hint": self.hint,
+        }

@@ -1,0 +1,76 @@
+"""Inline suppression: ``# graftlint: disable=G001[,G005]`` or ``=all``.
+
+The pragma suppresses findings of the listed rules on its own physical line.
+A pragma in the file *prologue* — before any code, i.e. among shebang/coding
+/comment/blank lines and the module docstring — suppresses the listed rules
+for the whole file.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, FrozenSet
+
+PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+)
+
+ALL = frozenset({"all"})
+
+# sentinel key for file-level (prologue) pragmas in the parsed map
+FILE_LEVEL = 0
+
+
+def _prologue_end(lines) -> int:
+    """Number of leading lines that are shebang/comments/blanks/docstring
+    (plus comments/blanks after the docstring) — i.e. everything before the
+    first line of actual code."""
+    n = len(lines)
+
+    def skip_trivia(i: int) -> int:
+        while i < n and (not lines[i].strip()
+                         or lines[i].lstrip().startswith("#")):
+            i += 1
+        return i
+
+    i = skip_trivia(0)
+    stripped = lines[i].lstrip() if i < n else ""
+    for quote in ('"""', "'''"):
+        if stripped.startswith(quote):
+            rest = stripped[len(quote):]
+            if quote not in rest:  # multi-line docstring
+                i += 1
+                while i < n and quote not in lines[i]:
+                    i += 1
+            i = min(i + 1, n)
+            i = skip_trivia(i)
+            break
+    return i
+
+
+def parse_pragmas(source: str) -> Dict[int, FrozenSet[str]]:
+    """1-based line -> rules disabled there; key ``FILE_LEVEL`` (0) holds
+    rules disabled for the whole file (pragma in the prologue)."""
+    out: Dict[int, FrozenSet[str]] = {}
+    lines = source.splitlines()
+    prologue = _prologue_end(lines)
+    for i, text in enumerate(lines, start=1):
+        m = PRAGMA_RE.search(text)
+        if not m:
+            continue
+        rules = frozenset(
+            r.strip() for r in m.group(1).split(",") if r.strip()
+        )
+        rules = ALL if "all" in rules else rules
+        key = FILE_LEVEL if i <= prologue else i
+        out[key] = out.get(key, frozenset()) | rules
+    return out
+
+
+def is_suppressed(pragmas: Dict[int, FrozenSet[str]], rule: str,
+                  line: int) -> bool:
+    for key in (line, FILE_LEVEL):
+        rules = pragmas.get(key)
+        if rules and ("all" in rules or rule in rules):
+            return True
+    return False

@@ -1,0 +1,606 @@
+"""Rule checkers G001–G004 over the analyzed function set.
+
+``check_function`` runs the traced-code rules (G001 host syncs on tainted
+values, G004 impurity) on functions the call graph marked trace-reachable;
+``check_untraced`` runs the host-side rules (G002 use-after-donate, G003
+recompile hazards) on every function.
+
+Taint model (G001): a traced function's parameters are tracers; taint flows
+through assignments/comprehensions and stops at static metadata
+(``.shape``/``.dtype``/``len()``) and at host casts themselves (the cast IS
+the finding; its result is a host value).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .analyzer import (
+    CHA_STOPLIST,
+    HOST_CASTS,
+    LOG_METHODS,
+    MUTATORS_ATTR,
+    MUTATORS_BARE,
+    STATIC_ATTRS,
+    Analyzer,
+    FuncInfo,
+    ModuleInfo,
+    _is_jaxish,
+    _is_numpy,
+    _jit_call_info,
+    dotted,
+)
+from .findings import Finding
+
+
+def _mk(mod: ModuleInfo, rule: str, node: ast.AST, message: str) -> Finding:
+    line = getattr(node, "lineno", 1)
+    col = getattr(node, "col_offset", 0)
+    return Finding(rule=rule, path=mod.rel, line=line, col=col,
+                   message=message, line_text=mod.line_text(line))
+
+
+def _root_name(node: ast.expr) -> Optional[str]:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _body_of(fi: FuncInfo) -> List[ast.stmt]:
+    if isinstance(fi.node, ast.Lambda):
+        return [ast.Expr(fi.node.body)]
+    return fi.node.body
+
+
+# ---------------------------------------------------------------------------
+# G001 + G004: traced-function checker
+# ---------------------------------------------------------------------------
+
+
+class _TraceChecker:
+    def __init__(self, analyzer: Analyzer, mod: ModuleInfo, fi: FuncInfo):
+        self.an = analyzer
+        self.mod = mod
+        self.fi = fi
+        self.findings: List[Finding] = []
+        params = fi.params()
+        self.tainted: Set[str] = {p for p in params if p not in ("self", "cls")}
+        self.local_created: Set[str] = set()
+        self.record = False
+
+    # -- taint --------------------------------------------------------------
+    def expr_tainted(self, e: Optional[ast.expr]) -> bool:
+        if e is None:
+            return False
+        if isinstance(e, ast.Name):
+            return e.id in self.tainted
+        if isinstance(e, ast.Attribute):
+            if e.attr in STATIC_ATTRS:
+                return False
+            return self.expr_tainted(e.value)
+        if isinstance(e, ast.Call):
+            ds = dotted(e.func)
+            if ds == "len":
+                return False
+            if ds in HOST_CASTS:
+                return False  # host value; the cast itself is the finding
+            if isinstance(e.func, ast.Attribute) and e.func.attr in (
+                    "item", "tolist"):
+                return False
+            if ds is not None:
+                parts = ds.split(".")
+                if (len(parts) > 1 and _is_numpy(self.mod, parts[0])
+                        and parts[-1] in ("asarray", "array")):
+                    return False
+            # taint flows through method-call receivers (x.sum(), x.mean())
+            recv_tainted = (self.expr_tainted(e.func.value)
+                            if isinstance(e.func, ast.Attribute) else False)
+            return recv_tainted or any(
+                self.expr_tainted(a) for a in e.args
+            ) or any(self.expr_tainted(k.value) for k in e.keywords)
+        if isinstance(e, ast.expr):
+            return any(self.expr_tainted(c)
+                       for c in ast.iter_child_nodes(e)
+                       if isinstance(c, ast.expr))
+        return False
+
+    def _taint_target(self, t: ast.expr, tainted: bool) -> None:
+        if isinstance(t, ast.Name):
+            self.local_created.add(t.id)
+            if tainted:
+                self.tainted.add(t.id)
+            else:
+                self.tainted.discard(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._taint_target(e, tainted)
+        elif isinstance(t, ast.Starred):
+            self._taint_target(t.value, tainted)
+
+    # -- traversal ----------------------------------------------------------
+    def run(self) -> List[Finding]:
+        body = _body_of(self.fi)
+        self.record = False
+        self._visit_block(body)  # pass 1: taint fixpoint (loops)
+        self.record = True
+        self._visit_block(body)
+        return self.findings
+
+    def _visit_block(self, stmts: List[ast.stmt]) -> None:
+        for s in stmts:
+            self._visit_stmt(s)
+
+    def _visit_stmt(self, s: ast.stmt) -> None:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.local_created.add(s.name)
+            return  # nested defs are checked separately if traced
+        if isinstance(s, ast.ClassDef):
+            self.local_created.add(s.name)
+            return
+        if isinstance(s, ast.Assign):
+            self._visit_expr(s.value)
+            tainted = self.expr_tainted(s.value)
+            for t in s.targets:
+                if isinstance(t, (ast.Name, ast.Tuple, ast.List, ast.Starred)):
+                    self._taint_target(t, tainted)
+                else:
+                    self._check_store_target(t, s)
+                    self._visit_expr(t)
+            return
+        if isinstance(s, ast.AugAssign):
+            self._visit_expr(s.value)
+            if isinstance(s.target, ast.Name):
+                if self.expr_tainted(s.value):
+                    self.tainted.add(s.target.id)
+                self.local_created.add(s.target.id)
+            else:
+                self._check_store_target(s.target, s)
+            return
+        if isinstance(s, ast.AnnAssign):
+            if s.value is not None:
+                self._visit_expr(s.value)
+                if isinstance(s.target, ast.Name):
+                    self._taint_target(s.target, self.expr_tainted(s.value))
+                else:
+                    self._check_store_target(s.target, s)
+            return
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            self._visit_expr(s.iter)
+            self._taint_target(s.target, self.expr_tainted(s.iter))
+            self._visit_block(s.body)
+            self._visit_block(s.orelse)
+            return
+        if isinstance(s, ast.While):
+            self._visit_expr(s.test)
+            self._visit_block(s.body)
+            self._visit_block(s.orelse)
+            return
+        if isinstance(s, ast.If):
+            self._visit_expr(s.test)
+            self._visit_block(s.body)
+            self._visit_block(s.orelse)
+            return
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                self._visit_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._taint_target(item.optional_vars, True)
+            self._visit_block(s.body)
+            return
+        if isinstance(s, ast.Try):
+            self._visit_block(s.body)
+            for h in s.handlers:
+                if h.name:
+                    self.local_created.add(h.name)
+                self._visit_block(h.body)
+            self._visit_block(s.orelse)
+            self._visit_block(s.finalbody)
+            return
+        if isinstance(s, ast.Global):
+            if self.record:
+                self.findings.append(_mk(
+                    self.mod, "G004", s,
+                    f"`global {', '.join(s.names)}` inside traced "
+                    f"`{self.fi.qualname}` — writes escape the trace",
+                ))
+            return
+        if isinstance(s, ast.Return) and s.value is not None:
+            self._visit_expr(s.value)
+            return
+        if isinstance(s, ast.Expr):
+            self._visit_expr(s.value)
+            return
+        if isinstance(s, ast.Delete):
+            for t in s.targets:
+                if not isinstance(t, ast.Name):
+                    self._check_store_target(t, s)
+            return
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, ast.expr):
+                self._visit_expr(child)
+            elif isinstance(child, ast.stmt):
+                self._visit_stmt(child)
+
+    def _check_store_target(self, target: ast.expr, stmt: ast.stmt) -> None:
+        if not self.record:
+            return
+        root = _root_name(target)
+        if root is not None and root in self.local_created:
+            return
+        kind = ("attribute" if isinstance(target, ast.Attribute)
+                else "container")
+        name = dotted(target) or (f"{root}[...]" if root else "<expr>")
+        self.findings.append(_mk(
+            self.mod, "G004", stmt,
+            f"{kind} write to `{name}` inside traced `{self.fi.qualname}` "
+            "— side effect runs at trace time only and escapes the program",
+        ))
+
+    def _visit_expr(self, e: Optional[ast.expr]) -> None:
+        if e is None:
+            return
+        if isinstance(e, ast.Call):
+            self._visit_call(e)
+            return
+        if isinstance(e, ast.Lambda):
+            return
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.DictComp,
+                          ast.GeneratorExp)):
+            for gen in e.generators:
+                self._visit_expr(gen.iter)
+                self._taint_target(gen.target, self.expr_tainted(gen.iter))
+                for cond in gen.ifs:
+                    self._visit_expr(cond)
+            if isinstance(e, ast.DictComp):
+                self._visit_expr(e.key)
+                self._visit_expr(e.value)
+            else:
+                self._visit_expr(e.elt)
+            return
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.expr):
+                self._visit_expr(child)
+
+    def _visit_call(self, call: ast.Call) -> None:
+        for a in call.args:
+            self._visit_expr(a)
+        for k in call.keywords:
+            self._visit_expr(k.value)
+        if not self.record:
+            return
+        ds = dotted(call.func)
+        parts = ds.split(".") if ds else []
+        where = f"inside traced `{self.fi.qualname}`"
+
+        # G001: host syncs
+        if ds == "print":
+            self.findings.append(_mk(
+                self.mod, "G001", call,
+                f"print() {where} runs at trace time only (use "
+                "jax.debug.print, or log outside the traced region)",
+            ))
+        elif ds in HOST_CASTS and any(self.expr_tainted(a)
+                                      for a in call.args):
+            self.findings.append(_mk(
+                self.mod, "G001", call,
+                f"{ds}() on a traced value {where} forces a host sync "
+                "(keep the scalar on device)",
+            ))
+        elif (isinstance(call.func, ast.Attribute)
+              and call.func.attr in ("item", "tolist")
+              and self.expr_tainted(call.func.value)):
+            self.findings.append(_mk(
+                self.mod, "G001", call,
+                f".{call.func.attr}() on a traced value {where} forces a "
+                "host sync",
+            ))
+        elif (len(parts) > 1 and _is_numpy(self.mod, parts[0])
+              and parts[-1] in ("asarray", "array")
+              and any(self.expr_tainted(a) for a in call.args)):
+            self.findings.append(_mk(
+                self.mod, "G001", call,
+                f"{ds}() on a traced value {where} pulls the buffer to "
+                "host (use jnp, or move this out of the traced region)",
+            ))
+        elif (parts and parts[-1] == "device_get"
+              and _is_jaxish(self.mod, parts[0])
+              and any(self.expr_tainted(a) for a in call.args)):
+            self.findings.append(_mk(
+                self.mod, "G001", call,
+                f"jax.device_get() {where} forces a host sync",
+            ))
+
+        # G004: telemetry / logging / captured-state mutation
+        if len(parts) >= 2 and parts[-2] == "telemetry" and parts[-1] != "phase":
+            self.findings.append(_mk(
+                self.mod, "G004", call,
+                f"telemetry call `{ds}` {where} fires at trace time only — "
+                "move it to the host-side wrapper",
+            ))
+        elif (len(parts) == 2 and parts[0] in ("logger", "logging")
+              and parts[1] in LOG_METHODS):
+            self.findings.append(_mk(
+                self.mod, "G004", call,
+                f"logging call `{ds}` {where} fires at trace time only",
+            ))
+        elif isinstance(call.func, ast.Attribute):
+            recv = call.func.value
+            m = call.func.attr
+            if isinstance(recv, ast.Attribute):
+                root = _root_name(recv)
+                if (root is not None and root not in self.local_created
+                        and m in MUTATORS_ATTR):
+                    self.findings.append(_mk(
+                        self.mod, "G004", call,
+                        f"`{dotted(recv)}.{m}(...)` mutates captured state "
+                        f"{where}",
+                    ))
+            elif isinstance(recv, ast.Name):
+                if (recv.id not in self.local_created
+                        and recv.id not in ("self", "cls")
+                        and m in MUTATORS_BARE):
+                    self.findings.append(_mk(
+                        self.mod, "G004", call,
+                        f"`{recv.id}.{m}(...)` mutates captured state "
+                        f"{where}",
+                    ))
+
+
+def check_function(analyzer: Analyzer, mod: ModuleInfo,
+                   fi: FuncInfo) -> List[Finding]:
+    return _TraceChecker(analyzer, mod, fi).run()
+
+
+# ---------------------------------------------------------------------------
+# G002: use-after-donate (any function)
+# ---------------------------------------------------------------------------
+
+
+def _terminates(block: List[ast.stmt]) -> bool:
+    if not block:
+        return False
+    last = block[-1]
+    if isinstance(last, (ast.Return, ast.Raise, ast.Break, ast.Continue)):
+        return True
+    if isinstance(last, (ast.With, ast.AsyncWith)):
+        return _terminates(last.body)
+    if isinstance(last, ast.If):
+        return bool(last.orelse) and _terminates(last.body) and _terminates(
+            last.orelse)
+    return False
+
+
+class _DonationChecker:
+    def __init__(self, analyzer: Analyzer, mod: ModuleInfo, fi: FuncInfo):
+        self.an = analyzer
+        self.mod = mod
+        self.fi = fi
+        self.findings: List[Finding] = []
+        # name -> (callee description, call lineno)
+        self.consumed: Dict[str, Tuple[str, int]] = {}
+
+    def _donating_callee(self, call: ast.Call
+                         ) -> Optional[Tuple[str, Optional[Tuple[int, ...]]]]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            f = self.fi
+            while f is not None:
+                env = self.an.envs.get(f)
+                if env and func.id in env.donating_locals:
+                    return func.id, env.donating_locals[func.id]
+                f = f.parent
+            menv = self.an.module_envs.get(self.mod)
+            if menv and func.id in menv.donating_locals:
+                return func.id, menv.donating_locals[func.id]
+            return None
+        if isinstance(func, ast.Attribute):
+            if func.attr in self.an.index.donating_attrs:
+                return (dotted(func) or func.attr,
+                        self.an.index.donating_attrs[func.attr])
+            return None
+        if isinstance(func, ast.Call):
+            info = _jit_call_info(self.mod, func)
+            if info and info[3]:
+                return "jax.jit(...)", info[2]
+        return None
+
+    def run(self) -> List[Finding]:
+        self._visit_block(_body_of(self.fi))
+        return self.findings
+
+    def _visit_block(self, stmts: List[ast.stmt]) -> None:
+        for s in stmts:
+            self._visit_stmt(s)
+
+    def _store(self, t: ast.expr) -> None:
+        if isinstance(t, ast.Name):
+            self.consumed.pop(t.id, None)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._store(e)
+        elif isinstance(t, ast.Starred):
+            self._store(t.value)
+
+    def _visit_stmt(self, s: ast.stmt) -> None:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            return
+        if isinstance(s, ast.Assign):
+            self._visit_expr(s.value)
+            for t in s.targets:
+                if isinstance(t, (ast.Name, ast.Tuple, ast.List, ast.Starred)):
+                    self._store(t)
+                else:
+                    self._visit_expr(t)
+            return
+        if isinstance(s, ast.AugAssign):
+            self._visit_expr(s.value)
+            if isinstance(s.target, ast.Name):
+                self._load(ast.Name(id=s.target.id, ctx=ast.Load(),
+                                    lineno=s.lineno, col_offset=s.col_offset))
+                self._store(s.target)
+            else:
+                self._visit_expr(s.target)
+            return
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            self._visit_expr(s.iter)
+            self._store(s.target)
+            self._visit_block(s.body)
+            self._visit_block(s.orelse)
+            return
+        if isinstance(s, ast.If):
+            self._visit_expr(s.test)
+            before = dict(self.consumed)
+            self._visit_block(s.body)
+            # a branch that terminates (return/raise/...) contributes nothing
+            # to the join — code after the If never sees its consumption
+            after_body = ({} if _terminates(s.body) else self.consumed)
+            self.consumed = dict(before)
+            self._visit_block(s.orelse)
+            if s.orelse and _terminates(s.orelse):
+                self.consumed = dict(before)
+            # union: "may be consumed" after the branch join
+            merged = dict(self.consumed)
+            merged.update(after_body)
+            self.consumed = merged
+            return
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                self._visit_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._store(item.optional_vars)
+            self._visit_block(s.body)
+            return
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, ast.expr):
+                self._visit_expr(child)
+            elif isinstance(child, ast.stmt):
+                self._visit_stmt(child)
+
+    def _load(self, n: ast.Name) -> None:
+        hit = self.consumed.get(n.id)
+        if hit is not None:
+            callee, line = hit
+            self.findings.append(_mk(
+                self.mod, "G002", n,
+                f"`{n.id}` was donated to `{callee}` (line {line}) and is "
+                "read again — the buffer is invalidated (use-after-donate)",
+            ))
+
+    def _visit_expr(self, e: Optional[ast.expr]) -> None:
+        if e is None:
+            return
+        if isinstance(e, ast.Name) and isinstance(e.ctx, ast.Load):
+            self._load(e)
+            return
+        if isinstance(e, ast.Call):
+            self._visit_expr(e.func) if not isinstance(
+                e.func, ast.Name) else None
+            for a in e.args:
+                self._visit_expr(a)
+            for k in e.keywords:
+                self._visit_expr(k.value)
+            don = self._donating_callee(e)
+            if don is not None:
+                callee, argnums = don
+                positions = (range(len(e.args)) if argnums is None
+                             else [p for p in argnums if p < len(e.args)])
+                for p in positions:
+                    a = e.args[p]
+                    if isinstance(a, ast.Name):
+                        self.consumed[a.id] = (callee, e.lineno)
+            return
+        if isinstance(e, ast.Lambda):
+            return
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.expr):
+                self._visit_expr(child)
+
+
+# ---------------------------------------------------------------------------
+# G003: recompile hazards (any function)
+# ---------------------------------------------------------------------------
+
+
+def _scalar_arg_repr(mod: ModuleInfo, a: ast.expr) -> Optional[str]:
+    """A data-derived Python scalar/shape expression, else None."""
+    if isinstance(a, ast.Call):
+        ds = dotted(a.func)
+        if ds in ("int", "round", "len") and a.args:
+            inner = a.args[0]
+            if not isinstance(inner, ast.Constant):
+                return f"{ds}(...)"
+        return None
+    if isinstance(a, ast.Attribute) and a.attr == "shape":
+        return f"{dotted(a) or '<expr>.shape'}"
+    if (isinstance(a, ast.Subscript)
+            and isinstance(a.value, ast.Attribute)
+            and a.value.attr == "shape"):
+        return f"{dotted(a.value) or '<expr>.shape'}[...]"
+    return None
+
+
+class _RecompileChecker:
+    def __init__(self, analyzer: Analyzer, mod: ModuleInfo, fi: FuncInfo):
+        self.an = analyzer
+        self.mod = mod
+        self.fi = fi
+        self.findings: List[Finding] = []
+
+    def _strictjit_callee(self, call: ast.Call) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            f = self.fi
+            while f is not None:
+                env = self.an.envs.get(f)
+                if env and func.id in env.strictjit_locals:
+                    return func.id
+                f = f.parent
+            menv = self.an.module_envs.get(self.mod)
+            if menv and func.id in menv.strictjit_locals:
+                return func.id
+        elif isinstance(func, ast.Attribute):
+            if func.attr in self.an.index.strictjit_attrs:
+                return dotted(func) or func.attr
+        return None
+
+    def run(self) -> List[Finding]:
+        from .analyzer import _walk_shallow
+
+        for node in _walk_shallow(self.fi.node):
+            if isinstance(node, ast.Call):
+                callee = self._strictjit_callee(node)
+                if callee is not None:
+                    for a in list(node.args) + [k.value
+                                                for k in node.keywords]:
+                        rep = _scalar_arg_repr(self.mod, a)
+                        if rep is not None:
+                            self.findings.append(_mk(
+                                self.mod, "G003", a,
+                                f"data-derived Python scalar `{rep}` fed to "
+                                f"jit-compiled `{callee}` without "
+                                "static_argnums — every new value recompiles",
+                            ))
+            elif isinstance(node, ast.DictComp):
+                for gen in node.generators:
+                    it = gen.iter
+                    is_set = (isinstance(it, ast.Set)
+                              or (isinstance(it, ast.Call)
+                                  and dotted(it.func) == "set"))
+                    if is_set:
+                        self.findings.append(_mk(
+                            self.mod, "G003", node,
+                            "dict built by iterating a set feeds pytree "
+                            "construction — set order is process-dependent, "
+                            "so the pytree structure (and the compiled "
+                            "program) changes between runs",
+                        ))
+        return self.findings
+
+
+def check_untraced(analyzer: Analyzer, mod: ModuleInfo,
+                   fi: FuncInfo) -> List[Finding]:
+    out = _DonationChecker(analyzer, mod, fi).run()
+    out += _RecompileChecker(analyzer, mod, fi).run()
+    return out

@@ -1,0 +1,427 @@
+"""G005 unguarded-shared-state: cross-thread attribute races.
+
+Two sub-rules, both purely syntactic:
+
+1. **Instance attributes**: within a class, partition methods into a
+   *thread side* (methods used as ``threading.Thread(target=...)`` anywhere
+   in the analyzed tree, callback-assigned methods/closures, ``run`` of a
+   Thread subclass, plus their intra-class call closure) and a *main side*
+   (everything else; ``__init__``'s own body counts as pre-thread setup).
+   An attribute written unguarded on one side and accessed unguarded on the
+   other — and not itself a Lock/Event/Queue — is flagged.
+
+2. **Module-level namespaces** (the ``_State.x`` pattern): in modules that
+   construct threads, an unguarded read-then-write of the same class
+   attribute inside one function is a check-then-act / read-modify-write
+   race.
+
+"Guarded" = lexically inside ``with self.<lock>:`` (or any ``with`` whose
+context expression names a lock). A method whose every intra-class call site
+is guarded inherits the guard.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .analyzer import dotted as _dotted
+from .findings import Finding
+
+LOCK_TYPES = {"Lock", "RLock", "Condition"}
+SAFE_TYPES = LOCK_TYPES | {
+    "Event", "Semaphore", "BoundedSemaphore", "Barrier", "Queue",
+    "LifoQueue", "PriorityQueue", "SimpleQueue", "deque", "local",
+}
+CONCURRENCY_CTORS = {"Thread", "ThreadPoolExecutor", "server", "Client",
+                     "Timer", "Process"}
+WRITE_METHODS = {
+    "append", "extend", "insert", "pop", "popitem", "clear", "update",
+    "setdefault", "remove", "discard", "add", "put",
+}
+
+
+def _is_lock_expr(ds: Optional[str], lock_attrs: Set[str]) -> bool:
+    if not ds:
+        return False
+    last = ds.split(".")[-1]
+    return last in lock_attrs or "lock" in last.lower()
+
+
+class _Access:
+    __slots__ = ("attr", "write", "guarded", "line", "owner")
+
+    def __init__(self, attr: str, write: bool, guarded: bool, line: int,
+                 owner: str):
+        self.attr = attr
+        self.write = write
+        self.guarded = guarded
+        self.line = line
+        self.owner = owner
+
+
+def _mk(mod, node_line: int, message: str) -> Finding:
+    return Finding(rule="G005", path=mod.rel, line=node_line, col=0,
+                   message=message, line_text=mod.line_text(node_line))
+
+
+# ---------------------------------------------------------------------------
+
+
+def check_module_threads(mod, thread_entry_names: Set[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    module_creates_thread = _module_creates(mod.tree, {"Thread", "Timer"})
+    for cls_name, methods in mod.classes.items():
+        if not methods:
+            continue
+        findings += _check_class(mod, cls_name, methods, thread_entry_names)
+    if module_creates_thread:
+        findings += _check_module_state_rmw(mod)
+    return findings
+
+
+def _module_creates(tree: ast.AST, ctors: Set[str]) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            ds = _dotted(node.func)
+            if ds and ds.split(".")[-1] in ctors:
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Sub-rule 1: instance attributes
+# ---------------------------------------------------------------------------
+
+
+def _check_class(mod, cls_name: str, methods: Dict[str, object],
+                 thread_entry_names: Set[str]) -> List[Finding]:
+    class_node = _find_class_node(mod.tree, cls_name)
+    if class_node is None:
+        return []
+
+    lock_attrs: Set[str] = set()
+    safe_attrs: Set[str] = set()
+    concurrent = any("Thread" in b for b in mod.class_bases.get(cls_name, []))
+    # class-body assignments (``_lock = threading.Lock()``)
+    for stmt in class_node.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            ds = _dotted(stmt.value.func)
+            last = ds.split(".")[-1] if ds else ""
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    if last in LOCK_TYPES:
+                        lock_attrs.add(t.id)
+                    if last in SAFE_TYPES:
+                        safe_attrs.add(t.id)
+
+    for m in methods.values():
+        for node in ast.walk(m.node):
+            if isinstance(node, ast.Call):
+                ds = _dotted(node.func)
+                last = ds.split(".")[-1] if ds else ""
+                if last in CONCURRENCY_CTORS:
+                    concurrent = True
+            targets: List[ast.expr] = []
+            value = None
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                targets, value = node.targets, node.value
+            elif (isinstance(node, ast.AnnAssign)
+                  and isinstance(node.value, ast.Call)):
+                targets, value = [node.target], node.value
+            if value is not None:
+                ds = _dotted(value.func)
+                last = ds.split(".")[-1] if ds else ""
+                for t in targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        if last in LOCK_TYPES:
+                            lock_attrs.add(t.attr)
+                        if last in SAFE_TYPES:
+                            safe_attrs.add(t.attr)
+
+    # entry methods + callback-assigned members / closures
+    entries: Set[str] = set()
+    callback_closures: Set[int] = set()  # id() of nested FunctionDef nodes
+    for name, m in methods.items():
+        if name in thread_entry_names:
+            entries.add(name)
+        if concurrent and name == "run":
+            entries.add(name)
+        nested_defs = {n.name: n for n in ast.walk(m.node)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))
+                       and n is not m.node}
+        for node in ast.walk(m.node):
+            if isinstance(node, ast.Assign):
+                v = node.targets[0] if node.targets else None
+                val_ds = _dotted(node.value)
+                if isinstance(v, ast.Attribute):
+                    # self._client.on_connect = self.m / local closure
+                    if val_ds and val_ds.startswith("self."):
+                        mm = val_ds.split(".", 1)[1]
+                        if mm in methods and (
+                                v.attr.startswith("on_")
+                                or not isinstance(v.value, ast.Name)
+                                or v.value.id != "self"):
+                            entries.add(mm)
+                    elif (isinstance(node.value, ast.Name)
+                          and node.value.id in nested_defs):
+                        callback_closures.add(id(nested_defs[node.value.id]))
+            elif isinstance(node, ast.keyword) and node.arg == "target":
+                val_ds = _dotted(node.value)
+                if val_ds and val_ds.startswith("self."):
+                    mm = val_ds.split(".", 1)[1]
+                    if mm in methods:
+                        entries.add(mm)
+                elif (isinstance(node.value, ast.Name)
+                      and node.value.id in nested_defs):
+                    callback_closures.add(id(nested_defs[node.value.id]))
+            elif isinstance(node, ast.Call) and concurrent:
+                # a closure escaping into a handler registry / callback slot
+                for a in list(node.args) + [k.value for k in node.keywords
+                                            if k.arg != "target"]:
+                    if (isinstance(a, ast.Name) and a.id in nested_defs):
+                        callback_closures.add(id(nested_defs[a.id]))
+
+    if not entries and not callback_closures:
+        return []
+
+    # thread-side closure over intra-class self.m() calls
+    thread_side: Set[str] = set(entries)
+    changed = True
+    while changed:
+        changed = False
+        for name in list(thread_side):
+            m = methods.get(name)
+            if m is None:
+                continue
+            for node in ast.walk(m.node):
+                if isinstance(node, ast.Call):
+                    ds = _dotted(node.func)
+                    if ds and ds.startswith("self."):
+                        callee = ds.split(".")[1]
+                        if callee in methods and callee not in thread_side:
+                            thread_side.add(callee)
+                            changed = True
+
+    # methods reachable only from __init__ run before any thread exists
+    callers: Dict[str, Set[str]] = {}
+    for name, m in methods.items():
+        for node in ast.walk(m.node):
+            if isinstance(node, ast.Call):
+                ds = _dotted(node.func)
+                if ds and ds.startswith("self."):
+                    parts = ds.split(".")
+                    if len(parts) == 2 and parts[1] in methods:
+                        callers.setdefault(parts[1], set()).add(name)
+    setup_methods = {"__init__"}
+    for name in methods:
+        who = callers.get(name, set())
+        if who and who <= setup_methods and name not in thread_side:
+            setup_methods.add(name)
+
+    # collect accesses
+    accesses: List[_Access] = []
+    guarded_calls: Dict[str, List[bool]] = {}
+    for name, m in methods.items():
+        side_thread = name in thread_side
+        setup = name in setup_methods
+        _collect_accesses(
+            m.node, owner=name, thread=side_thread, setup=setup,
+            lock_attrs=lock_attrs, callback_closures=callback_closures,
+            accesses=accesses, guarded_calls=guarded_calls, methods=methods,
+        )
+
+    # guard inheritance: every in-class call site guarded → method guarded
+    fully_guarded = {name for name, flags in guarded_calls.items()
+                     if flags and all(flags)}
+    for a in accesses:
+        if a.owner in fully_guarded:
+            a.guarded = True
+
+    findings: List[Finding] = []
+    by_attr: Dict[str, List[_Access]] = {}
+    for a in accesses:
+        if a.attr not in safe_attrs and a.attr not in lock_attrs:
+            by_attr.setdefault(a.attr, []).append(a)
+    for attr, accs in sorted(by_attr.items()):
+        main = [a for a in accs if a.owner not in thread_side
+                and not a.owner.startswith("<closure")]
+        thr = [a for a in accs if a.owner in thread_side
+               or a.owner.startswith("<closure")]
+        main_w = [a for a in main if a.write and not a.guarded]
+        thr_w = [a for a in thr if a.write and not a.guarded]
+        main_any = [a for a in main if not a.guarded]
+        thr_any = [a for a in thr if not a.guarded]
+        hit = None
+        if main_w and thr_any:
+            hit = (main_w[0], thr_any[0])
+        elif thr_w and main_any:
+            hit = (main_any[0], thr_w[0])
+        if hit is not None:
+            a_main, a_thr = hit
+            findings.append(_mk(
+                mod, (a_main.line if a_main.write else a_thr.line),
+                f"`self.{attr}` in `{cls_name}` is accessed from both "
+                f"main-thread code (`{a_main.owner}`, line {a_main.line}) "
+                f"and thread-side code (`{a_thr.owner}`, line {a_thr.line}) "
+                "with at least one unguarded write — guard it with a lock "
+                "or use threading.Event/queue.Queue",
+            ))
+    return findings
+
+
+def _find_class_node(tree: ast.AST, name: str) -> Optional[ast.ClassDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            return node
+    return None
+
+
+def _collect_accesses(func_node, owner: str, thread: bool, setup: bool,
+                      lock_attrs: Set[str], callback_closures: Set[int],
+                      accesses: List[_Access],
+                      guarded_calls: Dict[str, List[bool]],
+                      methods: Dict[str, object]) -> None:
+    def walk(node: ast.AST, guarded: bool, cur_owner: str,
+             cur_setup: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if id(child) in callback_closures:
+                    # callback closure: runs later, on another thread
+                    walk(child, guarded,
+                         f"<closure {cur_owner}.{child.name}>", False)
+                else:
+                    walk(child, guarded, cur_owner, cur_setup)
+                continue
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                g = guarded or any(
+                    _is_lock_expr(_dotted(i.context_expr), lock_attrs)
+                    for i in child.items
+                )
+                for i in child.items:
+                    walk(i.context_expr, guarded, cur_owner, cur_setup)
+                for stmt in child.body:
+                    walk(stmt, g, cur_owner, cur_setup)
+                continue
+            if isinstance(child, ast.Call):
+                ds = _dotted(child.func)
+                if ds and ds.startswith("self."):
+                    parts = ds.split(".")
+                    if len(parts) == 2 and parts[1] in methods:
+                        guarded_calls.setdefault(parts[1], []).append(guarded)
+                    elif (len(parts) == 3
+                          and parts[-1] in WRITE_METHODS):
+                        _record(parts[1], True, guarded, child.lineno,
+                                cur_owner, cur_setup)
+                walk(child, guarded, cur_owner, cur_setup)
+                continue
+            if isinstance(child, ast.Assign):
+                walk(child.value, guarded, cur_owner, cur_setup)
+                for t in child.targets:
+                    _target_access(t, guarded, cur_owner, cur_setup)
+                    walk(t, guarded, cur_owner, cur_setup)
+                continue
+            if isinstance(child, ast.AugAssign):
+                walk(child.value, guarded, cur_owner, cur_setup)
+                _target_access(child.target, guarded, cur_owner, cur_setup)
+                continue
+            if (isinstance(child, ast.Attribute)
+                    and isinstance(child.value, ast.Name)
+                    and child.value.id == "self"
+                    and isinstance(child.ctx, ast.Load)):
+                _record(child.attr, False, guarded, child.lineno, cur_owner,
+                        cur_setup)
+                continue
+            walk(child, guarded, cur_owner, cur_setup)
+
+    def _target_access(t: ast.expr, guarded: bool, cur_owner: str,
+                       cur_setup: bool) -> None:
+        # self.X = ... / self.X[...] = ...
+        base = t
+        if isinstance(base, ast.Subscript):
+            base = base.value
+        if (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"):
+            _record(base.attr, True, guarded, t.lineno, cur_owner, cur_setup)
+
+    def _record(attr: str, write: bool, guarded: bool, line: int,
+                cur_owner: str, cur_setup: bool) -> None:
+        if cur_setup:
+            return  # __init__ body runs before any thread exists
+        accesses.append(_Access(attr, write, guarded, line, cur_owner))
+
+    walk(func_node, guarded=False, cur_owner=owner, cur_setup=setup)
+
+
+# ---------------------------------------------------------------------------
+# Sub-rule 2: module-level namespace read-modify-write
+# ---------------------------------------------------------------------------
+
+
+def _check_module_state_rmw(mod) -> List[Finding]:
+    findings: List[Finding] = []
+    class_names = set(mod.classes)
+    for fi in mod.funcs_by_node.values():
+        reads: Dict[Tuple[str, str], int] = {}
+        writes: Dict[Tuple[str, str], Tuple[int, bool]] = {}
+        guarded_stack: List[bool] = [False]
+
+        def walk(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fi.node:
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                g = guarded_stack[-1] or any(
+                    _is_lock_expr(_dotted(i.context_expr), set())
+                    for i in node.items
+                )
+                for i in node.items:
+                    walk(i.context_expr)
+                guarded_stack.append(g)
+                for stmt in node.body:
+                    walk(stmt)
+                guarded_stack.pop()
+                return
+            if isinstance(node, ast.Assign):
+                walk(node.value)
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id in class_names):
+                        key = (t.value.id, t.attr)
+                        if key not in writes:
+                            writes[key] = (t.lineno, guarded_stack[-1])
+                    else:
+                        walk(t)
+                return
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in class_names
+                    and isinstance(node.ctx, ast.Load)):
+                key = (node.value.id, node.attr)
+                if key not in reads:
+                    reads[key] = node.lineno
+                return
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+
+        for stmt in (fi.node.body if not isinstance(fi.node, ast.Lambda)
+                     else [fi.node.body]):
+            walk(stmt)
+        for key, (wline, wguard) in sorted(writes.items()):
+            rline = reads.get(key)
+            if rline is not None and rline < wline and not wguard:
+                cls, attr = key
+                findings.append(_mk(
+                    mod, wline,
+                    f"unguarded read-modify-write of module state "
+                    f"`{cls}.{attr}` in `{fi.qualname}` (read line {rline}, "
+                    f"write line {wline}) — racy when rounds run on a comm "
+                    "thread; hold a module lock around the check-and-set",
+                ))
+    return findings

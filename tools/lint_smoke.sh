@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# CI smoke for the graftlint static-analysis gate: the shipped tree must have
+# ZERO non-baselined findings (tools/graftlint/baseline.json holds the
+# suppressed-but-visible pre-existing debt), and the JSON output must parse.
+#
+# This is the cheap half of the tier-1 lint gate (tests/test_graftlint.py is
+# the full one): pure-AST, no jax import, sub-second.
+#
+# Usage: tools/lint_smoke.sh          (CI: exits non-zero on any regression)
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+out=$(timeout -k 10 120 python -m tools.graftlint fedml_tpu/ --format json)
+rc=$?
+
+if [ "$rc" -ne 0 ]; then
+    echo "lint_smoke: FAIL — graftlint exited rc=$rc" >&2
+    printf '%s\n' "$out" >&2
+    exit 1
+fi
+
+python - "$out" <<'EOF'
+import json
+import sys
+
+payload = json.loads(sys.argv[1])
+assert payload["exit_code"] == 0, payload
+assert payload["findings"] == [], payload["findings"]
+print(f"lint_smoke: OK — 0 findings ({payload['baselined']} baselined)")
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "lint_smoke: FAIL — JSON output did not validate" >&2
+    exit 1
+fi
+
+# the gate must actually bite: a known-bad fixture has to exit non-zero
+if python -m tools.graftlint tests/fixtures/graftlint/g001_bad.py \
+        --no-baseline >/dev/null 2>&1; then
+    echo "lint_smoke: FAIL — analyzer passed a known-bad fixture" >&2
+    exit 1
+fi
+
+echo "lint_smoke: PASS"
