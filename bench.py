@@ -313,7 +313,46 @@ def bench_fedavg() -> dict:
     counters = telemetry.registry().snapshot()["counters"]
     mlops.close()  # emits the telemetry summary + forces the metrics file
 
+    # optional resume-overhead probe (BENCH_RESUME=1; on by default in the
+    # smoke config): train a short checkpointed run, then measure the time
+    # from "process restart" (fresh engine construction) to the first
+    # post-resume round DISPATCH — the number that makes checkpoint-cadence
+    # tuning data-driven (core/runstate.py resume path)
+    resume_overhead_s = None
+    want_resume = os.environ.get(
+        "BENCH_RESUME", "1" if os.environ.get("BENCH_SMOKE") else "0"
+    ) == "1"
+    if want_resume:
+        from fedml_tpu.checkpoint import CheckpointManager
+
+        import shutil
+
+        ckpt_dir = tempfile.mkdtemp(prefix="fedml_bench_resume_")
+        try:
+            # preempt_signals=False: the probe must not install the
+            # process-wide SIGTERM/SIGINT latch — the operator's Ctrl-C has
+            # to keep killing the remaining bench legs
+            args_r = Arguments(overrides=dict(
+                overrides, checkpoint_dir=ckpt_dir, checkpoint_rounds=1,
+                comm_round=2, superround_k=0, preempt_signals=False,
+            ))
+            args_r.compilation_cache_dir = args.compilation_cache_dir
+            args_r = fedml.init(args_r, should_init_logs=False)
+            FedAvgAPI(args_r, fedml.get_device(args_r), ds, bundle).train()
+            t0 = time.perf_counter()
+            api_r = FedAvgAPI(args_r, fedml.get_device(args_r), ds, bundle)
+            ckpt_r = CheckpointManager(ckpt_dir)
+            start = api_r._maybe_resume(ckpt_r)
+            args_r.round_idx = start
+            api_r.run_round(start)  # returns at dispatch, not at ready
+            resume_overhead_s = time.perf_counter() - t0
+            ckpt_r.close()
+        finally:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+
     return {
+        **({"fedavg_resume_overhead_s": round(resume_overhead_s, 4)}
+           if resume_overhead_s is not None else {}),
         "rounds_per_sec": n_rounds / dt,
         "fedavg_compile_s": round(compile_s, 3),
         "fedavg_round_fused": api._round_step is not None,
@@ -529,7 +568,8 @@ def _translate_fedavg(parsed: dict):
         for k in ("fedavg_compile_s", "fedavg_round_fused",
                   "fedavg_superround_k", "fedavg_phases",
                   "fedavg_phase_rounds", "fedavg_tracked_wall_s",
-                  "fedavg_compile_cache_hits", "fedavg_compile_cache_misses")
+                  "fedavg_compile_cache_hits", "fedavg_compile_cache_misses",
+                  "fedavg_resume_overhead_s")
         if k in parsed
     }
     if platform != "tpu":

@@ -112,7 +112,24 @@ _SCHEMA: Dict[str, tuple] = {
     # checkpointing (absent in reference — SURVEY.md §5 "Checkpoint / resume")
     "checkpoint_dir": (str, ""),
     "checkpoint_every_rounds": (int, 0),
-    "resume": (bool, False),
+    # crash-safe rounds (core/runstate.py): checkpoint_rounds is the
+    # preferred cadence knob (checkpoint_every_rounds kept as an alias);
+    # resume ∈ auto|never|require decides what an existing checkpoint dir
+    # means at startup; preempt_signals installs the SIGTERM/SIGINT
+    # drain-and-commit handler whenever checkpointing is on
+    "checkpoint_rounds": (int, 0),
+    "resume": (str, "auto"),
+    "preempt_signals": (bool, True),
+    # idempotent at-least-once delivery (core/distributed/delivery.py):
+    # sender-side retry budget (exponential backoff + jitter) and the
+    # receiver-side dedup window (per-sender seqs remembered)
+    "comm_retry_max_attempts": (int, 4),
+    "comm_retry_backoff_s": (float, 0.05),
+    "comm_retry_backoff_max_s": (float, 2.0),
+    "comm_dedup_window": (int, 4096),
+    # MQTT subscribe-confirmation retry budget (mqtt_backend.py)
+    "mqtt_subscribe_retries": (int, 5),
+    "mqtt_subscribe_timeout_s": (float, 6.0),
     # round engine (simulation/round_engine.py)
     # round_fusion: auto fuses the FedAvg-family round into ONE donated XLA
     # program whenever no host-side hook blocks it; on demands it; off keeps
@@ -289,6 +306,22 @@ def add_args() -> argparse.Namespace:
         "--compilation_cache_dir", type=str, default=None,
         help="persistent XLA compilation cache dir (repeat runs skip the "
         "compile wall); also settable via YAML common_args",
+    )
+    # crash-safe rounds (core/runstate.py)
+    parser.add_argument(
+        "--checkpoint_dir", type=str, default=None,
+        help="Orbax checkpoint + run-ledger dir; enables round resume and "
+        "the SIGTERM/SIGINT drain-and-commit handler",
+    )
+    parser.add_argument(
+        "--checkpoint_rounds", type=int, default=None, metavar="N",
+        help="commit a checkpoint + ledger entry every N rounds",
+    )
+    parser.add_argument(
+        "--resume", type=str, default=None,
+        choices=("auto", "never", "require"),
+        help="what an existing checkpoint means at startup: auto resumes "
+        "when present, never demands a fresh dir, require errors without one",
     )
     # telemetry plane (defaults None so YAML keys win when the flag is absent)
     parser.add_argument(

@@ -1,0 +1,313 @@
+"""Chaos soak harness: prove the crash-recovery plane end-to-end.
+
+reference: none — the reference has no recovery soak of any kind (SURVEY.md
+§5). This harness runs the SAME loopback cross-silo federation twice:
+
+1. **reference leg** — fault-free, in-process, to completion;
+2. **chaos leg** — a subprocess under a seeded fault matrix (visible loss +
+   wire duplication + payload corruption on every client link) that
+   SIGTERMs ITSELF after the run ledger commits round ``kill_round``, then
+   a second subprocess restarts it with ``--resume auto``;
+
+and asserts the recovered run's final global params are **bitwise equal**
+to the fault-free run's, that no client contribution was ever counted
+twice (per-round contribution counters from the durable ledger), and that
+the combined ledger stream covers every round exactly like the reference
+run's. That is the "kill -9 anywhere, restart, converge to the same
+params" invariant as an executable check — ``fedml_tpu chaos`` from the
+CLI, ``tools/chaos_smoke.sh`` in CI.
+
+Why this catches real bugs: visible loss exercises the at-least-once retry
+budget, duplication exercises the receiver dedup window, corruption
+exercises the payload checksum + NACK re-send, and the mid-run SIGTERM +
+restart exercises the preemption drain, the Orbax round checkpoint, and
+ledger-driven resume — all composed, all seeded, all reproducible.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+FINAL_PARAMS_FILE = "final_params.npz"
+REPORT_FILE = "chaos_report.json"
+
+
+def _world_overrides(a) -> Dict:
+    return dict(
+        training_type="cross_silo", dataset="synthetic", model="lr",
+        client_num_in_total=int(a.clients), client_num_per_round=int(a.clients),
+        comm_round=int(a.rounds), epochs=int(a.epochs), batch_size=8,
+        learning_rate=0.2, backend="LOOPBACK", frequency_of_the_test=1000,
+        random_seed=int(a.seed),
+    )
+
+
+def build_fault_plan(rank: int, seed: int, loss: float, duplicate: float,
+                     corrupt: float):
+    """Seeded per-client fault matrix. Loss is VISIBLE (the sender sees the
+    failure and retries — the at-least-once contract under test); rank
+    decorrelates the client streams while keeping each reproducible."""
+    from .core.distributed.faults import FaultPlan
+
+    plan = FaultPlan()
+    if loss > 0:
+        plan.loss(loss, seed=seed * 1000 + rank, visible=True)
+    if duplicate > 0:
+        plan.duplicate(p=duplicate, seed=seed * 2000 + rank)
+    if corrupt > 0:
+        plan.corrupt(p=corrupt, seed=seed * 3000 + rank)
+    return plan
+
+
+def run_world(a, run_id: str, checkpoint_dir: str, faulty: bool,
+              kill_round: int = -1) -> Dict:
+    """One loopback cross-silo federation (server + clients as threads).
+
+    Returns {"params": leaves, "server": manager, "preempted": bool}. With
+    ``kill_round >= 0`` a watcher thread SIGTERMs THIS process as soon as
+    the run ledger commits that round — the real preemption path, timed
+    deterministically off the durable commit rather than a sleep.
+    """
+    import fedml_tpu as fedml
+    from fedml_tpu import data as data_mod
+    from fedml_tpu import models as model_mod
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core import runstate
+    from fedml_tpu.cross_silo import FedMLCrossSiloClient, FedMLCrossSiloServer
+
+    def mk(role, rank=0):
+        overrides = dict(
+            _world_overrides(a), role=role, rank=rank, run_id=run_id,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_rounds=int(a.checkpoint_rounds),
+        )
+        return fedml.init(Arguments(overrides=overrides),
+                          should_init_logs=False)
+
+    args_s = mk("server")
+    ds, od = data_mod.load(args_s)
+    bundle = model_mod.create(args_s, od)
+    server = FedMLCrossSiloServer(args_s, None, ds, bundle)
+
+    clients = []
+    for rank in range(1, int(a.clients) + 1):
+        args_c = mk("client", rank)
+        if faulty:
+            args_c.fault_plan = build_fault_plan(
+                rank, int(a.seed), float(a.loss), float(a.duplicate),
+                float(a.corrupt),
+            )
+        clients.append(FedMLCrossSiloClient(args_c, None, ds, bundle))
+
+    if kill_round >= 0:
+        ledger = runstate.RunLedger.for_checkpoint_dir(checkpoint_dir)
+        stop_watch = threading.Event()
+
+        def watch():
+            while not stop_watch.is_set():
+                last = ledger.last_round()
+                if last is not None and last >= kill_round:
+                    logger.warning(
+                        "chaos: round %d committed — SIGTERM self", last
+                    )
+                    os.kill(os.getpid(), signal.SIGTERM)
+                    return
+                time.sleep(0.02)
+
+        threading.Thread(target=watch, daemon=True).start()
+
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    try:
+        server.run()
+    except runstate.PreemptionError:
+        pass  # expected under kill_round; reported via the preempted flag
+    if kill_round >= 0:
+        stop_watch.set()
+    import jax
+
+    leaves = [np.asarray(l)
+              for l in jax.tree.leaves(server.manager.global_params)]
+    return {
+        "params": leaves,
+        "server": server.manager,
+        "preempted": bool(server.manager.preempted),
+    }
+
+
+# ---------------------------------------------------------------------------
+# worker entry (the subprocess the orchestrator spawns)
+# ---------------------------------------------------------------------------
+
+
+def run_worker(a) -> int:
+    """One chaos leg in THIS process: run the faulty world, write the final
+    params + report into --out, exit EXIT_PREEMPTED if preempted."""
+    from fedml_tpu.core.runstate import EXIT_PREEMPTED
+
+    os.makedirs(a.out, exist_ok=True)
+    result = run_world(
+        a, run_id=f"chaos-{os.getpid()}", checkpoint_dir=a.checkpoint_dir,
+        faulty=True, kill_round=int(a.kill_round),
+    )
+    report = {
+        "preempted": result["preempted"],
+        "round_idx": int(result["server"].round_idx),
+        "contrib_counts": {
+            str(r): {str(k): v for k, v in per.items()}
+            for r, per in result["server"].contrib_counts.items()
+        },
+    }
+    with open(os.path.join(a.out, REPORT_FILE), "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+    if not result["preempted"]:
+        np.savez(os.path.join(a.out, FINAL_PARAMS_FILE), *result["params"])
+    return EXIT_PREEMPTED if result["preempted"] else 0
+
+
+# ---------------------------------------------------------------------------
+# orchestrator
+# ---------------------------------------------------------------------------
+
+
+def _worker_cmd(a, out: str, ckpt_dir: str, kill_round: int) -> List[str]:
+    return [
+        sys.executable, "-m", "fedml_tpu.cli", "chaos", "--worker",
+        "--out", out, "--checkpoint_dir", ckpt_dir,
+        "--clients", str(a.clients), "--rounds", str(a.rounds),
+        "--epochs", str(a.epochs), "--seed", str(a.seed),
+        "--loss", str(a.loss), "--duplicate", str(a.duplicate),
+        "--corrupt", str(a.corrupt),
+        "--checkpoint_rounds", str(a.checkpoint_rounds),
+        "--kill-round", str(kill_round),
+    ]
+
+
+def _run_leg(cmd: List[str], timeout_s: float) -> int:
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
+        "JAX_PLATFORMS", "cpu"))
+    proc = subprocess.run(
+        cmd, timeout=timeout_s, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    if proc.stdout:
+        sys.stderr.write(proc.stdout.decode(errors="replace")[-4000:])
+    return proc.returncode
+
+
+def orchestrate(a) -> int:
+    """Reference leg (in-process, fault-free) vs chaos leg (subprocess,
+    faults + self-SIGTERM + resumed subprocess); verify bitwise parity and
+    exactly-once contribution counting. Returns a process exit code."""
+    from fedml_tpu.core.runstate import EXIT_PREEMPTED, RunLedger
+
+    workdir = a.workdir or tempfile.mkdtemp(prefix="fedml_chaos_")
+    os.makedirs(workdir, exist_ok=True)
+    ref_ckpt = os.path.join(workdir, "ref_ckpt")
+    chaos_ckpt = os.path.join(workdir, "chaos_ckpt")
+    chaos_out = os.path.join(workdir, "chaos_out")
+
+    logger.info("chaos: reference (fault-free) leg …")
+    ref = run_world(a, run_id=f"chaos-ref-{os.getpid()}-{time.time_ns()}",
+                    checkpoint_dir=ref_ckpt, faulty=False)
+    ref_params = ref["params"]
+
+    kill_round = int(a.kill_round)
+    logger.info("chaos: faulty leg (loss=%.2f dup=%.2f corrupt=%.2f, "
+                "self-SIGTERM after round %d) …", a.loss, a.duplicate,
+                a.corrupt, kill_round)
+    rc1 = _run_leg(_worker_cmd(a, chaos_out, chaos_ckpt, kill_round),
+                   float(a.timeout))
+    killed = rc1 == EXIT_PREEMPTED
+    if not killed and rc1 != 0:
+        print(json.dumps({"ok": False,
+                          "error": f"chaos leg failed rc={rc1}"}))
+        return 1
+    if kill_round >= 0 and not killed:
+        # the federation outran the watcher — still verify parity, but
+        # report that preemption wasn't exercised so CI can tighten knobs
+        logger.warning("chaos: run completed before the SIGTERM landed")
+
+    if killed:
+        logger.info("chaos: preempted as planned (rc=%d) — restarting "
+                    "with --resume auto …", rc1)
+        rc2 = _run_leg(_worker_cmd(a, chaos_out, chaos_ckpt, -1),
+                       float(a.timeout))
+        if rc2 != 0:
+            print(json.dumps({"ok": False,
+                              "error": f"resume leg failed rc={rc2}"}))
+            return 1
+
+    with np.load(os.path.join(chaos_out, FINAL_PARAMS_FILE)) as z:
+        chaos_params = [z[k] for k in z.files]
+
+    # -- verdicts -----------------------------------------------------------
+    problems: List[str] = []
+    if len(chaos_params) != len(ref_params):
+        problems.append("param tree arity mismatch")
+    else:
+        for i, (x, y) in enumerate(zip(ref_params, chaos_params)):
+            if x.dtype != y.dtype or x.shape != y.shape \
+                    or not np.array_equal(x, y):
+                problems.append(f"params leaf {i} not bitwise equal")
+
+    ledger = RunLedger.for_checkpoint_dir(chaos_ckpt)
+    rounds_seen: Dict[int, Dict] = {}
+    double_counted: List[str] = []
+    for e in ledger.rounds():
+        rounds_seen[int(e["round"])] = e
+        for client, count in (e.get("contrib") or {}).items():
+            if int(count) > 1:
+                double_counted.append(
+                    f"round {e['round']} client {client} counted {count}x"
+                )
+    if double_counted:
+        problems.append("double-counted contributions: "
+                        + "; ".join(double_counted))
+    expect_rounds = set(range(int(a.rounds)))
+    missing = expect_rounds - set(rounds_seen)
+    if missing:
+        problems.append(f"ledger missing committed rounds: {sorted(missing)}")
+    full_cohort = list(range(1, int(a.clients) + 1))
+    bad_cohorts = [r for r, e in sorted(rounds_seen.items())
+                   if sorted(e.get("cohort") or []) != full_cohort]
+    if bad_cohorts:
+        problems.append(f"rounds aggregated a partial cohort: {bad_cohorts}")
+
+    verdict = {
+        "ok": not problems,
+        "parity": not any("leaf" in p or "arity" in p for p in problems),
+        "preemption_exercised": killed,
+        "rounds": int(a.rounds),
+        "clients": int(a.clients),
+        "fault_matrix": {"loss": float(a.loss),
+                         "duplicate": float(a.duplicate),
+                         "corrupt": float(a.corrupt),
+                         "seed": int(a.seed)},
+        "problems": problems,
+        "workdir": workdir,
+    }
+    print(json.dumps(verdict, indent=2, sort_keys=True))
+    return 0 if verdict["ok"] else 1
+
+
+def main(a) -> int:
+    if a.worker:
+        return run_worker(a)
+    return orchestrate(a)

@@ -349,6 +349,21 @@ def cmd_lint(args) -> int:
     return subprocess.call(cmd, cwd=repo_root)
 
 
+def cmd_chaos(args) -> int:
+    """Chaos soak harness (fedml_tpu/chaos.py): run a loopback cross-silo
+    federation under a seeded fault matrix (visible loss + duplication +
+    payload corruption + mid-run self-SIGTERM), restart it with
+    ``--resume auto``, and verify the recovered run's final global params
+    are bitwise-equal to a fault-free reference run with no contribution
+    counted twice. CI entry: ``tools/chaos_smoke.sh``."""
+    import logging as _logging
+
+    from .chaos import main as chaos_main
+
+    _logging.basicConfig(level=_logging.INFO)
+    return chaos_main(args)
+
+
 def cmd_multihost(args) -> int:
     """Spawn N coordinated worker processes (analog: mpirun -np N).
 
@@ -449,6 +464,36 @@ def main(argv=None) -> int:
     p_lint.add_argument("--runtime", action="store_true",
                         help="also trace the round engine under jax.make_jaxpr")
 
+    p_chaos = sub.add_parser(
+        "chaos",
+        help="chaos soak: faults + kill/restart must reproduce the "
+        "fault-free run bitwise",
+    )
+    p_chaos.add_argument("--clients", type=int, default=2)
+    p_chaos.add_argument("--rounds", type=int, default=4)
+    p_chaos.add_argument("--epochs", type=int, default=1)
+    p_chaos.add_argument("--seed", type=int, default=7)
+    p_chaos.add_argument("--loss", type=float, default=0.1,
+                         help="visible (retryable) per-message loss prob")
+    p_chaos.add_argument("--duplicate", type=float, default=0.2,
+                         help="wire-duplication probability")
+    p_chaos.add_argument("--corrupt", type=float, default=0.2,
+                         help="payload-corruption probability")
+    p_chaos.add_argument("--kill-round", type=int, default=1, metavar="R",
+                         help="self-SIGTERM once the ledger commits round R "
+                         "(-1 disables the kill)")
+    p_chaos.add_argument("--checkpoint_rounds", type=int, default=1)
+    p_chaos.add_argument("--workdir", default="",
+                         help="scratch dir (default: a fresh temp dir)")
+    p_chaos.add_argument("--timeout", type=float, default=240.0,
+                         help="per-leg subprocess timeout (seconds)")
+    # internal: run ONE chaos leg in this process (the orchestrator's child)
+    p_chaos.add_argument("--worker", action="store_true",
+                         help=argparse.SUPPRESS)
+    p_chaos.add_argument("--out", default="", help=argparse.SUPPRESS)
+    p_chaos.add_argument("--checkpoint_dir", default="",
+                         help=argparse.SUPPRESS)
+
     p_mh = sub.add_parser(
         "multihost", help="spawn N coordinated worker processes",
         usage="%(prog)s [-np N] [--local_devices D] script [script_args ...]",
@@ -475,6 +520,7 @@ def main(argv=None) -> int:
         "agent": cmd_agent,
         "cache": cmd_cache,
         "lint": cmd_lint,
+        "chaos": cmd_chaos,
         "multihost": cmd_multihost,
     }
     if args.command is None:

@@ -28,6 +28,7 @@ MessageHandler = Callable[[Message], None]
 class FedMLCommManager(Observer):
     def __init__(self, args, comm=None, rank: int = 0, size: int = 0,
                  backend: str = constants.COMM_BACKEND_LOOPBACK):
+        from .delivery import DedupWindow, RetryPolicy, SenderStamp
         from .payload_store import store_from_args
 
         self.args = args
@@ -42,6 +43,16 @@ class FedMLCommManager(Observer):
         self.payload_store = store_from_args(args)
         self.payload_inline_limit = int(
             getattr(args, "payload_inline_limit_bytes", 1 * 1024 * 1024)
+        )
+        # idempotent at-least-once delivery (delivery.py): every outbound
+        # message is stamped (sender epoch + monotonic seq) ONCE, so a
+        # retried send is a recognizable wire duplicate; inbound duplicates
+        # and stale-epoch stragglers are dropped before any FSM handler
+        # (a retried C2S_SEND_MODEL must never double-count a client)
+        self._stamp = SenderStamp()
+        self._retry_policy = RetryPolicy.from_args(args)
+        self._dedup = DedupWindow(
+            window=int(getattr(args, "comm_dedup_window", 4096))
         )
         if self.com_manager is None:
             self._init_manager()
@@ -78,8 +89,14 @@ class FedMLCommManager(Observer):
 
     def send_message(self, message: Message) -> None:
         from ..mlops import telemetry
+        from .delivery import TransientSendError, arrays_digest
         from .payload_store import PAYLOAD_REF_KEY
 
+        # stamp ONCE per logical message (idempotent across retries and
+        # across callers that re-send the same Message object)
+        if message.get(Message.MSG_ARG_KEY_SEQ) is None:
+            message.add(Message.MSG_ARG_KEY_SEQ, self._stamp.next_seq())
+            message.add(Message.MSG_ARG_KEY_EPOCH, self._stamp.epoch)
         if (
             self.payload_store is not None
             and message.arrays
@@ -92,16 +109,36 @@ class FedMLCommManager(Observer):
                 "comm.payload_offload_bytes",
                 sum(a.nbytes for a in message.arrays),
             )
+            # digest of the arrays BEFORE they leave the message: the
+            # receiver re-verifies after the store fetch (and re-fetches
+            # once on mismatch — a torn blob read must not reach the FSM)
+            message.add(Message.MSG_ARG_KEY_PAYLOAD_SHA256,
+                        arrays_digest(message.arrays))
             key = self.payload_store.put_dedup(message.arrays)
             message.add(PAYLOAD_REF_KEY, key)
             message.set_arrays([])
             self.payload_store.sweep(
                 float(getattr(self.args, "payload_ttl_seconds", 3600.0))
             )
-        self.com_manager.send_message(message)
+        try:
+            self._retry_policy.call(
+                lambda: self.com_manager.send_message(message),
+                is_transient=lambda e: isinstance(e, TransientSendError),
+                on_retry=lambda attempt, e: (
+                    telemetry.counter_inc("comm.send_retries"),
+                    logger.info(
+                        "rank %d: transient send failure for %r (%s) — "
+                        "retry %d", self.rank, message.get_type(), e, attempt,
+                    ),
+                ),
+            )
+        except Exception:
+            telemetry.counter_inc("comm.send_failures")
+            raise
 
     def receive_message(self, msg_type: str, msg: Message) -> None:
         from ..mlops import telemetry
+        from .delivery import PayloadCorruptError
         from .payload_store import PAYLOAD_REF_KEY
 
         ref = msg.get(PAYLOAD_REF_KEY)
@@ -118,8 +155,10 @@ class FedMLCommManager(Observer):
                 return
             try:
                 # blobs are content-addressed and shared across recipients —
-                # never consumed on read; the sender's TTL sweep reclaims them
-                msg.set_arrays(self.payload_store.get(str(ref)))
+                # never consumed on read; the sender's TTL sweep reclaims
+                # them. A fetch whose digest mismatches the header (torn
+                # read, corrupted blob) is re-fetched once, then dropped.
+                msg.set_arrays(self._fetch_verified(str(ref), msg))
             except OSError as e:
                 logger.error(
                     "rank %d: payload blob %r for %r is gone (%s) — likely "
@@ -127,11 +166,66 @@ class FedMLCommManager(Observer):
                     "Dropping message.", self.rank, ref, msg_type, e,
                 )
                 return
+            except PayloadCorruptError as e:
+                telemetry.counter_inc("comm.corrupt_payloads")
+                logger.error(
+                    "rank %d: payload blob %r for %r failed its checksum "
+                    "after re-fetch (%s) — dropping message",
+                    self.rank, ref, msg_type, e,
+                )
+                return
+        # at-most-once: drop wire duplicates (sender retries, injected
+        # duplication) and stale-epoch stragglers before the handler runs.
+        # Recorded only AFTER the payload fetch succeeded — a message
+        # dropped for a missing/corrupt blob must NOT consume its seq, or
+        # the sender's re-delivery of the same logical message would be
+        # misclassified as a duplicate and the contribution lost for good
+        seq = msg.get(Message.MSG_ARG_KEY_SEQ)
+        if seq is not None:
+            verdict = self._dedup.accept(
+                msg.get_sender_id(), int(msg.get(
+                    Message.MSG_ARG_KEY_EPOCH, 0)), int(seq),
+            )
+            if verdict == "duplicate":
+                telemetry.counter_inc("comm.dedup_drops")
+                logger.info(
+                    "rank %d: duplicate %r from %d (seq %s) dropped",
+                    self.rank, msg_type, msg.get_sender_id(), seq,
+                )
+                return
+            if verdict == "stale_epoch":
+                telemetry.counter_inc("comm.stale_epoch_drops")
+                logger.info(
+                    "rank %d: stale-epoch %r from %d dropped (sender "
+                    "restarted)", self.rank, msg_type, msg.get_sender_id(),
+                )
+                return
         handler = self.message_handler_dict.get(str(msg_type))
         if handler is None:
             logger.debug("rank %d: no handler for %r", self.rank, msg_type)
             return
         handler(msg)
+
+    def _fetch_verified(self, ref: str, msg: Message):
+        """Payload-store fetch with integrity verification + one re-fetch."""
+        from ..mlops import telemetry
+        from .delivery import PayloadCorruptError, arrays_digest
+
+        want = msg.get(Message.MSG_ARG_KEY_PAYLOAD_SHA256)
+        for attempt in range(2):
+            arrays = self.payload_store.get(ref)
+            if want is None or arrays_digest(arrays) == want:
+                return arrays
+            if attempt == 0:
+                telemetry.counter_inc("comm.payload_refetches")
+                logger.warning(
+                    "rank %d: payload blob %r failed checksum — "
+                    "re-fetching once", self.rank, ref,
+                )
+        raise PayloadCorruptError(
+            f"payload blob {ref!r} digest mismatch after re-fetch "
+            f"(expected {str(want)[:12]}…)"
+        )
 
     def finish(self) -> None:
         """Stop the loop (reference :57-60 calls MPI Abort; we just stop)."""
@@ -168,6 +262,7 @@ class FedMLCommManager(Observer):
                 stream_threshold_bytes=int(getattr(
                     self.args, "grpc_stream_threshold_bytes", 8 * 1024 * 1024
                 )),
+                retry_policy=self._retry_policy,
             )
         elif self.backend == constants.COMM_BACKEND_MQTT:
             from .mqtt_backend import MqttCommManager
@@ -178,6 +273,12 @@ class FedMLCommManager(Observer):
                 rank=self.rank,
                 world_size=self.size,
                 run_id=str(getattr(self.args, "run_id", "0")),
+                subscribe_retries=int(
+                    getattr(self.args, "mqtt_subscribe_retries", 5)
+                ),
+                subscribe_timeout_s=float(
+                    getattr(self.args, "mqtt_subscribe_timeout_s", 6.0)
+                ),
             )
         else:
             raise ValueError(

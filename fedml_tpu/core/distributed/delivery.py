@@ -1,0 +1,213 @@
+"""Idempotent at-least-once delivery primitives for the message plane.
+
+reference: none — the reference's transports are fire-and-forget (its only
+retry is gRPC's implicit reconnect; a duplicated or replayed
+``MSG_TYPE_C2S_SEND_MODEL`` double-counts a client in the aggregator).
+Production FL needs *effectively-once* message handling built from two
+halves:
+
+- **at-least-once** (sender): every logical message carries a per-sender
+  monotonic sequence number and a sender epoch (regenerated at process
+  start, strictly increasing across restarts); transient send failures are
+  retried under :class:`RetryPolicy` (exponential backoff + jitter, bounded
+  budget). Retries re-send the SAME sequence number — that is what makes
+  them recognizable as duplicates.
+- **at-most-once** (receiver): :class:`DedupWindow` drops wire duplicates
+  (same sender/epoch/seq), messages from a superseded sender epoch (a
+  restarted sender never re-uses its predecessor's numbering), and —
+  together with the payload checksum in :mod:`message` — corrupt payloads.
+
+Transports raise :class:`TransientSendError` for failures worth retrying;
+anything else propagates (the cross-silo server's ``_send_or_mark_dead``
+keeps handling hard-dead peers). All recovery events are telemetry
+counters: ``comm.send_retries``, ``comm.send_failures``,
+``comm.dedup_drops``, ``comm.stale_epoch_drops``, ``comm.corrupt_payloads``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, Optional, Set, Tuple
+
+import numpy as np
+
+
+def safe_deserialize(data: bytes, transport: str = "comm"):
+    """Decode wire bytes defensively: a frame that fails to parse or whose
+    payload checksum mismatches is counted (``comm.corrupt_payloads``) and
+    dropped (returns None) instead of killing the receive loop. The
+    at-least-once sender re-delivers a clean copy."""
+    import logging
+
+    from ..mlops import telemetry
+    from .message import Message
+
+    try:
+        return Message.deserialize(data)
+    except Exception as e:  # noqa: BLE001 — any decode failure is a drop
+        telemetry.counter_inc("comm.corrupt_payloads")
+        logging.getLogger(__name__).warning(
+            "%s: corrupt frame (%d bytes) dropped: %s", transport,
+            len(data), e,
+        )
+        return None
+
+
+class TransientSendError(ConnectionError):
+    """A send failure the at-least-once layer should retry (peer briefly
+    unreachable, injected fault, broker blip). Non-transient errors keep
+    their own types and propagate."""
+
+
+class PayloadCorruptError(ValueError):
+    """Deserialized payload failed its integrity checksum."""
+
+
+# ---------------------------------------------------------------------------
+# payload digests
+# ---------------------------------------------------------------------------
+
+
+def arrays_digest(arrays) -> str:
+    """Canonical sha256 over an array list: dtype + shape + C-order bytes
+    per array. Wire-format independent — the same digest verifies an inline
+    npz body, a raw tensor frame, and a payload-store blob."""
+    h = hashlib.sha256()
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        h.update(str(a.dtype.str).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# sender side: retry with backoff + jitter
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RetryPolicy:
+    """Bounded exponential backoff with decorrelated jitter.
+
+    ``max_attempts`` counts RE-sends (0 disables retrying); the first send
+    is always made. Backoff for attempt k (1-based) is
+    ``min(base * 2**(k-1), max_s)`` scaled by a uniform jitter in
+    ``[1 - jitter, 1]`` so synchronized clients don't retry in lockstep.
+    """
+
+    max_attempts: int = 4
+    base_s: float = 0.05
+    max_s: float = 2.0
+    jitter: float = 0.5
+
+    @classmethod
+    def from_args(cls, args) -> "RetryPolicy":
+        return cls(
+            max_attempts=int(getattr(args, "comm_retry_max_attempts", 4)),
+            base_s=float(getattr(args, "comm_retry_backoff_s", 0.05)),
+            max_s=float(getattr(args, "comm_retry_backoff_max_s", 2.0)),
+        )
+
+    def backoff_s(self, attempt: int, rng: Optional[random.Random] = None) \
+            -> float:
+        expo = min(self.base_s * (2.0 ** max(attempt - 1, 0)), self.max_s)
+        r = (rng or random).uniform(1.0 - self.jitter, 1.0)
+        return expo * r
+
+    def call(self, fn, *, is_transient, on_retry=None):
+        """Run ``fn`` with the policy. ``is_transient(exc) -> bool`` decides
+        retryability; ``on_retry(attempt, exc)`` observes each re-send."""
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception as e:  # noqa: BLE001 — classifier decides
+                if not is_transient(e) or attempt >= self.max_attempts:
+                    raise
+                attempt += 1
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                time.sleep(self.backoff_s(attempt))
+
+
+# ---------------------------------------------------------------------------
+# receiver side: dedup window
+# ---------------------------------------------------------------------------
+
+
+class DedupWindow:
+    """Per-sender (epoch, seq) dedup with a bounded memory window.
+
+    ``accept(sender, epoch, seq)`` returns the verdict:
+
+    - ``"accept"`` — first sighting; the seq is recorded.
+    - ``"duplicate"`` — same epoch, already-seen seq (a retry or an
+      injected duplication) — the handler must NOT run.
+    - ``"stale_epoch"`` — the sender has since restarted with a newer
+      epoch; its previous life's stragglers are dropped.
+
+    A NEWER epoch resets the sender's window (a restarted sender starts
+    its numbering over). The window keeps the last ``window`` seqs per
+    sender; seqs older than the window floor are treated as duplicates —
+    with monotonic senders a seq that far behind can only be a replay.
+    Thread-safe: delayed-delivery timers and multi-threaded transports may
+    deliver concurrently with the receive loop.
+    """
+
+    def __init__(self, window: int = 4096):
+        self.window = max(int(window), 1)
+        self._lock = threading.Lock()
+        # sender -> (epoch, seen-set, fifo of seqs, floor)
+        self._senders: Dict[int, Tuple[int, Set[int], Deque[int]]] = {}
+
+    def accept(self, sender: int, epoch: int, seq: int) -> str:
+        sender, epoch, seq = int(sender), int(epoch), int(seq)
+        with self._lock:
+            cur = self._senders.get(sender)
+            if cur is None or epoch > cur[0]:
+                seen: Set[int] = {seq}
+                fifo: Deque[int] = deque([seq])
+                self._senders[sender] = (epoch, seen, fifo)
+                return "accept"
+            cur_epoch, seen, fifo = cur
+            if epoch < cur_epoch:
+                return "stale_epoch"
+            if seq in seen:
+                return "duplicate"
+            if fifo and len(fifo) >= self.window and seq < min(fifo):
+                # below the window floor: cannot distinguish from a replay —
+                # reject (senders are monotonic; a live message is never
+                # `window` sends behind)
+                return "duplicate"
+            seen.add(seq)
+            fifo.append(seq)
+            while len(fifo) > self.window:
+                seen.discard(fifo.popleft())
+            return "accept"
+
+
+# ---------------------------------------------------------------------------
+# sender identity
+# ---------------------------------------------------------------------------
+
+
+class SenderStamp:
+    """Per-process sender identity: a strictly-increasing epoch (wall-clock
+    nanoseconds at construction — a restart always epoch-supersedes the
+    previous life) + a monotonic per-message sequence counter."""
+
+    def __init__(self, epoch: Optional[int] = None):
+        self.epoch = int(epoch) if epoch is not None else time.time_ns()
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def next_seq(self) -> int:
+        with self._lock:
+            self._seq += 1
+            return self._seq

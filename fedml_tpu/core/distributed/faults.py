@@ -3,20 +3,35 @@
 reference: none — SURVEY.md §5 records the reference has **no fault
 injection** harness (its only failure tooling is MQTT last-will + fail-stop
 ``MPI.Abort``). This module is the upgrade the blueprint calls for: system
-faults (lost messages, delays, crashed peers) injected AT THE TRANSPORT, so
-every recovery path — round deadlines, straggler revival, OFFLINE handling,
-LightSecAgg dropout tolerance — is testable deterministically, with the
-production FSMs completely unaware.
+faults (lost messages, delays, crashed peers, duplicated and corrupted
+frames) injected AT THE TRANSPORT, so every recovery path — round
+deadlines, straggler revival, OFFLINE handling, LightSecAgg dropout
+tolerance, retry/dedup/checksum delivery — is testable deterministically,
+with the production FSMs completely unaware.
 
 ``FaultyComm`` wraps any ``BaseCommunicationManager`` (loopback/gRPC/MQTT)
 and applies a ``FaultPlan``:
 
 - ``drop(sender, receiver, round)`` — a specific message class vanishes;
-- ``delay(sender, receiver, seconds)`` — link latency;
+- ``delay(sender, receiver, round, seconds)`` — link latency, delivered
+  from a daemon timer thread (the sender's thread is NEVER stalled — a
+  delayed link must not block the server FSM's unrelated sends);
 - ``crash(rank, after_sends)`` — the wrapped node stops sending AND
   receiving after its Nth send (0 = dead from the start), like a killed
   process (its queue goes dark, not its python object);
-- ``loss(p, seed)`` — seeded Bernoulli message loss, reproducible.
+- ``loss(p, seed, visible=False)`` — seeded Bernoulli message loss.
+  ``visible=True`` models a transport whose sender SEES the failure (a
+  refused TCP write, a gRPC UNAVAILABLE): the send raises
+  :class:`delivery.TransientSendError`, which the at-least-once layer
+  retries with backoff. The default models silent loss (QoS-0 broadcast);
+- ``duplicate(p, seed, sender, receiver, round)`` — seeded wire
+  duplication: the SAME stamped message is delivered twice, exercising the
+  receiver's dedup window;
+- ``corrupt(p, seed, sender, receiver, round)`` — seeded payload
+  corruption: a bit-flipped copy is delivered AND the send raises
+  ``TransientSendError`` (the loopback analog of a receiver checksum NACK),
+  so the retry layer re-delivers a clean copy while the receiver drops the
+  corrupt one.
 
 Rules match on the Message header only (sender/receiver/round), never on
 payloads, so injection composes with compression/encryption layers.
@@ -25,13 +40,13 @@ payloads, so injection composes with compression/encryption layers.
 from __future__ import annotations
 
 import threading
-import time
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from .base_com_manager import BaseCommunicationManager, Observer
+from .delivery import TransientSendError
 from .message import Message
 
 
@@ -41,10 +56,13 @@ class FaultPlan:
 
     drops: List[dict] = field(default_factory=list)
     delays: List[dict] = field(default_factory=list)
+    duplicates: List[dict] = field(default_factory=list)
+    corrupts: List[dict] = field(default_factory=list)
     crash_rank: Optional[int] = None
     crash_after_sends: int = 0
     loss_p: float = 0.0
     loss_seed: int = 0
+    loss_visible: bool = False
 
     def drop(self, sender: Optional[int] = None,
              receiver: Optional[int] = None,
@@ -55,9 +73,11 @@ class FaultPlan:
         return self
 
     def delay(self, seconds: float, sender: Optional[int] = None,
-              receiver: Optional[int] = None) -> "FaultPlan":
+              receiver: Optional[int] = None,
+              round_idx: Optional[int] = None) -> "FaultPlan":
         self.delays.append(
-            {"sender": sender, "receiver": receiver, "seconds": seconds}
+            {"sender": sender, "receiver": receiver, "round": round_idx,
+             "seconds": seconds}
         )
         return self
 
@@ -66,9 +86,31 @@ class FaultPlan:
         self.crash_after_sends = after_sends
         return self
 
-    def loss(self, p: float, seed: int = 0) -> "FaultPlan":
+    def loss(self, p: float, seed: int = 0,
+             visible: bool = False) -> "FaultPlan":
         self.loss_p = float(p)
         self.loss_seed = int(seed)
+        self.loss_visible = bool(visible)
+        return self
+
+    def duplicate(self, p: float = 1.0, seed: int = 0,
+                  sender: Optional[int] = None,
+                  receiver: Optional[int] = None,
+                  round_idx: Optional[int] = None) -> "FaultPlan":
+        self.duplicates.append(
+            {"sender": sender, "receiver": receiver, "round": round_idx,
+             "p": float(p), "seed": int(seed)}
+        )
+        return self
+
+    def corrupt(self, p: float = 1.0, seed: int = 0,
+                sender: Optional[int] = None,
+                receiver: Optional[int] = None,
+                round_idx: Optional[int] = None) -> "FaultPlan":
+        self.corrupts.append(
+            {"sender": sender, "receiver": receiver, "round": round_idx,
+             "p": float(p), "seed": int(seed)}
+        )
         return self
 
 
@@ -96,34 +138,106 @@ class FaultyComm(BaseCommunicationManager):
         self._sends = 0
         self._crashed = False
         self._rng = np.random.RandomState(plan.loss_seed)
+        # per-rule seeded streams: each probabilistic rule draws from its
+        # own RandomState so matrices reproduce regardless of rule order
+        self._dup_rngs = [np.random.RandomState(r["seed"])
+                          for r in plan.duplicates]
+        self._cor_rngs = [np.random.RandomState(r["seed"])
+                          for r in plan.corrupts]
         self._lock = threading.Lock()
 
     # -- fault logic --------------------------------------------------------
 
-    def _should_drop(self, msg: Message) -> bool:
+    def _send_verdict(self, msg: Message) -> str:
+        """One of: deliver | drop | lose_visible."""
         with self._lock:
             if self._crashed:
-                return True
+                return "drop"
             # after_sends=0 means crashed-from-the-start: no send ever leaves
             if (self.plan.crash_rank == self.rank
                     and self._sends >= self.plan.crash_after_sends):
                 self._crashed = True
                 self.inner.stop_receive_message()  # the process is gone
-                return True
+                return "drop"
             self._sends += 1
             if self.plan.loss_p > 0 and self._rng.rand() < self.plan.loss_p:
-                return True
-        return any(_matches(r, msg) for r in self.plan.drops)
+                return ("lose_visible" if self.plan.loss_visible
+                        else "drop")
+        if any(_matches(r, msg) for r in self.plan.drops):
+            return "drop"
+        return "deliver"
+
+    def _rule_hits(self, msg: Message, rules: List[dict],
+                   rngs: List[np.random.RandomState]) -> bool:
+        """Whether any matching probabilistic rule fires. Every MATCHING
+        rule draws (under the lock) even when it misses, so the stream
+        position depends only on the matched-message sequence."""
+        hit = False
+        with self._lock:
+            for rule, rng in zip(rules, rngs):
+                if _matches(rule, msg) and rng.rand() < rule["p"]:
+                    hit = True
+        return hit
 
     # -- BaseCommunicationManager -------------------------------------------
 
     def send_message(self, msg: Message) -> None:
-        if self._should_drop(msg):
+        verdict = self._send_verdict(msg)
+        if verdict == "drop":
             return
+        if verdict == "lose_visible":
+            raise TransientSendError(
+                f"injected loss: {msg.get_type()!r} "
+                f"{msg.get_sender_id()}->{msg.get_receiver_id()}"
+            )
+        delay_s = 0.0
         for rule in self.plan.delays:
             if _matches(rule, msg):
-                time.sleep(float(rule["seconds"]))
-        self.inner.send_message(msg)
+                delay_s = max(delay_s, float(rule["seconds"]))
+        corrupt = self._rule_hits(msg, self.plan.corrupts, self._cor_rngs)
+        duplicate = self._rule_hits(msg, self.plan.duplicates, self._dup_rngs)
+        if corrupt:
+            # deliver the damaged frame, then surface a NACK to the sender:
+            # the retry layer re-sends a clean copy (same seq — the receiver
+            # dropped the corrupt one before dedup recorded it)
+            self._deliver(msg, delay_s, corrupt=True)
+            raise TransientSendError(
+                f"injected corruption: {msg.get_type()!r} "
+                f"{msg.get_sender_id()}->{msg.get_receiver_id()}"
+            )
+        self._deliver(msg, delay_s)
+        if duplicate:
+            self._deliver(msg, delay_s)
+
+    def _deliver(self, msg: Message, delay_s: float,
+                 corrupt: bool = False) -> None:
+        """Hand the message to the wrapped transport — immediately, or from
+        a daemon timer thread after ``delay_s``. The caller's thread never
+        sleeps: a delayed link stalls only its own messages, not the
+        sender FSM's unrelated sends."""
+        if delay_s <= 0:
+            self._transmit(msg, corrupt)
+            return
+        t = threading.Timer(delay_s, self._transmit, args=(msg, corrupt))
+        t.daemon = True
+        t.start()
+
+    def _transmit(self, msg: Message, corrupt: bool) -> None:
+        with self._lock:
+            if self._crashed:
+                return  # a timer racing the crash: the process is gone
+        if corrupt:
+            # corrupt a COPY: the caller's Message instance is re-sent
+            # verbatim by the retry layer (and possibly by a concurrent
+            # delayed timer) — it must never carry the corruption flag
+            damaged = Message()
+            damaged.init(msg.get_params())
+            damaged.arrays = list(msg.arrays)
+            damaged.wire_format = msg.wire_format
+            damaged.corrupt_on_wire = True
+            self.inner.send_message(damaged)
+        else:
+            self.inner.send_message(msg)
 
     def add_observer(self, observer: Observer) -> None:
         self.inner.add_observer(observer)

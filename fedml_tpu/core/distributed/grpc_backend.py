@@ -25,9 +25,13 @@ from .message import Message
 
 logger = logging.getLogger(__name__)
 
-# one transient UNAVAILABLE retry per send: a peer mid-restart (crash-drop
-# recovery, rolling deploy) costs a counter bump instead of a dead round
-SEND_RETRIES = 1
+# transient status codes worth re-sending: a peer mid-restart (crash-drop
+# recovery, rolling deploy) costs backoff + a counter bump instead of a
+# dead round. Retried sends re-use the same delivery header (seq/epoch),
+# so the receiver's dedup window recognizes any duplicate the retry
+# creates. RESOURCE_EXHAUSTED is deliberately NOT here: its common cause
+# (message over the peer's size limit) is permanent and must fail fast.
+TRANSIENT_STATUS_CODES = (grpc.StatusCode.UNAVAILABLE,)
 
 MAX_MESSAGE_BYTES = 1024 * 1024 * 1024  # 1 GB, reference parity
 _SERVICE = "fedml_tpu.Comm"
@@ -62,7 +66,11 @@ class GRPCCommManager(BaseCommunicationManager):
         base_port: int = CommunicationConstants.GRPC_BASE_PORT,
         wire_format: str = "npz",
         stream_threshold_bytes: int = 8 * 1024 * 1024,
+        retry_policy=None,
     ):
+        from .delivery import RetryPolicy
+
+        self.retry_policy = retry_policy or RetryPolicy()
         self.rank = int(rank)
         self.world_size = int(world_size)
         self.base_port = int(base_port)
@@ -157,25 +165,37 @@ class GRPCCommManager(BaseCommunicationManager):
         payload = msg.serialize()
         telemetry.counter_inc("comm.grpc.messages_sent")
         telemetry.counter_inc("comm.grpc.bytes_sent", len(payload))
-        for attempt in range(SEND_RETRIES + 1):
-            try:
-                if len(payload) > self.stream_threshold:
-                    from .tensor_transport import iter_chunks
 
-                    self._stream_stub(msg.get_receiver_id())(
-                        iter_chunks(payload), timeout=300
-                    )
-                else:
-                    self._stub(msg.get_receiver_id())(payload, timeout=300)
-                return
-            except grpc.RpcError as e:
-                code = e.code() if hasattr(e, "code") else None
-                if (attempt < SEND_RETRIES
-                        and code == grpc.StatusCode.UNAVAILABLE):
-                    telemetry.counter_inc("comm.grpc.send_retries")
-                    continue
-                telemetry.counter_inc("comm.grpc.send_failures")
-                raise
+        def _once() -> None:
+            if len(payload) > self.stream_threshold:
+                from .tensor_transport import iter_chunks
+
+                self._stream_stub(msg.get_receiver_id())(
+                    iter_chunks(payload), timeout=300
+                )
+            else:
+                self._stub(msg.get_receiver_id())(payload, timeout=300)
+
+        def _transient(e: Exception) -> bool:
+            code = e.code() if hasattr(e, "code") else None
+            return (isinstance(e, grpc.RpcError)
+                    and code in TRANSIENT_STATUS_CODES)
+
+        try:
+            # exponential backoff + jitter under a bounded budget
+            # (delivery.RetryPolicy) — replaces the old single-UNAVAILABLE
+            # retry; a peer that stays down past the budget still raises so
+            # _send_or_mark_dead can declare it dead
+            self.retry_policy.call(
+                _once,
+                is_transient=_transient,
+                on_retry=lambda attempt, e: telemetry.counter_inc(
+                    "comm.grpc.send_retries"
+                ),
+            )
+        except grpc.RpcError:
+            telemetry.counter_inc("comm.grpc.send_failures")
+            raise
 
     def add_observer(self, observer: Observer) -> None:
         with self._obs_lock:
@@ -196,7 +216,11 @@ class GRPCCommManager(BaseCommunicationManager):
                 data = self._queue.get(timeout=0.1)
             except queue.Empty:
                 continue
-            self._notify(Message.deserialize(data))
+            from .delivery import safe_deserialize
+
+            msg = safe_deserialize(data, "grpc")
+            if msg is not None:
+                self._notify(msg)
 
     def stop_receive_message(self) -> None:
         self._stop_evt.set()

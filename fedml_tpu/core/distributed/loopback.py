@@ -92,7 +92,11 @@ class LoopbackCommManager(BaseCommunicationManager):
                 data = q.get(timeout=0.1)
             except queue.Empty:
                 continue
-            self._notify(Message.deserialize(data))
+            from .delivery import safe_deserialize
+
+            msg = safe_deserialize(data, "loopback")
+            if msg is not None:
+                self._notify(msg)
 
     def stop_receive_message(self) -> None:
         self._stop_evt.set()

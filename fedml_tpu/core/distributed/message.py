@@ -26,6 +26,12 @@ class Message:
     MSG_ARG_KEY_CLIENT_STATUS = "client_status"
     MSG_ARG_KEY_ROUND_IDX = "round_idx"
     MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
+    # at-least-once delivery header (core/distributed/delivery.py): per-
+    # sender monotonic sequence + sender epoch identify wire duplicates;
+    # the payload digest rejects corrupt bodies before any handler runs
+    MSG_ARG_KEY_SEQ = "_seq"
+    MSG_ARG_KEY_EPOCH = "_epoch"
+    MSG_ARG_KEY_PAYLOAD_SHA256 = "_sha256"
 
     def __init__(self, type: str = "default", sender_id: int = 0, receiver_id: int = 0):
         self.type = str(type)
@@ -80,20 +86,46 @@ class Message:
     # interoperate (npz bodies start with the zip magic "PK").
     wire_format = "npz"
 
+    # fault-injection hook (core/distributed/faults.py `corrupt()` rules):
+    # when set, serialize() computes the TRUE payload digest and then flips
+    # one byte of the encoded frame — the receiver's integrity check must
+    # reject the message. Never set outside the fault harness.
+    corrupt_on_wire = False
+
     def serialize(self) -> bytes:
+        from .delivery import arrays_digest
+
+        if self.arrays:
+            # digest of the arrays (not the encoded body): the same header
+            # value verifies an inline body AND a payload-store blob after
+            # the arrays moved by reference (comm_manager offload)
+            self.msg_params[Message.MSG_ARG_KEY_PAYLOAD_SHA256] = \
+                arrays_digest(self.arrays)
         header = json.dumps(self.msg_params).encode("utf-8")
         prefix = [len(header).to_bytes(4, "big"), header]
         if self.wire_format == "raw" and self.arrays:
             from .tensor_transport import encode_frame_parts
 
             # single-pass assembly: one join over prefix + frame pieces
-            return b"".join(prefix + encode_frame_parts(self.arrays))
-        buf = io.BytesIO()
-        np.savez(buf, *self.arrays)
-        return b"".join(prefix + [buf.getvalue()])
+            frame = b"".join(prefix + encode_frame_parts(self.arrays))
+        else:
+            buf = io.BytesIO()
+            np.savez(buf, *self.arrays)
+            frame = b"".join(prefix + [buf.getvalue()])
+        if self.corrupt_on_wire:
+            frame = bytearray(frame)
+            # flip a byte mid-body for payload messages (defeats the array
+            # digest), or a header byte for control messages (defeats the
+            # JSON parse) — either way the receiver must reject the frame
+            body_start = 4 + len(header)
+            idx = (body_start + (len(frame) - body_start) // 2
+                   if self.arrays else 4)
+            frame[idx] ^= 0xFF
+            frame = bytes(frame)
+        return frame
 
     @staticmethod
-    def deserialize(data: bytes) -> "Message":
+    def deserialize(data: bytes, verify: bool = True) -> "Message":
         hlen = int.from_bytes(data[:4], "big")
         header = json.loads(data[4 : 4 + hlen].decode("utf-8"))
         msg = Message()
@@ -107,7 +139,27 @@ class Message:
             else:
                 with np.load(io.BytesIO(bytes(body))) as z:
                     msg.arrays = [z[k] for k in z.files]
+        if verify and msg.arrays:
+            msg.verify_payload()
         return msg
+
+    def verify_payload(self) -> None:
+        """Check the arrays against the header digest (when present).
+        Raises :class:`delivery.PayloadCorruptError` on mismatch — receive
+        loops turn that into a counted drop, and the at-least-once sender
+        re-delivers a clean copy."""
+        from .delivery import PayloadCorruptError, arrays_digest
+
+        want = self.msg_params.get(Message.MSG_ARG_KEY_PAYLOAD_SHA256)
+        if want is None:
+            return  # pre-digest peer: nothing to verify
+        got = arrays_digest(self.arrays)
+        if got != want:
+            raise PayloadCorruptError(
+                f"payload checksum mismatch for {self.type!r} "
+                f"{self.sender_id}->{self.receiver_id}: "
+                f"expected {want[:12]}…, got {got[:12]}…"
+            )
 
     def __repr__(self) -> str:  # pragma: no cover
         return (

@@ -23,6 +23,7 @@ import base64
 import logging
 import queue
 import threading
+import time
 from typing import List
 
 from ..mlops import telemetry
@@ -36,7 +37,9 @@ class MqttCommManager(BaseCommunicationManager):
     """Per-rank topic scheme: ``fedml/<run_id>/<rank>``."""
 
     def __init__(self, host: str, port: int, rank: int, world_size: int,
-                 run_id: str = "0", keepalive: int = 60, qos: int = 1):
+                 run_id: str = "0", keepalive: int = 60, qos: int = 1,
+                 subscribe_retries: int = 5,
+                 subscribe_timeout_s: float = 6.0):
         try:
             import paho.mqtt.client as mqtt
         except ImportError as e:
@@ -49,6 +52,10 @@ class MqttCommManager(BaseCommunicationManager):
         self.world_size = int(world_size)
         self.run_id = str(run_id)
         self.qos = int(qos)
+        self.host = str(host)
+        self.port = int(port)
+        self.subscribe_retries = int(subscribe_retries)
+        self.subscribe_timeout_s = float(subscribe_timeout_s)
         self._queue: "queue.Queue[bytes]" = queue.Queue()
         # shared with the paho network thread and the receive thread
         # (graftlint G005): observers snapshot under a lock, loop liveness
@@ -133,18 +140,60 @@ class MqttCommManager(BaseCommunicationManager):
             if observer in self._observers:
                 self._observers.remove(observer)
 
+    def _await_subscribe(self) -> None:
+        """Block until our SUBSCRIBE is acknowledged, with bounded
+        reconnect retries + backoff.
+
+        The pre-robustness behavior — one hard 30 s wait, then "proceeding
+        anyway" — either wedged a run on a slow broker or silently dropped
+        the peers' ONLINE handshakes (brokers drop publishes to
+        subscriber-less topics). Now each unconfirmed window triggers a
+        counted reconnect attempt (``comm.mqtt.subscribe_retries``); when
+        the budget is spent the raise tells the operator exactly what to
+        check instead of a bare timeout."""
+        from .delivery import RetryPolicy
+
+        backoff = RetryPolicy(base_s=0.5, max_s=5.0)
+        for attempt in range(self.subscribe_retries + 1):
+            if self._conn_resolved.wait(timeout=self.subscribe_timeout_s):
+                if self._connect_error is not None:
+                    # a broker REFUSAL (bad auth/ACL) is not transient —
+                    # retrying the same credentials cannot succeed
+                    raise ConnectionError(
+                        f"{self._connect_error} — check the broker "
+                        f"credentials/ACL for client fedml-{self.run_id}-"
+                        f"{self.rank} at {self.host}:{self.port}"
+                    )
+                if self._subscribed.is_set():
+                    return
+            if attempt >= self.subscribe_retries:
+                break
+            telemetry.counter_inc("comm.mqtt.subscribe_retries")
+            logger.warning(
+                "mqtt backend: subscribe unconfirmed after %.1fs — "
+                "reconnect attempt %d/%d", self.subscribe_timeout_s,
+                attempt + 1, self.subscribe_retries,
+            )
+            self._conn_resolved.clear()
+            try:
+                self._client.reconnect()
+            except Exception as e:  # noqa: BLE001 — retried with backoff
+                logger.warning("mqtt backend: reconnect failed: %s", e)
+            time.sleep(backoff.backoff_s(attempt + 1))
+        raise ConnectionError(
+            f"mqtt backend: subscribe to {self._topic(self.rank)} "
+            f"unconfirmed after {self.subscribe_retries} reconnect "
+            f"attempts (~{self.subscribe_timeout_s * (self.subscribe_retries + 1):.0f}s) "
+            f"— is the broker at {self.host}:{self.port} reachable from "
+            "this host (DNS/firewall), and does it allow this client id? "
+            "On broker-less pods use the GRPC or LOOPBACK backend."
+        )
+
     def handle_receive_message(self) -> None:
         # don't declare readiness before our SUBSCRIBE is acknowledged:
         # brokers drop publishes to subscriber-less topics, so an early
         # ONLINE handshake from a peer would vanish
-        self._conn_resolved.wait(timeout=30.0)
-        if self._connect_error is not None:
-            raise ConnectionError(self._connect_error)
-        if not self._subscribed.is_set():
-            logger.warning(
-                "mqtt backend: subscribe not confirmed after 30s; "
-                "proceeding anyway"
-            )
+        self._await_subscribe()
         self._notify(
             Message(CommunicationConstants.MSG_TYPE_CONNECTION_IS_READY,
                     self.rank, self.rank)
@@ -154,7 +203,11 @@ class MqttCommManager(BaseCommunicationManager):
                 data = self._queue.get(timeout=0.1)
             except queue.Empty:
                 continue
-            self._notify(Message.deserialize(data))
+            from .delivery import safe_deserialize
+
+            msg = safe_deserialize(data, "mqtt")
+            if msg is not None:
+                self._notify(msg)
 
     def stop_receive_message(self) -> None:
         self._stop_evt.set()

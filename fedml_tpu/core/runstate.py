@@ -1,0 +1,319 @@
+"""Durable run ledger + preemption-safe resume.
+
+reference: none — SURVEY.md §5 records the reference has essentially no
+checkpoint/resume (models are in-memory state dicts or per-round S3
+artifacts; a killed run restarts from round 0). Production FL treats device
+churn and server preemption as the steady state (Bonawitz et al., MLSys
+2019), so this module makes "kill -9 anywhere, restart, converge to the same
+params" a first-class, testable invariant:
+
+- :class:`RunLedger` — an append-only JSONL file beside the Orbax
+  checkpoints. One line per *committed* round boundary (round index,
+  covering checkpoint step, sampled cohort, contribution counts), each line
+  self-checksummed so a torn write at crash time is detected and dropped on
+  read instead of poisoning the resume.
+- :class:`PreemptionGuard` — a process-wide SIGTERM/SIGINT latch. The
+  handler only sets an Event; training loops drain the in-flight round,
+  commit checkpoint + ledger, and raise :class:`PreemptionError`, which
+  entry points convert into :data:`EXIT_PREEMPTED` (75, EX_TEMPFAIL:
+  "preempted, resumable") so schedulers can tell a preemption from a crash.
+- ``resume_mode`` / ``checkpoint_cadence`` — the one parser for the
+  ``--resume auto|never|require`` and ``--checkpoint_rounds N`` knobs shared
+  by the sp/mesh engines and the cross-silo server.
+
+Recovery events (resumes, preemptions, committed rounds) flow through the
+telemetry registry as ``run.*`` counters.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import signal
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from .mlops import telemetry
+
+logger = logging.getLogger(__name__)
+
+LEDGER_FILENAME = "run_ledger.jsonl"
+
+# EX_TEMPFAIL: the conventional "transient failure, retry me" exit status —
+# distinct from a crash (nonzero) and from success, so a supervisor can
+# restart with --resume auto instead of paging someone
+EXIT_PREEMPTED = 75
+
+
+class PreemptionError(RuntimeError):
+    """Raised by a training loop that drained and committed after SIGTERM/
+    SIGINT. Carries the last committed round so callers can log it."""
+
+    def __init__(self, last_round: int, message: str = ""):
+        super().__init__(
+            message or f"preempted after committing round {last_round} — "
+            f"resumable with --resume auto (exit {EXIT_PREEMPTED})"
+        )
+        self.last_round = int(last_round)
+
+
+def resume_mode(args) -> str:
+    """Normalize ``args.resume`` to ``auto | never | require``.
+
+    Back-compat: the pre-ledger schema typed ``resume`` as a bool; True
+    maps to ``auto``, False to ``never``.
+    """
+    raw = getattr(args, "resume", "auto")
+    if isinstance(raw, bool):
+        return "auto" if raw else "never"
+    mode = str(raw).strip().lower()
+    if mode in ("", "auto", "true", "1", "yes"):
+        return "auto"
+    if mode in ("never", "false", "0", "no", "off"):
+        return "never"
+    if mode in ("require", "required", "must"):
+        return "require"
+    raise ValueError(
+        f"resume must be auto|never|require, got {raw!r}"
+    )
+
+
+def checkpoint_cadence(args) -> int:
+    """Rounds between checkpoint commits: ``--checkpoint_rounds`` wins,
+    then the legacy ``checkpoint_every_rounds``, else every round."""
+    for key in ("checkpoint_rounds", "checkpoint_every_rounds"):
+        n = int(getattr(args, key, 0) or 0)
+        if n > 0:
+            return n
+    return 1
+
+
+# ---------------------------------------------------------------------------
+# Durable ledger
+# ---------------------------------------------------------------------------
+
+
+def _line_digest(payload: Dict[str, Any]) -> str:
+    body = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(body.encode("utf-8")).hexdigest()[:16]
+
+
+class RunLedger:
+    """Append-only JSONL record of committed round boundaries.
+
+    Every line is ``{...payload..., "sha": <sha256[:16] of the payload>}``
+    and is flushed + fsync'd before ``commit_round`` returns — after a crash
+    the file's valid prefix IS the set of rounds that durably completed.
+    Read-side, any line that fails to parse or whose checksum mismatches
+    (a torn write at kill time) ends the valid prefix; everything after it
+    is ignored. The ledger is advisory metadata next to the Orbax
+    checkpoint: the checkpoint holds the params, the ledger holds the round
+    history (cohorts, contribution counts) that makes recovery *auditable*
+    — two runs are provably the same federation iff their ledgers diff
+    clean.
+    """
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def for_checkpoint_dir(cls, ckpt_dir: str) -> "RunLedger":
+        return cls(os.path.join(os.path.abspath(ckpt_dir), LEDGER_FILENAME))
+
+    # -- append side --------------------------------------------------------
+
+    def _append(self, payload: Dict[str, Any]) -> None:
+        payload = dict(payload)
+        payload["sha"] = _line_digest(
+            {k: v for k, v in payload.items() if k != "sha"}
+        )
+        line = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+        with self._lock:
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(line + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+    def ensure_meta(self, **meta: Any) -> Dict[str, Any]:
+        """Write the run_meta head line once; return the (existing or new)
+        meta. A resumed run re-uses the original meta — a MISMATCH on the
+        identity keys (seed, world) means the operator pointed a different
+        federation at this ledger, which would silently corrupt the round
+        history, so it raises."""
+        existing = self.meta()
+        if existing is not None:
+            for key in ("seed", "world"):
+                if key in meta and key in existing and \
+                        existing[key] != meta[key]:
+                    raise RuntimeError(
+                        f"ledger {self.path}: run_meta mismatch on "
+                        f"{key!r} (ledger={existing[key]!r}, "
+                        f"run={meta[key]!r}) — this checkpoint dir belongs "
+                        "to a different federation; use a fresh dir"
+                    )
+            return existing
+        payload = {"kind": "run_meta", "version": 1, **meta}
+        self._append(payload)
+        return payload
+
+    def commit_round(
+        self,
+        round_idx: int,
+        ckpt_step: Optional[int] = None,
+        cohort: Optional[Sequence[int]] = None,
+        **extra: Any,
+    ) -> Dict[str, Any]:
+        """Durably record one committed round boundary."""
+        payload: Dict[str, Any] = {
+            "kind": "round",
+            "round": int(round_idx),
+            "ckpt_step": None if ckpt_step is None else int(ckpt_step),
+            "cohort": None if cohort is None else [int(c) for c in cohort],
+        }
+        for k, v in extra.items():
+            payload[k] = v
+        self._append(payload)
+        telemetry.counter_inc("run.rounds_committed")
+        return payload
+
+    # -- read side ----------------------------------------------------------
+
+    def entries(self) -> List[Dict[str, Any]]:
+        """The valid prefix of the ledger (torn/corrupt tail dropped)."""
+        out: List[Dict[str, Any]] = []
+        if not os.path.exists(self.path):
+            return out
+        with open(self.path, "r", encoding="utf-8") as f:
+            for raw in f:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    payload = json.loads(raw)
+                except (ValueError, TypeError):
+                    logger.warning(
+                        "ledger %s: torn/corrupt line after %d entries — "
+                        "treating it as the crash point", self.path, len(out)
+                    )
+                    break
+                sha = payload.pop("sha", None)
+                if sha != _line_digest(payload):
+                    logger.warning(
+                        "ledger %s: checksum mismatch after %d entries — "
+                        "treating it as the crash point", self.path, len(out)
+                    )
+                    break
+                out.append(payload)
+        return out
+
+    def meta(self) -> Optional[Dict[str, Any]]:
+        for e in self.entries():
+            if e.get("kind") == "run_meta":
+                return e
+        return None
+
+    def rounds(self) -> List[Dict[str, Any]]:
+        return [e for e in self.entries() if e.get("kind") == "round"]
+
+    def last_round(self) -> Optional[int]:
+        rs = self.rounds()
+        return None if not rs else int(rs[-1]["round"])
+
+    def cohort_for(self, round_idx: int) -> Optional[List[int]]:
+        """The recorded cohort of a committed round (newest record wins —
+        a resumed run may legitimately re-commit the crash-round)."""
+        for e in reversed(self.rounds()):
+            if int(e["round"]) == int(round_idx):
+                c = e.get("cohort")
+                return None if c is None else [int(x) for x in c]
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Preemption guard
+# ---------------------------------------------------------------------------
+
+
+class PreemptionGuard:
+    """Process-wide SIGTERM/SIGINT latch with drain semantics.
+
+    The signal handler ONLY sets an Event — no I/O, no exit — so the
+    training loop finishes (drains) the in-flight round, commits checkpoint
+    + ledger at a consistent boundary, and exits with the distinct
+    "preempted, resumable" status. A second signal while already draining
+    escalates: the original handler is restored and the signal re-raised,
+    so a stuck drain can still be killed.
+
+    Tests trigger preemption without real signals via :meth:`request`.
+    """
+
+    def __init__(self):
+        self._evt = threading.Event()
+        self._installed = False
+        self._prev: Dict[int, Any] = {}
+        self._lock = threading.Lock()
+
+    def install(self, signals: Sequence[int] = (signal.SIGTERM,
+                                                signal.SIGINT)) -> bool:
+        """Install handlers (idempotent). Returns False off the main thread
+        (signal.signal raises there) — callers on comm threads simply run
+        without signal-driven preemption, keeping :meth:`request` usable."""
+        with self._lock:
+            if self._installed:
+                return True
+            try:
+                for sig in signals:
+                    self._prev[sig] = signal.signal(sig, self._on_signal)
+            except ValueError:  # not the main thread
+                self._prev.clear()
+                return False
+            self._installed = True
+            return True
+
+    def _on_signal(self, signum, frame) -> None:
+        if self._evt.is_set():
+            # second signal: the drain is stuck or the operator means NOW —
+            # restore the original disposition and re-deliver
+            prev = self._prev.get(signum, signal.SIG_DFL)
+            signal.signal(signum, prev)
+            os.kill(os.getpid(), signum)
+            return
+        telemetry.counter_inc("run.preempt_signals")
+        self._evt.set()
+        logger.warning(
+            "preemption signal %d: draining the in-flight round, then "
+            "committing checkpoint + ledger (exit %d)", signum,
+            EXIT_PREEMPTED,
+        )
+
+    def request(self, *_a) -> None:
+        """Programmatic preemption (tests, embedding runtimes)."""
+        self._evt.set()
+
+    def requested(self) -> bool:
+        return self._evt.is_set()
+
+    def reset(self) -> None:
+        self._evt.clear()
+
+    def uninstall(self) -> None:
+        with self._lock:
+            for sig, prev in self._prev.items():
+                try:
+                    signal.signal(sig, prev)
+                except ValueError:
+                    pass
+            self._prev.clear()
+            self._installed = False
+
+
+_GUARD = PreemptionGuard()
+
+
+def preemption_guard() -> PreemptionGuard:
+    """The process-wide guard (one handler install, many consumers)."""
+    return _GUARD

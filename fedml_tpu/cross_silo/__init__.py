@@ -38,6 +38,14 @@ class FedMLCrossSiloServer:
 
     def run(self):
         self.manager.run()
+        if getattr(self.manager, "preempted", False):
+            # surface the drain as the sp/mesh engines do: FedMLRunner maps
+            # PreemptionError to the distinct "preempted, resumable" exit
+            # status (75) so supervisors restart with --resume auto instead
+            # of treating the preemption as a completed run
+            from ..core.runstate import PreemptionError
+
+            raise PreemptionError(self.manager.round_idx - 1)
         return self.manager.final_metrics
 
 

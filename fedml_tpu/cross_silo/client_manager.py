@@ -41,6 +41,17 @@ class ClientMasterManager(FedMLCommManager):
         self.silo_shard = silo_shard
         self.client_index = rank - 1
         self.round_idx = 0
+        # highest round already trained + the exact stamped message we
+        # answered it with: a re-delivered or delayed S2C_SYNC/INIT for
+        # that round must not RETRAIN (same params in, same model out, but
+        # a fresh seq could double-count at a server whose dedup window
+        # rotated) — instead the cached message is re-sent verbatim. Same
+        # seq, so a live server dedups it, while a RESTARTED server (fresh
+        # dedup window, re-broadcasting the uncommitted round it lost)
+        # gets the model it needs — without this, every client would drop
+        # the replay and the resumed round could never complete.
+        self._last_trained_round = -1
+        self._last_model_msg: Optional[Message] = None
         self.done = threading.Event()
         self.dp = (
             FedPrivacyMechanism.from_args(args)
@@ -94,14 +105,42 @@ class ClientMasterManager(FedMLCommManager):
         self.client_index = int(
             msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, self.client_index)
         )
-        self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0))
+        round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0))
+        if self._replay_guard("INIT", round_idx):
+            return
+        self.round_idx = round_idx
         self._install_params(msg)
         self._train_and_send()
 
     def _on_sync(self, msg: Message) -> None:
-        self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0))
+        round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0))
+        if self._replay_guard("SYNC", round_idx):
+            return
+        self.round_idx = round_idx
         self._install_params(msg)
         self._train_and_send()
+
+    def _replay_guard(self, kind: str, round_idx: int) -> bool:
+        """Idempotent INIT/SYNC: for the round we LAST answered, re-send
+        the cached stamped message (a restarted server needs it; a live
+        server dedups it by seq); older rounds are dropped outright.
+        Returns True when the caller must not retrain."""
+        if round_idx > self._last_trained_round:
+            return False
+        if (round_idx == self._last_trained_round
+                and self._last_model_msg is not None):
+            logger.info(
+                "client %d: replayed %s for round %d — re-sending the "
+                "cached round result", self.rank, kind, round_idx,
+            )
+            self.send_message(self._last_model_msg)
+        else:
+            logger.info(
+                "client %d: stale %s for round %d ignored (already trained "
+                "round %d)", self.rank, kind, round_idx,
+                self._last_trained_round,
+            )
+        return True
 
     def _on_finish(self, msg: Message) -> None:
         self._install_params(msg)
@@ -113,6 +152,7 @@ class ClientMasterManager(FedMLCommManager):
 
     def _train_and_send(self) -> None:
         """reference: __train + send_model_to_server (:109-127,160)."""
+        self._last_trained_round = self.round_idx
         self.args.round_idx = self.round_idx
         if self.silo_plane is not None:
             params, n, metrics = self._train_hierarchical()
@@ -142,6 +182,7 @@ class ClientMasterManager(FedMLCommManager):
             msg.set_arrays(arrays)
         else:
             msg.set_arrays([np.asarray(l) for l in jax.tree.leaves(params)])
+        self._last_model_msg = msg
         self.send_message(msg)
 
     def _train_hierarchical(self):

@@ -68,18 +68,41 @@ class FedMLServerManager(FedMLCommManager):
         )
         self.final_metrics: Optional[dict] = None
         self.done = threading.Event()
+        self.preempted = False
+        # per-round contribution counters: how many times each client's
+        # model was ACCEPTED into a round's aggregation. The delivery-layer
+        # dedup keeps every count at 1 even under retries/duplication —
+        # the chaos harness and the deadline-race tests assert exactly that
+        self.contrib_counts: Dict[int, Dict[int, int]] = {}
         # round checkpoint/resume (the reference restarts every killed run
         # from round 0 — SURVEY §5): with args.checkpoint_dir the aggregated
-        # global + round index persist via Orbax after every round, and a
-        # restarted server resumes the federation where it died — clients
-        # re-joining get the restored global in their INIT
+        # global + round index persist via Orbax after every round round
+        # boundary, the durable run ledger (core/runstate.py) records each
+        # committed round (cohort + contribution counts), and a restarted
+        # server resumes the federation where it died — clients re-joining
+        # get the restored global in their INIT
         self._ckpt = None
+        self._ledger = None
+        self._guard = None
         ckpt_dir = str(getattr(args, "checkpoint_dir", "") or "")
         if ckpt_dir:
             from ..checkpoint import CheckpointManager
+            from ..core import runstate
 
             self._ckpt = CheckpointManager(ckpt_dir)
+            mode = runstate.resume_mode(args)
             step = self._ckpt.latest_step()
+            if mode == "never" and step is not None:
+                raise RuntimeError(
+                    f"--resume never, but {ckpt_dir} already holds a "
+                    f"checkpoint (step {step}) — point at a fresh "
+                    "checkpoint_dir or use --resume auto"
+                )
+            if mode == "require" and step is None:
+                raise RuntimeError(
+                    f"--resume require, but {ckpt_dir} holds no checkpoint "
+                    "to resume from"
+                )
             if step is not None:
                 restored = self._ckpt.restore_latest(
                     {"global_params": self.global_params}
@@ -87,10 +110,31 @@ class FedMLServerManager(FedMLCommManager):
                 self.global_params = restored["global_params"]
                 self.aggregator.set_model_params(self.global_params)
                 self.round_idx = step + 1
+                from ..core.mlops import telemetry
+
+                telemetry.counter_inc("run.resumes")
                 logger.info(
                     "server: resumed federation at round %d from %s",
                     self.round_idx, ckpt_dir,
                 )
+            # identity pins engine + world size, NOT comm_round: restarting
+            # a finished federation with a larger round budget is the
+            # supported "extend the run" pattern
+            self._ledger = runstate.RunLedger.for_checkpoint_dir(ckpt_dir)
+            self._ledger.ensure_meta(
+                seed=int(getattr(args, "random_seed", 0)),
+                world={
+                    "engine": type(self).__name__,
+                    "client_num": self.client_num,
+                },
+            )
+            # preemption-safe drain: SIGTERM/SIGINT latches; the in-flight
+            # round finishes aggregating, commits checkpoint + ledger, and
+            # the FSM stops instead of dispatching the next round
+            self._guard = runstate.preemption_guard()
+            if bool(getattr(args, "preempt_signals", True)):
+                self._guard.install()
+            self._guard.reset()
 
     # -- FSM ----------------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -271,6 +315,13 @@ class FedMLServerManager(FedMLCommManager):
             # the stale-round check, not counted toward round r+1
             round_r = self.round_idx
             self.round_idx += 1
+            # count each aggregated contribution: a value > 1 would mean a
+            # client entered the SAME round's aggregation twice (a wire
+            # duplicate that slipped dedup, or a double-fired round) — the
+            # chaos harness and deadline-race tests assert all-ones
+            per_round = self.contrib_counts.setdefault(round_r, {})
+            for s in senders:
+                per_round[s] = per_round.get(s, 0) + 1
         raw = self.aggregator.on_before_aggregation(raw)
         weights = jnp.asarray([n for n, _ in raw])
         stacked = stack_trees([p for _, p in raw])
@@ -292,12 +343,24 @@ class FedMLServerManager(FedMLCommManager):
         agg = self.aggregator.on_after_aggregation(agg)
         self.global_params = agg
         self.aggregator.set_model_params(agg)
+        preempt = self._guard is not None and self._guard.requested()
         if self._ckpt is not None:
-            every = int(getattr(self.args, "checkpoint_every_rounds", 1) or 1)
+            from ..core import runstate
+
+            every = runstate.checkpoint_cadence(self.args)
             # the save blocks the FSM thread (Orbax wait_until_finished) —
-            # checkpoint_every_rounds bounds that cost, same as the sp engine
-            if (round_r + 1) % every == 0 or round_r == self.round_num - 1:
+            # the checkpoint cadence bounds that cost, same as the sp
+            # engine; a preemption drain commits regardless of cadence
+            if ((round_r + 1) % every == 0 or round_r == self.round_num - 1
+                    or preempt):
                 self._ckpt.save({"global_params": agg}, step=round_r)
+                if self._ledger is not None:
+                    with self._lock:
+                        contrib = dict(self.contrib_counts.get(round_r, {}))
+                    self._ledger.commit_round(
+                        round_r, ckpt_step=round_r, cohort=senders,
+                        contrib={str(k): v for k, v in contrib.items()},
+                    )
 
         if self.ds is not None:
             freq = max(int(getattr(self.args, "frequency_of_the_test", 1)), 1)
@@ -309,6 +372,24 @@ class FedMLServerManager(FedMLCommManager):
                     "server round %d: acc=%.4f", round_r,
                     self.final_metrics["test_acc"],
                 )
+
+        if preempt and self.round_idx < self.round_num:
+            # preemption drain: round_r is aggregated + committed; stop
+            # HERE instead of dispatching round_r+1 — the restarted server
+            # resumes at exactly round_r+1 with the committed global
+            from ..core.mlops import telemetry
+
+            telemetry.counter_inc("run.preemptions")
+            logger.warning(
+                "server: preempted after committing round %d — resumable "
+                "with --resume auto", round_r,
+            )
+            self.preempted = True
+            if self._ckpt is not None:
+                self._ckpt.close()
+            self.done.set()
+            self.finish()
+            return
 
         leaves = [np.asarray(l) for l in jax.tree.leaves(self.global_params)]
         if self.round_idx < self.round_num:

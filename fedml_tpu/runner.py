@@ -7,6 +7,8 @@ override points, exactly like the reference's FedMLRunner.
 
 from __future__ import annotations
 
+import logging
+
 from . import constants
 
 
@@ -78,12 +80,19 @@ class FedMLRunner:
 
     def run(self):
         from .core.mlops import telemetry
+        from .core.runstate import EXIT_PREEMPTED, PreemptionError
 
         # periodic host CPU/RSS + HBM sampling on a daemon thread (off by
         # default; --sys_perf_interval_s N with tracking enabled turns it on)
         sampler = telemetry.start_sys_perf_sampler(self.args)
         try:
             return self.runner.run()
+        except PreemptionError as e:
+            # drained + committed: exit with the distinct "preempted,
+            # resumable" status (75, EX_TEMPFAIL) so supervisors restart
+            # with --resume auto instead of treating this as a crash
+            logging.getLogger(__name__).warning("%s", e)
+            raise SystemExit(EXIT_PREEMPTED)
         finally:
             if sampler is not None:
                 sampler.stop()

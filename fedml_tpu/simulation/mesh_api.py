@@ -69,6 +69,19 @@ class MeshFedAvgAPI(FedAvgAPI):
             self.axis_size, self.mesh,
         )
 
+    def _ledger_world(self):
+        """Pin the mesh topology into the run ledger's run_meta: a resumed
+        run on a different chip count would silently change cohort padding
+        (and so the padded-row math) — ``RunLedger.ensure_meta`` turns that
+        into a loud mismatch error instead."""
+        world = super()._ledger_world()
+        world["mesh_axes"] = {
+            str(name): int(self.mesh.shape[name])
+            for name in self.mesh.axis_names
+        }
+        world["device_count"] = int(len(self.mesh.devices.flat))
+        return world
+
     # -- FedAvgAPI placement hooks ------------------------------------------
     def _pad_cohort(self, cohort: np.ndarray):
         pad = (-len(cohort)) % self.axis_size

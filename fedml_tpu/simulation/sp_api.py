@@ -641,23 +641,70 @@ class FedAvgAPI:
         if self.scaffold:
             self.c_global = restored["c_global"]
             self.c_locals = restored["c_locals"]
+        telemetry.counter_inc("run.resumes")
         logger.info("sp engine: resumed federation at round %d", step + 1)
         return step + 1
 
+    def _ledger_world(self) -> Dict[str, Any]:
+        """Run-identity fields pinned into the ledger's run_meta line; the
+        mesh engine extends this with its device topology so a resumed run
+        on a mismatched mesh fails loudly instead of silently resharding."""
+        return {
+            "engine": type(self).__name__,
+            "optimizer": self.opt_name,
+            "client_num_in_total": int(self.ds.client_num),
+            "client_num_per_round": int(self.args.client_num_per_round),
+        }
+
     def train(self) -> Dict[str, float]:
-        from ..core import mlops
+        from ..core import mlops, runstate
 
         rounds = int(self.args.comm_round)
         freq = max(int(getattr(self.args, "frequency_of_the_test", 5)), 1)
         ckpt = None
+        ledger = None
+        guard = None
         start_round = 0
         ckpt_dir = str(getattr(self.args, "checkpoint_dir", "") or "")
-        every = int(getattr(self.args, "checkpoint_every_rounds", 1) or 1)
+        every = runstate.checkpoint_cadence(self.args)
+        mode = runstate.resume_mode(self.args)
         if ckpt_dir:
             from ..checkpoint import CheckpointManager
 
             ckpt = CheckpointManager(ckpt_dir)
+            if mode == "never" and ckpt.latest_step() is not None:
+                raise RuntimeError(
+                    f"--resume never, but {ckpt_dir} already holds a "
+                    f"checkpoint (step {ckpt.latest_step()}) — point at a "
+                    "fresh checkpoint_dir or use --resume auto"
+                )
+            if mode == "require" and ckpt.latest_step() is None:
+                raise RuntimeError(
+                    f"--resume require, but {ckpt_dir} holds no checkpoint "
+                    "to resume from"
+                )
             start_round = self._maybe_resume(ckpt)
+            ledger = runstate.RunLedger.for_checkpoint_dir(ckpt_dir)
+            ledger.ensure_meta(
+                seed=int(getattr(self.args, "random_seed", 0)),
+                world=self._ledger_world(),
+            )
+            last_committed = ledger.last_round()
+            if last_committed is not None \
+                    and last_committed != start_round - 1:
+                logger.warning(
+                    "run ledger %s ends at round %d but the checkpoint "
+                    "resumes at round %d — ledger history may be from an "
+                    "uncommitted crash window", ledger.path, last_committed,
+                    start_round,
+                )
+            # preemption-safe drain: SIGTERM/SIGINT latches, the in-flight
+            # chunk finishes, checkpoint + ledger commit, and train raises
+            # PreemptionError (exit EXIT_PREEMPTED at the CLI)
+            guard = runstate.preemption_guard()
+            if bool(getattr(self.args, "preempt_signals", True)):
+                guard.install()
+            guard.reset()
         last_eval: Dict[str, float] = {}
         try:
             if start_round >= rounds:
@@ -668,6 +715,7 @@ class FedAvgAPI:
                 )
                 return last_eval
             round_idx = start_round
+            pending: List[tuple] = []  # (round, cohort) awaiting a commit
             while round_idx < rounds:
                 k = self._chunk_len(round_idx, rounds, freq,
                                     every if ckpt is not None else 0)
@@ -711,11 +759,39 @@ class FedAvgAPI:
                         last_round, last_eval["test_loss"],
                         last_eval["test_acc"], dt / k,
                     )
+                if ledger is not None:
+                    # cohorts are host-sampled per round except under a
+                    # superround scan (on-device sampling) — deterministic
+                    # either way, but only the host path is recordable
+                    for j in range(round_idx, last_round + 1):
+                        pending.append((
+                            j,
+                            None if k > 1
+                            else [int(c) for c in self._client_sampling(j)],
+                        ))
                 if ckpt is not None and (
                     (last_round + 1) % every == 0 or last_round == rounds - 1
                 ):
-                    ckpt.save(self._ckpt_state(), step=last_round)
+                    step = ckpt.save(self._ckpt_state(), step=last_round)
+                    for r, cohort in pending:
+                        ledger.commit_round(r, ckpt_step=step, cohort=cohort)
+                    pending.clear()
                 round_idx += k
+                if guard is not None and guard.requested() \
+                        and round_idx < rounds:
+                    from ..core.runstate import PreemptionError
+
+                    # drain commit: the chunk above completed; persist its
+                    # state NOW (even off the checkpoint cadence) so the
+                    # restart resumes exactly here instead of re-training
+                    if ckpt.latest_step() != last_round:
+                        step = ckpt.save(self._ckpt_state(), step=last_round)
+                        for r, cohort in pending:
+                            ledger.commit_round(r, ckpt_step=step,
+                                                cohort=cohort)
+                        pending.clear()
+                    telemetry.counter_inc("run.preemptions")
+                    raise PreemptionError(last_round)
         finally:
             if ckpt is not None:  # release Orbax threads even on a crash
                 ckpt.close()

@@ -1,0 +1,158 @@
+"""Chaos soak: faults + mid-run SIGTERM/restart must reproduce the
+fault-free run bitwise (ISSUE 4 acceptance criteria).
+
+Two layers:
+
+- in-process: the full fault matrix (visible loss + duplication +
+  corruption) without a kill — fast, exercises retry/dedup/checksum
+  end-to-end;
+- subprocess: the REAL preemption path — ``fedml_tpu chaos --worker``
+  SIGTERMs itself after the ledger commits round R, exits with
+  EXIT_PREEMPTED (75), restarts with ``--resume auto``, and the combined
+  run must match the fault-free reference bitwise with the ledger streams
+  diffing clean.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from fedml_tpu import chaos
+from fedml_tpu.core.runstate import EXIT_PREEMPTED, RunLedger
+
+
+def _cfg(tmp_path, **kw):
+    a = types.SimpleNamespace(
+        clients=2, rounds=4, epochs=1, seed=7, loss=0.1, duplicate=0.2,
+        corrupt=0.2, kill_round=1, checkpoint_rounds=1,
+        workdir=str(tmp_path), timeout=240.0, worker=False, out="",
+        checkpoint_dir="",
+    )
+    for k, v in kw.items():
+        setattr(a, k, v)
+    return a
+
+
+def _run_leg(tmp_path, a, out, ckpt, kill_round):
+    cmd = chaos._worker_cmd(a, out, ckpt, kill_round)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        cmd, timeout=240, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(chaos.__file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    return proc
+
+
+class TestChaosInProcess:
+    def test_fault_matrix_parity_bitwise(self, tmp_path):
+        """Seeded loss + duplication + corruption on every client link:
+        final global params bitwise-equal to the fault-free run, and no
+        contribution counted twice."""
+        a = _cfg(tmp_path)
+        ref = chaos.run_world(
+            a, run_id=f"chaosref-{os.getpid()}-a",
+            checkpoint_dir=str(tmp_path / "ref_ckpt"), faulty=False)
+        noisy = chaos.run_world(
+            a, run_id=f"chaosnoisy-{os.getpid()}-b",
+            checkpoint_dir=str(tmp_path / "noisy_ckpt"), faulty=True)
+        assert len(ref["params"]) == len(noisy["params"])
+        for i, (x, y) in enumerate(zip(ref["params"], noisy["params"])):
+            assert x.dtype == y.dtype and np.array_equal(x, y), \
+                f"leaf {i} diverged under faults"
+        for rnd, per in noisy["server"].contrib_counts.items():
+            assert sorted(per) == [1, 2], (rnd, per)
+            assert all(v == 1 for v in per.values()), (rnd, per)
+
+
+class TestChaosKillRestart:
+    def test_sigterm_resume_bitwise_parity_and_ledger_diff(self, tmp_path):
+        """kill -TERM during round R (timed off the durable ledger commit),
+        restart with --resume auto: the resumed run starts at exactly the
+        committed round + 1, re-uses the recorded history, and finishes
+        bitwise-identical to the fault-free run."""
+        a = _cfg(tmp_path)
+        ref = chaos.run_world(
+            a, run_id=f"chaoskref-{os.getpid()}",
+            checkpoint_dir=str(tmp_path / "ref_ckpt"), faulty=False)
+
+        out = str(tmp_path / "out")
+        ckpt = str(tmp_path / "chaos_ckpt")
+        p1 = _run_leg(tmp_path, a, out, ckpt, kill_round=1)
+        assert p1.returncode == EXIT_PREEMPTED, (
+            f"expected preempted exit {EXIT_PREEMPTED}, got "
+            f"{p1.returncode}:\n{p1.stdout.decode(errors='replace')[-3000:]}"
+        )
+        with open(os.path.join(out, chaos.REPORT_FILE)) as f:
+            report1 = json.load(f)
+        assert report1["preempted"] is True
+
+        ledger = RunLedger.for_checkpoint_dir(ckpt)
+        committed = ledger.last_round()
+        assert committed is not None and committed >= 1
+
+        p2 = _run_leg(tmp_path, a, out, ckpt, kill_round=-1)
+        assert p2.returncode == 0, \
+            p2.stdout.decode(errors="replace")[-3000:]
+        with open(os.path.join(out, chaos.REPORT_FILE)) as f:
+            report2 = json.load(f)
+        assert report2["preempted"] is False
+        assert report2["round_idx"] == a.rounds
+
+        # resumed at exactly committed+1: the resumed process only
+        # aggregated rounds it actually ran
+        resumed_rounds = sorted(int(r) for r in report2["contrib_counts"])
+        assert resumed_rounds[0] == committed + 1
+        assert resumed_rounds[-1] == a.rounds - 1
+        for rnd, per in report2["contrib_counts"].items():
+            assert all(v == 1 for v in per.values()), (rnd, per)
+
+        # bitwise parity with the fault-free reference
+        with np.load(os.path.join(out, chaos.FINAL_PARAMS_FILE)) as z:
+            chaos_params = [z[k] for k in z.files]
+        assert len(chaos_params) == len(ref["params"])
+        for i, (x, y) in enumerate(zip(ref["params"], chaos_params)):
+            assert x.dtype == y.dtype and np.array_equal(x, y), \
+                f"leaf {i} not bitwise equal after kill+resume"
+
+        # RoundRecord JSONL stream diff: newest record per round in the
+        # killed+resumed ledger must equal the fault-free run's stream on
+        # (round, cohort), covering every round exactly once
+        ref_ledger = RunLedger.for_checkpoint_dir(str(tmp_path / "ref_ckpt"))
+        ref_stream = {r["round"]: r["cohort"] for r in ref_ledger.rounds()}
+        stream = {}
+        for r in ledger.rounds():
+            stream[r["round"]] = r["cohort"]  # newest wins
+        assert stream == ref_stream
+        assert sorted(stream) == list(range(a.rounds))
+        # and the chaos run's combined ledger counted nobody twice
+        for r in ledger.rounds():
+            for client, count in (r.get("contrib") or {}).items():
+                assert count == 1, (r["round"], client, count)
+
+
+@pytest.mark.slow
+class TestChaosCLI:
+    def test_chaos_cli_end_to_end(self, tmp_path):
+        """The full `fedml_tpu chaos` orchestrator (what
+        tools/chaos_smoke.sh runs in CI)."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "fedml_tpu.cli", "chaos",
+             "--clients", "2", "--rounds", "3", "--seed", "7",
+             "--loss", "0.1", "--duplicate", "0.2", "--corrupt", "0.2",
+             "--kill-round", "0", "--workdir", str(tmp_path)],
+            timeout=300, env=env,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(chaos.__file__))),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        assert proc.returncode == 0, proc.stderr.decode(
+            errors="replace")[-3000:]
+        verdict = json.loads(proc.stdout.decode())
+        assert verdict["ok"] and verdict["parity"], verdict

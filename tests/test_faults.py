@@ -134,3 +134,66 @@ class TestFaultRecovery:
         for c in clients:
             if c.manager.rank != 2:
                 assert c.manager.done.wait(timeout=30)
+
+
+class TestDeadlineRaces:
+    """Satellite (ISSUE 4): the two cross-silo deadline races, driven by
+    deterministic FaultPlan delays — no sleeps in the asserts; the only
+    timing is the injected link latency itself.
+
+    The races live in server_manager._on_round_timeout vs
+    _on_model_received: a model landing exactly at the deadline must end up
+    EITHER inside the closing round or cleanly dropped-then-revived — never
+    double-counted, never wedging the round. The per-round contribution
+    counters (aggregation-side) are the oracle."""
+
+    def test_straggler_at_exact_timeout_boundary(self):
+        """Client 3's round-0 model is delayed by EXACTLY round_timeout —
+        the model-arrival and deadline callbacks race. Whichever side wins
+        (counted into the closing round; dropped-then-revived into round 1;
+        or dropped with the revival landing after the short run ended), the
+        invariants hold: every round aggregates exactly once per client,
+        the always-on-time clients are in every round, and the federation
+        neither wedges nor double-counts."""
+        timeout = 3.0
+        plans = {3: FaultPlan().delay(timeout, sender=3, round_idx=0)}
+        result, server, clients = run_faulty_world(
+            "race-exact", plans, round_timeout=timeout,
+        )
+        m = server.manager
+        assert m.round_idx == 2
+        assert sorted(m.contrib_counts) == [0, 1]  # each round ONCE
+        for rnd, per in m.contrib_counts.items():
+            assert all(v == 1 for v in per.values()), (rnd, per)
+            assert {1, 2} <= set(per) <= {1, 2, 3}, (rnd, per)
+        assert result is not None and result["test_acc"] > 0.4
+
+    def test_dropped_client_revival_is_exactly_once(self):
+        """Client 3's round-0 model arrives long after the deadline: the
+        round closes without it (dropped), the late round-0 model is
+        rejected as stale, and its on-time round-1 model revives it.
+        Clients 1/2 are slowed in round 1 so 3's revival model provably
+        lands while the round is open."""
+        # deadline sized like the other load-safe tests here (6 s): the
+        # on-time clients' round-0 models must land inside it even when a
+        # parallel suite run starves the host core
+        timeout = 6.0
+        plans = {
+            3: FaultPlan().delay(2 * timeout + 2.0, sender=3, round_idx=0),
+            1: FaultPlan().delay(1.0, sender=1, round_idx=1),
+            2: FaultPlan().delay(1.0, sender=2, round_idx=1),
+        }
+        result, server, clients = run_faulty_world(
+            "race-revive", plans, round_timeout=timeout,
+        )
+        m = server.manager
+        assert m.round_idx == 2
+        # round 0 closed WITHOUT client 3 — its model was still in flight
+        assert sorted(m.contrib_counts.get(0, {})) == [1, 2]
+        # round 1 revived it, exactly once; the stale round-0 model that
+        # eventually arrived must not appear anywhere
+        assert sorted(m.contrib_counts.get(1, {})) == [1, 2, 3]
+        for rnd, per in m.contrib_counts.items():
+            assert all(v == 1 for v in per.values()), (rnd, per)
+        assert 3 not in m._dead  # revived, not permanently excluded
+        assert result is not None and result["test_acc"] > 0.4

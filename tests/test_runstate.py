@@ -1,0 +1,255 @@
+"""Crash-safe rounds: durable run ledger, resume modes, preemption drain.
+
+The invariant under test (ISSUE 4 tentpole): kill the process anywhere,
+restart with --resume auto, and the federation converges to the SAME params
+as an uninterrupted run — with the ledger as the auditable round history.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import fedml_tpu as fedml
+from fedml_tpu import data as data_mod
+from fedml_tpu import models as model_mod
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.core import runstate
+from fedml_tpu.core.runstate import (
+    EXIT_PREEMPTED,
+    PreemptionError,
+    RunLedger,
+    checkpoint_cadence,
+    preemption_guard,
+    resume_mode,
+)
+
+
+class TestLedger:
+    def test_commit_and_read_back(self, tmp_path):
+        led = RunLedger.for_checkpoint_dir(str(tmp_path))
+        led.ensure_meta(seed=3, world={"engine": "X"})
+        led.commit_round(0, ckpt_step=0, cohort=[2, 1], contrib={"1": 1})
+        led.commit_round(1, ckpt_step=1, cohort=None)
+        assert led.last_round() == 1
+        assert led.cohort_for(0) == [2, 1]
+        assert led.cohort_for(1) is None
+        rounds = led.rounds()
+        assert [r["round"] for r in rounds] == [0, 1]
+        assert rounds[0]["contrib"] == {"1": 1}
+        assert led.meta()["seed"] == 3
+
+    def test_torn_tail_is_dropped(self, tmp_path):
+        led = RunLedger.for_checkpoint_dir(str(tmp_path))
+        led.commit_round(0, ckpt_step=0, cohort=[0])
+        led.commit_round(1, ckpt_step=1, cohort=[1])
+        with open(led.path, "a") as f:
+            f.write('{"kind":"round","round":2,"ckpt_')  # kill -9 mid-write
+        fresh = RunLedger(led.path)
+        assert fresh.last_round() == 1
+        # and a checksum-corrupted line (bit rot) also ends the prefix
+        lines = open(led.path).read().splitlines()[:2]
+        lines[1] = lines[1].replace('"round":1', '"round":9')
+        with open(led.path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        assert RunLedger(led.path).last_round() == 0
+
+    def test_meta_mismatch_raises(self, tmp_path):
+        led = RunLedger.for_checkpoint_dir(str(tmp_path))
+        led.ensure_meta(seed=1, world={"clients": 4})
+        led.ensure_meta(seed=1, world={"clients": 4})  # same run: fine
+        with pytest.raises(RuntimeError, match="different federation"):
+            RunLedger.for_checkpoint_dir(str(tmp_path)).ensure_meta(
+                seed=2, world={"clients": 4}
+            )
+
+    def test_appends_survive_across_instances(self, tmp_path):
+        """A restarted process appends to the same ledger — the combined
+        stream is one run history."""
+        RunLedger.for_checkpoint_dir(str(tmp_path)).commit_round(
+            0, ckpt_step=0, cohort=[1])
+        RunLedger.for_checkpoint_dir(str(tmp_path)).commit_round(
+            1, ckpt_step=1, cohort=[2])
+        assert [r["round"] for r in
+                RunLedger.for_checkpoint_dir(str(tmp_path)).rounds()] == [0, 1]
+
+
+class TestKnobs:
+    def test_resume_mode_normalization(self):
+        class A:
+            pass
+
+        a = A()
+        for raw, want in [("auto", "auto"), ("", "auto"), (True, "auto"),
+                          (False, "never"), ("never", "never"),
+                          ("require", "require"), ("REQUIRE", "require")]:
+            a.resume = raw
+            assert resume_mode(a) == want, raw
+        a.resume = "sometimes"
+        with pytest.raises(ValueError):
+            resume_mode(a)
+
+    def test_checkpoint_cadence_alias(self):
+        class A:
+            pass
+
+        a = A()
+        assert checkpoint_cadence(a) == 1
+        a.checkpoint_every_rounds = 4
+        assert checkpoint_cadence(a) == 4
+        a.checkpoint_rounds = 2  # the preferred knob wins
+        assert checkpoint_cadence(a) == 2
+
+    def test_exit_code_is_tempfail(self):
+        assert EXIT_PREEMPTED == 75  # EX_TEMPFAIL: "transient, retry me"
+
+
+def _sp_api(tmp_path, rounds, **kw):
+    from fedml_tpu.simulation.sp_api import FedAvgAPI
+
+    overrides = dict(
+        dataset="synthetic", model="lr", client_num_in_total=16,
+        client_num_per_round=8, comm_round=rounds, epochs=1,
+        batch_size=16, learning_rate=0.1, frequency_of_the_test=100,
+        preempt_signals=False,
+    )
+    overrides.update(kw)
+    if tmp_path is not None:
+        overrides.setdefault("checkpoint_dir", str(tmp_path / "ckpt"))
+    args = fedml.init(Arguments(overrides=overrides), should_init_logs=False)
+    ds, od = data_mod.load(args)
+    return FedAvgAPI(args, fedml.get_device(args), ds,
+                     model_mod.create(args, od))
+
+
+def _leaves(api):
+    import jax
+
+    return [np.asarray(l) for l in jax.tree.leaves(api.global_params)]
+
+
+class TestPreemptionDrain:
+    """SIGTERM mid-run (here: the programmatic latch) must drain the
+    in-flight chunk, commit checkpoint + ledger, raise PreemptionError —
+    and the resumed run must finish BITWISE identical to an uninterrupted
+    one."""
+
+    def test_sp_preempt_resume_bitwise_parity(self, tmp_path):
+        ref = _sp_api(None, rounds=6)
+        ref.train()
+        ref_params = _leaves(ref)
+
+        api1 = _sp_api(tmp_path, rounds=6, checkpoint_rounds=2)
+        orig = api1.run_round
+
+        def hooked(r):
+            out = orig(r)
+            if r == 2:
+                preemption_guard().request()
+            return out
+
+        api1.run_round = hooked
+        preemption_guard().reset()
+        with pytest.raises(PreemptionError) as ei:
+            api1.train()
+        assert ei.value.last_round == 2
+        preemption_guard().reset()
+
+        # the drain committed OFF the cadence: rounds 0..2 are durable
+        led = RunLedger.for_checkpoint_dir(str(tmp_path / "ckpt"))
+        assert led.last_round() == 2
+
+        api2 = _sp_api(tmp_path, rounds=6, checkpoint_rounds=2)
+        api2.train()
+        assert [e["round"] for e in api2.history] == [3, 4, 5]
+        for a, b in zip(ref_params, _leaves(api2)):
+            assert a.dtype == b.dtype and np.array_equal(a, b), \
+                "resumed params differ from the uninterrupted run"
+
+        # ledger-stream diff vs an uninterrupted CHECKPOINTED run: the
+        # per-round cohorts must be identical (the recorded cohort is what
+        # the resumed run re-used — sampling is round-keyed)
+        ref2_dir = tmp_path / "ref2"
+        ref2 = _sp_api(ref2_dir, rounds=6, checkpoint_rounds=2,
+                       checkpoint_dir=str(ref2_dir / "ckpt"))
+        ref2.train()
+        led_ref = RunLedger.for_checkpoint_dir(str(ref2_dir / "ckpt"))
+        stream = {r["round"]: r["cohort"] for r in led.rounds()}
+        stream_ref = {r["round"]: r["cohort"] for r in led_ref.rounds()}
+        assert stream == stream_ref
+        assert sorted(stream) == list(range(6))
+
+    def test_superround_chunker_aligns_to_checkpoint_cadence(self, tmp_path):
+        """Superround scan boundaries must align to the checkpoint cadence
+        so a preemption commit lands on a scanned-chunk boundary — resume
+        parity vs an uninterrupted superround run, bitwise."""
+        ref = _sp_api(None, rounds=6, superround_k=2,
+                      client_num_per_round=16)
+        ref.train()
+        ref_params = _leaves(ref)
+
+        api1 = _sp_api(tmp_path, rounds=6, superround_k=2,
+                       client_num_per_round=16, checkpoint_rounds=2)
+        orig = api1.run_rounds
+
+        def hooked(start, k):
+            out = orig(start, k)
+            if start == 2:
+                preemption_guard().request()
+            return out
+
+        api1.run_rounds = hooked
+        preemption_guard().reset()
+        with pytest.raises(PreemptionError) as ei:
+            api1.train()
+        assert ei.value.last_round == 3  # chunks [0,1][2,3] committed
+        preemption_guard().reset()
+
+        api2 = _sp_api(tmp_path, rounds=6, superround_k=2,
+                       client_num_per_round=16, checkpoint_rounds=2)
+        api2.train()
+        for a, b in zip(ref_params, _leaves(api2)):
+            assert a.dtype == b.dtype and np.array_equal(a, b)
+
+    def test_preempt_exit_code_contract(self, tmp_path):
+        """PreemptionError carries the committed round; callers map it to
+        EXIT_PREEMPTED (75) — asserted end-to-end by test_chaos.py."""
+        api = _sp_api(tmp_path, rounds=3)
+        orig = api.run_round
+
+        def hooked(r):
+            out = orig(r)
+            preemption_guard().request()
+            return out
+
+        api.run_round = hooked
+        preemption_guard().reset()
+        with pytest.raises(PreemptionError) as ei:
+            api.train()
+        preemption_guard().reset()
+        assert ei.value.last_round == 0
+        assert str(EXIT_PREEMPTED) in str(ei.value)
+
+
+class TestResumeModes:
+    def test_resume_never_demands_fresh_dir(self, tmp_path):
+        api1 = _sp_api(tmp_path, rounds=2)
+        api1.train()
+        with pytest.raises(RuntimeError, match="resume never"):
+            _sp_api(tmp_path, rounds=4, resume="never").train()
+
+    def test_resume_require_demands_checkpoint(self, tmp_path):
+        with pytest.raises(RuntimeError, match="resume require"):
+            _sp_api(tmp_path, rounds=2, resume="require").train()
+        # and with a checkpoint present it resumes normally
+        _sp_api(tmp_path, rounds=2).train()
+        api = _sp_api(tmp_path, rounds=4, resume="require")
+        api.train()
+        assert [e["round"] for e in api.history] == [2, 3]
+
+    def test_mesh_world_mismatch_is_loud(self, tmp_path):
+        """A ledger written by one world must refuse a different one (the
+        mesh engine pins its topology through the same run_meta path)."""
+        _sp_api(tmp_path, rounds=2).train()
+        with pytest.raises(RuntimeError, match="different federation"):
+            _sp_api(tmp_path, rounds=2, random_seed=99).train()

@@ -81,9 +81,16 @@ with open(metrics_path) as f:
         samples += 1
 assert samples > 0, "metrics exposition is empty"
 
+# resume-overhead probe (BENCH_RESUME defaults on under BENCH_SMOKE):
+# restart-to-first-dispatch must be present and sane so checkpoint-cadence
+# tuning stays data-driven (docs/robustness.md)
+assert "fedavg_resume_overhead_s" in line, f"no resume probe in line: {line}"
+assert 0 < line["fedavg_resume_overhead_s"] < 120, line
+
 print("bench_smoke: OK —",
       f"{line['fedavg_cpu_smoke_rounds_per_sec']:.2f} rounds/s,",
       f"compile {line.get('fedavg_compile_s', '?')}s,",
       f"fused={line.get('fedavg_round_fused')},",
+      f"resume {line['fedavg_resume_overhead_s']:.2f}s,",
       f"{len(records)} round records, {samples} metric samples")
 EOF

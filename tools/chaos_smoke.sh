@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# CI smoke for the crash-recovery plane (fedml_tpu/chaos.py): a loopback
+# cross-silo federation under a seeded fault matrix — 10% visible message
+# loss + 20% wire duplication + 20% payload corruption + one mid-run
+# self-SIGTERM — restarted with --resume auto, must produce final global
+# params BITWISE EQUAL to a fault-free reference run, with no client
+# contribution counted twice (per-round contribution counters from the
+# durable run ledger).
+#
+# This is the executable form of the robustness contract in
+# docs/robustness.md; tests/test_chaos.py is the fine-grained half.
+#
+# Usage: tools/chaos_smoke.sh          (CI: exits non-zero on any regression)
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+workdir=$(mktemp -d /tmp/fedml_chaos_smoke.XXXXXX)
+trap 'rm -rf "$workdir"' EXIT
+
+out=$(timeout -k 10 300 env JAX_PLATFORMS=cpu python -m fedml_tpu.cli chaos \
+    --clients 2 --rounds 4 --seed 7 \
+    --loss 0.1 --duplicate 0.2 --corrupt 0.2 \
+    --kill-round 1 --workdir "$workdir" 2>/dev/null)
+rc=$?
+
+if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    echo "chaos_smoke: FAIL — harness hit the hard timeout (rc=$rc)" >&2
+    exit 1
+fi
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL — chaos harness exited rc=$rc" >&2
+    printf '%s\n' "$out" >&2
+    exit 1
+fi
+
+python - "$out" <<'EOF'
+import json
+import sys
+
+verdict = json.loads(sys.argv[1])
+assert verdict["ok"], verdict["problems"]
+assert verdict["parity"], verdict["problems"]
+print("chaos_smoke: OK —",
+      f"{verdict['rounds']} rounds x {verdict['clients']} clients,",
+      f"faults={verdict['fault_matrix']},",
+      f"preemption_exercised={verdict['preemption_exercised']}")
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL — verdict did not validate" >&2
+    exit 1
+fi
+echo "chaos_smoke: PASS"
