@@ -326,25 +326,35 @@ def _report_cache_telemetry(run_file: str) -> None:
 
 
 def cmd_lint(args) -> int:
-    """Run graftlint (tools/graftlint), the JAX-aware static analyzer, over
-    the tree — trace-safety (G001), donation (G002), recompile (G003),
-    purity (G004) and thread-safety (G005) linting. Shells into the same
-    entry point CI uses (``python -m tools.graftlint``), anchored at the
-    repo root so results are identical from any cwd."""
+    """Run the static-analysis suites over the tree. Default: graftlint
+    (tools/graftlint) — trace-safety (G001), donation (G002), recompile
+    (G003), purity (G004) and thread-safety (G005). ``--proto``: graftproto
+    (tools/graftproto) — message-flow graph (P001–P003), FSM replay/
+    termination (P004/P005), delivery invariants (P006/P007) and lock-order
+    analysis (P008/P009). Shells into the same entry points CI uses,
+    anchored at the repo root so results are identical from any cwd.
+
+    Exit codes (both suites): 0 clean, 1 findings, 2 the analyzer itself
+    crashed (or usage error) — CI failures are diagnosable at a glance."""
     import subprocess
 
+    suite = "graftproto" if getattr(args, "proto", False) else "graftlint"
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    if not os.path.isdir(os.path.join(repo_root, "tools", "graftlint")):
-        print("fedml_tpu lint: tools/graftlint not found next to the "
+    if not os.path.isdir(os.path.join(repo_root, "tools", suite)):
+        print(f"fedml_tpu lint: tools/{suite} not found next to the "
               f"package (looked in {repo_root}) — run from a source checkout")
         return 2
     # absolutize user paths: the subprocess runs with cwd=repo_root, which
     # would otherwise re-resolve relative paths against the wrong directory
     paths = [os.path.abspath(p) for p in args.paths] or ["fedml_tpu"]
-    cmd = [sys.executable, "-m", "tools.graftlint", *paths]
+    cmd = [sys.executable, "-m", f"tools.{suite}", *paths]
     if args.format != "text":
         cmd += ["--format", args.format]
     if args.runtime:
+        if suite == "graftproto":
+            print("fedml_tpu lint: --runtime is a graftlint pass (jaxpr "
+                  "purity); it does not combine with --proto")
+            return 2
         cmd.append("--runtime")
     return subprocess.call(cmd, cwd=repo_root)
 
@@ -456,11 +466,17 @@ def main(argv=None) -> int:
                          "(default: newest run)")
 
     p_lint = sub.add_parser(
-        "lint", help="run graftlint (JAX-aware static analysis) over the tree"
+        "lint",
+        help="run static analysis over the tree (graftlint; --proto for "
+        "the comm-plane protocol suite)",
     )
     p_lint.add_argument("paths", nargs="*", default=[],
                         help="files/dirs to lint (default: fedml_tpu)")
     p_lint.add_argument("--format", choices=("text", "json"), default="text")
+    p_lint.add_argument("--proto", action="store_true",
+                        help="run graftproto (message-flow graph, FSM "
+                        "replay/termination, delivery invariants, lock "
+                        "order) instead of graftlint")
     p_lint.add_argument("--runtime", action="store_true",
                         help="also trace the round engine under jax.make_jaxpr")
 

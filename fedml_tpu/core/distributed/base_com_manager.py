@@ -45,6 +45,12 @@ class CommunicationConstants:
     """reference: communication/constants.py:1-11."""
 
     MSG_TYPE_CONNECTION_IS_READY = "connection_ready"
+    # the client liveness-status type lives HERE (not only in the cross-silo
+    # message_define) because the transport layer itself speaks it: the MQTT
+    # last-will publishes an OFFLINE status on the sender's behalf, and the
+    # transport must not import FSM-layer protocol modules (graftproto P003
+    # pins every use site to a define-class constant)
+    MSG_TYPE_CLIENT_STATUS = "c2s_client_status"
     MSG_CLIENT_STATUS_OFFLINE = "OFFLINE"
     MSG_CLIENT_STATUS_IDLE = "IDLE"
     GRPC_BASE_PORT = 8890

@@ -24,6 +24,7 @@ import numpy as np
 
 from ... import constants
 from ..alg_frame import Params
+from .base_com_manager import CommunicationConstants
 from .comm_manager import FedMLCommManager
 from .message import Message
 
@@ -96,7 +97,8 @@ class FedMLAlgorithmFlow(FedMLCommManager):
     # -- handlers ------------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
-            "connection_ready", self._on_connection_ready
+            CommunicationConstants.MSG_TYPE_CONNECTION_IS_READY,
+            self._on_connection_ready,
         )
         self.register_message_receive_handler(self.MSG_TYPE_READY, self._on_ready)
         self.register_message_receive_handler(self.MSG_TYPE_FLOW, self._on_flow)

@@ -80,9 +80,10 @@ class MqttCommManager(BaseCommunicationManager):
         # MQTT last-will: the broker publishes OFFLINE for us if we vanish —
         # the server's liveness handler treats it like a graceful departure
         will = Message(
-            "c2s_client_status", self.rank, 0
+            CommunicationConstants.MSG_TYPE_CLIENT_STATUS, self.rank, 0
         )
-        will.add("client_status", "OFFLINE")
+        will.add(Message.MSG_ARG_KEY_CLIENT_STATUS,
+                 CommunicationConstants.MSG_CLIENT_STATUS_OFFLINE)
         self._client.will_set(
             self._topic(0), base64.b64encode(will.serialize()), qos=qos,
             retain=False,

@@ -132,11 +132,24 @@ class RunLedger:
             {k: v for k, v in payload.items() if k != "sha"}
         )
         line = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+        # write+flush under the lock (append order stays serialized), fsync
+        # OUTSIDE it (graftproto P009): fsync can stall for tens of ms on a
+        # busy disk and the comm/FSM thread must not hold the ledger lock
+        # through it. fsync on the still-open fd durably covers this line
+        # (and anything a concurrent appender wrote after it) before
+        # commit_round returns, so the durability contract is unchanged.
         with self._lock:
-            with open(self.path, "a", encoding="utf-8") as f:
+            f = open(self.path, "a", encoding="utf-8")
+            try:
                 f.write(line + "\n")
                 f.flush()
-                os.fsync(f.fileno())
+            except Exception:
+                f.close()
+                raise
+        try:
+            os.fsync(f.fileno())
+        finally:
+            f.close()
 
     def ensure_meta(self, **meta: Any) -> Dict[str, Any]:
         """Write the run_meta head line once; return the (existing or new)

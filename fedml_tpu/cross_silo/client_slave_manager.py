@@ -64,7 +64,18 @@ class ClientSlaveManager(FedMLCommManager):
         self.trainer.set_model_params(jax.tree.unflatten(self._treedef, leaves))
 
     def _on_sync(self, msg: Message) -> None:
-        self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0))
+        round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0))
+        # replay guard (graftproto P004): the master broadcasts each round
+        # once and rounds only advance, so a SYNC for an OLDER round is a
+        # delayed/replayed frame — retraining it would waste the slave and
+        # ship a result the master's staleness check discards anyway
+        if round_idx < self.round_idx:
+            logger.info(
+                "silo slave %d: stale SILO_SYNC for round %d ignored "
+                "(already at round %d)", self.rank, round_idx, self.round_idx,
+            )
+            return
+        self.round_idx = round_idx
         self._install_params(msg)
         self.args.round_idx = self.round_idx
         # this slave's sub-shard: the silo's client shard is range-split by

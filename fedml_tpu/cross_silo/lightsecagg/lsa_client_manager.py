@@ -65,7 +65,18 @@ class LightSecAggClientManager(FedMLCommManager):
 
     # -- round ---------------------------------------------------------------
     def _on_init_or_sync(self, msg: Message) -> None:
-        self.round_idx = int(msg.get(LSAMessage.ARG_ROUND_IDX, 0))
+        round_idx = int(msg.get(LSAMessage.ARG_ROUND_IDX, 0))
+        # replay guard (graftproto P004): the server's round only advances,
+        # so an INIT/SYNC for an OLDER round is a delayed/replayed frame —
+        # adopting it would rewind round_idx and poison the (round, src)
+        # share bookkeeping for the round actually in flight
+        if round_idx < self.round_idx:
+            logger.info(
+                "lsa client %d: stale sync for round %d ignored (already "
+                "at round %d)", self.rank, round_idx, self.round_idx,
+            )
+            return
+        self.round_idx = round_idx
         leaves = [jnp.asarray(a) for a in msg.get_arrays()]
         if self._treedef is None:
             skeleton = self.trainer.model.init(

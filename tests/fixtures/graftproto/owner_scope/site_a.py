@@ -1,0 +1,21 @@
+"""Owner-scoping fixture, package A: the define class is named MyMessage —
+exactly like package B's — but carries A's own wire values."""
+
+
+class MyMessage:
+    MSG_TYPE_S2C_GO = "a_go"
+
+
+class ServerManagerA:
+    def _drive(self):
+        self.send_message(Message(MyMessage.MSG_TYPE_S2C_GO, 0, 1))
+
+
+class ClientManagerA:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_GO, self._on_go
+        )
+
+    def _on_go(self, msg):
+        self.finish()

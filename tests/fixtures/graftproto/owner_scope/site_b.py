@@ -1,0 +1,22 @@
+"""Owner-scoping fixture, package B: a same-named define class with a
+same-named attribute bound to a DIFFERENT wire value. Each module must
+resolve MyMessage against its own class, never a bare-name merge."""
+
+
+class MyMessage:
+    MSG_TYPE_S2C_GO = "b_go"
+
+
+class ServerManagerB:
+    def _drive(self):
+        self.send_message(Message(MyMessage.MSG_TYPE_S2C_GO, 0, 1))
+
+
+class ClientManagerB:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_GO, self._on_go
+        )
+
+    def _on_go(self, msg):
+        self.finish()

@@ -1,0 +1,43 @@
+"""P001 fixture: a sent-but-never-handled type + a wrong-role registration."""
+
+
+class Defines:
+    MSG_TYPE_C2S_UPLOAD = "c2s_upload"
+    MSG_TYPE_C2S_STATUS = "c2s_status"
+    MSG_TYPE_S2C_ORPHAN = "s2c_orphan"
+    MSG_TYPE_S2C_FINISH = "s2c_finish"
+
+
+class ServerManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_C2S_UPLOAD, self._on_upload
+        )
+
+    def _on_upload(self, msg):
+        # line 19: S2C_ORPHAN has no handler anywhere -> P001
+        self.send_message(Message(Defines.MSG_TYPE_S2C_ORPHAN, 0, 1))
+        self.send_message(Message(Defines.MSG_TYPE_S2C_FINISH, 0, 1))
+        self.finish()
+
+
+class ClientManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_S2C_FINISH, self._on_finish
+        )
+        # line 30: a C2S type registered ONLY on a client manager -> P001
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_C2S_STATUS, self._on_status
+        )
+
+    def _on_status(self, msg):
+        pass
+
+    def _on_finish(self, msg):
+        self.done.set()
+        self.finish()
+
+    def _report(self):
+        self.send_message(Message(Defines.MSG_TYPE_C2S_UPLOAD, 1, 0))
+        self.send_message(Message(Defines.MSG_TYPE_C2S_STATUS, 1, 0))

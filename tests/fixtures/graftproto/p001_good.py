@@ -1,0 +1,31 @@
+"""P001 good twin: every sent type is handled, on the right role."""
+
+
+class Defines:
+    MSG_TYPE_C2S_UPLOAD = "c2s_upload"
+    MSG_TYPE_S2C_FINISH = "s2c_finish"
+
+
+class ServerManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_C2S_UPLOAD, self._on_upload
+        )
+
+    def _on_upload(self, msg):
+        self.send_message(Message(Defines.MSG_TYPE_S2C_FINISH, 0, 1))
+        self.finish()
+
+
+class ClientManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_S2C_FINISH, self._on_finish
+        )
+
+    def _on_finish(self, msg):
+        self.done.set()
+        self.finish()
+
+    def _report(self):
+        self.send_message(Message(Defines.MSG_TYPE_C2S_UPLOAD, 1, 0))

@@ -1,0 +1,28 @@
+"""P002 fixture: a registered type no peer ever sends (dead handler)."""
+
+
+class Defines:
+    MSG_TYPE_S2C_BCAST = "s2c_bcast"
+    MSG_TYPE_S2C_GHOST = "s2c_ghost"
+
+
+class ClientManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_S2C_BCAST, self._on_bcast
+        )
+        # line 15: nobody sends S2C_GHOST -> P002
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_S2C_GHOST, self._on_ghost
+        )
+
+    def _on_bcast(self, msg):
+        self.finish()
+
+    def _on_ghost(self, msg):
+        pass
+
+
+class ServerManager:
+    def _announce(self):
+        self.send_message(Message(Defines.MSG_TYPE_S2C_BCAST, 0, 1))

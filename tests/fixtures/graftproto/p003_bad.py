@@ -1,0 +1,31 @@
+"""P003 fixture: duplicate wire value, dead constant, stale attribute ref,
+and a raw literal shadowing a define-class constant."""
+
+
+class Defines:
+    MSG_TYPE_S2C_SYNC = "s2c_sync"
+    MSG_TYPE_S2C_PING = "s2c_sync"  # line 7: duplicate wire value -> P003
+    MSG_TYPE_S2C_DEAD = "s2c_dead"  # line 8: never sent nor handled -> P003
+
+
+class ClientManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_S2C_SYNC, self._on_sync
+        )
+        # line 18: MSG_TYPE_S2C_RENAMED does not exist on Defines -> P003
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_S2C_RENAMED, self._on_other
+        )
+
+    def _on_sync(self, msg):
+        self.finish()
+
+    def _on_other(self, msg):
+        pass
+
+
+class ServerManager:
+    def _sync(self):
+        # line 31: raw literal duplicating Defines.MSG_TYPE_S2C_SYNC -> P003
+        self.send_message(Message("s2c_sync", 0, 1))

@@ -1,0 +1,20 @@
+"""P003 good twin: unique values, live constants, constant-only use sites."""
+
+
+class Defines:
+    MSG_TYPE_S2C_SYNC = "s2c_sync"
+
+
+class ClientManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_S2C_SYNC, self._on_sync
+        )
+
+    def _on_sync(self, msg):
+        self.finish()
+
+
+class ServerManager:
+    def _sync(self):
+        self.send_message(Message(Defines.MSG_TYPE_S2C_SYNC, 0, 1))

@@ -1,0 +1,34 @@
+"""P004 fixture: a handler that adopts the message's round and stores
+per-round state with no round comparison anywhere in its call closure."""
+
+
+class Defines:
+    MSG_TYPE_S2C_SYNC = "s2c_sync"
+    MSG_TYPE_C2S_RESULT = "c2s_result"
+
+
+class ClientManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_S2C_SYNC, self._on_sync
+        )
+
+    def _on_sync(self, msg):
+        # line 18: round state mutated, no round guard -> P004
+        self.round_idx = int(msg.get("round_idx", 0))
+        self._models[msg.get_sender_id()] = msg.get_arrays()
+        self.send_message(Message(Defines.MSG_TYPE_C2S_RESULT, 1, 0))
+        self.finish()
+
+
+class ServerManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_C2S_RESULT, self._on_result
+        )
+
+    def _on_result(self, msg):
+        self.finish()
+
+    def _sync(self):
+        self.send_message(Message(Defines.MSG_TYPE_S2C_SYNC, 0, 1))

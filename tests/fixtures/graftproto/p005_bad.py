@@ -1,0 +1,29 @@
+"""P005 fixture: two FSMs with handlers but no path to finish() at all —
+the receive loops can never terminate."""
+
+
+class Defines:
+    MSG_TYPE_S2C_WORK = "s2c_work"
+    MSG_TYPE_C2S_DONE = "c2s_done"
+
+
+class ClientManager:
+    def register_message_receive_handlers(self):
+        # line 13: handlers, but no finish()/done.set() anywhere -> P005
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_S2C_WORK, self._on_work
+        )
+
+    def _on_work(self, msg):
+        self.send_message(Message(Defines.MSG_TYPE_C2S_DONE, 1, 0))
+
+
+class ServerManager:
+    def register_message_receive_handlers(self):
+        # line 24: same on the server side -> P005
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_C2S_DONE, self._on_done
+        )
+
+    def _on_done(self, msg):
+        self.send_message(Message(Defines.MSG_TYPE_S2C_WORK, 0, 1))

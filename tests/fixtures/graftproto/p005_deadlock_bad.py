@@ -1,0 +1,30 @@
+"""P005 pairing fixture: the client CAN finish, but only on a terminal
+message no peer ever sends — both roles block forever (also P002)."""
+
+
+class Defines:
+    MSG_TYPE_S2C_WORK = "s2c_work"
+    MSG_TYPE_S2C_FINISH = "s2c_finish"
+
+
+class ClientManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_S2C_WORK, self._on_work
+        )
+        # line 16: the only finish() path, and nobody sends it -> P005+P002
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_S2C_FINISH, self._on_finish
+        )
+
+    def _on_work(self, msg):
+        pass
+
+    def _on_finish(self, msg):
+        self.done.set()
+        self.finish()
+
+
+class ServerManager:
+    def _drive(self):
+        self.send_message(Message(Defines.MSG_TYPE_S2C_WORK, 0, 1))

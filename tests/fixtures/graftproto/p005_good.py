@@ -1,0 +1,38 @@
+"""P005 good twin: the terminal edge exists and its trigger is sent."""
+
+
+class Defines:
+    MSG_TYPE_S2C_WORK = "s2c_work"
+    MSG_TYPE_S2C_FINISH = "s2c_finish"
+    MSG_TYPE_C2S_DONE = "c2s_done"
+
+
+class ClientManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_S2C_WORK, self._on_work
+        )
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_S2C_FINISH, self._on_finish
+        )
+
+    def _on_work(self, msg):
+        self.send_message(Message(Defines.MSG_TYPE_C2S_DONE, 1, 0))
+
+    def _on_finish(self, msg):
+        self.done.set()
+        self.finish()
+
+
+class ServerManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_C2S_DONE, self._on_done
+        )
+
+    def _on_done(self, msg):
+        self.send_message(Message(Defines.MSG_TYPE_S2C_FINISH, 0, 1))
+        self.finish()
+
+    def _drive(self):
+        self.send_message(Message(Defines.MSG_TYPE_S2C_WORK, 0, 1))

@@ -1,0 +1,23 @@
+"""P006 fixture: FSM code sending through the raw backend, bypassing the
+delivery layer's seq/epoch stamping and retry policy."""
+
+
+class Defines:
+    MSG_TYPE_C2S_RESULT = "c2s_result"
+
+
+class ClientManager:
+    def _report(self):
+        out = Message(Defines.MSG_TYPE_C2S_RESULT, 1, 0)
+        # line 13: raw backend send -> P006
+        self.com_manager.send_message(out)
+
+
+class ServerManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_C2S_RESULT, self._on_result
+        )
+
+    def _on_result(self, msg):
+        self.finish()

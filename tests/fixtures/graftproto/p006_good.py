@@ -1,0 +1,21 @@
+"""P006 good twin: the send goes through FedMLCommManager.send_message."""
+
+
+class Defines:
+    MSG_TYPE_C2S_RESULT = "c2s_result"
+
+
+class ClientManager:
+    def _report(self):
+        out = Message(Defines.MSG_TYPE_C2S_RESULT, 1, 0)
+        self.send_message(out)
+
+
+class ServerManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_C2S_RESULT, self._on_result
+        )
+
+    def _on_result(self, msg):
+        self.finish()

@@ -1,0 +1,10 @@
+"""P007 fixture: arrays offloaded to the payload store with no sha256
+digest attached — the receiver cannot verify the blob."""
+
+
+class Uploader:
+    def offload(self, message):
+        # line 8: payload-store write, no digest in this function -> P007
+        key = self.payload_store.put_dedup(message.arrays)
+        message.add("payload_ref", key)
+        message.set_arrays([])

@@ -1,0 +1,9 @@
+"""P007 good twin: digest attached before the store write."""
+
+
+class Uploader:
+    def offload(self, message):
+        message.add("_sha256", arrays_digest(message.arrays))
+        key = self.payload_store.put_dedup(message.arrays)
+        message.add("payload_ref", key)
+        message.set_arrays([])

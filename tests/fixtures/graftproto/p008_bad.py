@@ -1,0 +1,23 @@
+"""P008 fixture: the classic A->B / B->A lock-order inversion between the
+trainer thread and the comm thread."""
+
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._comm_lock = threading.Lock()
+        self.step = 0
+
+    def trainer_side(self):
+        with self._state_lock:
+            # line 16: comm lock acquired under state lock -> P008
+            with self._comm_lock:
+                self.step += 1
+
+    def comm_side(self):
+        with self._comm_lock:
+            # line 22: state lock acquired under comm lock -> P008
+            with self._state_lock:
+                self.step += 1

@@ -1,0 +1,20 @@
+"""P008 good twin: both threads acquire in the same global order."""
+
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._comm_lock = threading.Lock()
+        self.step = 0
+
+    def trainer_side(self):
+        with self._state_lock:
+            with self._comm_lock:
+                self.step += 1
+
+    def comm_side(self):
+        with self._state_lock:
+            with self._comm_lock:
+                self.step += 1

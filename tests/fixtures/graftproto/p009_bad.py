@@ -1,0 +1,31 @@
+"""P009 fixture: blocking calls while holding a lock — direct (fsync,
+sleep, untimed get/join) and through a resolvable callee."""
+
+import os
+import threading
+import time
+
+
+class Committer:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def commit(self, line):
+        with self._lock:
+            f = open("ledger", "a")
+            f.write(line)
+            os.fsync(f.fileno())  # line 17 -> P009
+            time.sleep(0.01)  # line 18 -> P009
+
+    def drain(self):
+        with self._lock:
+            item = self._queue.get()  # line 22 -> P009 (untimed)
+            self._thread.join()  # line 23 -> P009 (untimed)
+        return item
+
+    def _settle(self):
+        time.sleep(1.0)
+
+    def indirect(self):
+        with self._lock:
+            self._settle()  # line 31 -> P009 (callee blocks)

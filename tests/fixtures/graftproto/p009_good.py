@@ -1,0 +1,35 @@
+"""P009 good twin: snapshot under the lock, block lock-free; timeouts on
+the waits that stay inside."""
+
+import os
+import threading
+import time
+
+
+class Committer:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def commit(self, line):
+        with self._lock:
+            f = open("ledger", "a")
+            f.write(line)
+            f.flush()
+        os.fsync(f.fileno())
+        f.close()
+
+    def drain(self):
+        item = self._queue.get(timeout=1.0)
+        with self._lock:
+            self._drained += 1
+        self._thread.join(1.0)
+        return item
+
+    def _settle(self):
+        time.sleep(1.0)
+
+    def indirect(self):
+        with self._lock:
+            snapshot = dict(self._state)
+        self._settle()
+        return snapshot

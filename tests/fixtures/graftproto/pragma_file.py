@@ -1,0 +1,15 @@
+"""graftproto file-level pragma fixture: prologue pragma silences the
+whole file."""
+# graftproto: disable=P009
+
+import os
+import threading
+
+
+class Committer:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def commit(self, fd):
+        with self._lock:
+            os.fsync(fd)

@@ -1,0 +1,14 @@
+"""graftproto pragma fixture: one suppressed P009, one live."""
+
+import os
+import threading
+
+
+class Committer:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def commit(self, fd):
+        with self._lock:
+            os.fsync(fd)  # graftproto: disable=P009
+            os.fsync(fd)  # line 14: NOT suppressed -> P009

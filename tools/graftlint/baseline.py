@@ -46,12 +46,13 @@ def load(path: str) -> Counter:
     return Counter({str(k): int(v) for k, v in data.get("findings", {}).items()})
 
 
-def save(path: str, findings: Iterable[Finding]) -> None:
+def save(path: str, findings: Iterable[Finding],
+         tool: str = "graftlint") -> None:
     counts = Counter(f.baseline_key() for f in findings)
     payload = {
         "version": 1,
         "comment": (
-            "graftlint baseline: pre-existing findings, suppressed but "
+            f"{tool} baseline: pre-existing findings, suppressed but "
             "visible. Regenerate with --write-baseline; shrink it, never "
             "grow it."
         ),

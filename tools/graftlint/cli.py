@@ -1,116 +1,57 @@
 """graftlint CLI: ``python -m tools.graftlint [paths...]``.
 
-Exit codes: 0 clean (after baseline + pragmas), 1 findings, 2 usage error.
+Thin suite definition over the shared driver (:mod:`tools.graftlint.clikit`
+— flags, baseline handling, rendering, and the exit-code contract live
+there, shared with graftproto). Exit codes: 0 clean (after baseline +
+pragmas), 1 findings, 2 usage error OR analyzer crash — CI can tell "the
+tree regressed" (1) from "the linter itself broke" (2) at a glance; that
+includes crashes inside the ``--runtime`` jaxpr pass.
 """
 
 from __future__ import annotations
 
 import argparse
-import json
-import os
-import sys
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
-from . import baseline as baseline_mod
+from . import clikit
 from .analyzer import analyze_paths
+from .baseline import DEFAULT_BASELINE_RELPATH
 from .findings import RULES, Finding
 
 
-def build_parser() -> argparse.ArgumentParser:
-    p = argparse.ArgumentParser(
-        prog="graftlint",
-        description="JAX-aware static analysis: trace-safety, donation, "
-                    "recompile and thread-safety linting",
-    )
-    p.add_argument("paths", nargs="*", default=["fedml_tpu"],
-                   help="files or directories to analyze (default: fedml_tpu)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
-    p.add_argument("--baseline", default="",
-                   help="baseline file (default: <repo-root>/tools/graftlint/"
-                        "baseline.json, resolved independent of cwd)")
-    p.add_argument("--no-baseline", action="store_true",
-                   help="report every finding, ignoring the baseline")
-    p.add_argument("--write-baseline", action="store_true",
-                   help="rewrite the baseline from the current findings")
-    p.add_argument("--select", default="",
-                   help="comma-separated rule ids to report (e.g. G001,G005)")
+def _add_arguments(p: argparse.ArgumentParser) -> None:
     p.add_argument("--runtime", action="store_true",
                    help="also trace the round engine under jax.make_jaxpr "
                         "and check the jaxprs for effects (imports jax)")
-    p.add_argument("--list-rules", action="store_true")
-    return p
 
 
-def main(argv: Optional[List[str]] = None) -> int:
-    args = build_parser().parse_args(argv)
-    if args.list_rules:
-        for rid, (title, hint) in RULES.items():
-            print(f"{rid}  {title}\n      fix: {hint}")
-        return 0
-
-    for p in args.paths:
-        if not os.path.exists(p):
-            print(f"graftlint: no such path: {p}", file=sys.stderr)
-            return 2
-
-    repo_root = baseline_mod.find_repo_root(args.paths[0])
+def _analyze(args: argparse.Namespace,
+             repo_root: str) -> Tuple[List[Finding], Dict]:
     findings = analyze_paths(args.paths, repo_root=repo_root)
-
     if args.runtime:
         from .runtime_check import check_round_engine
 
         try:
             findings = findings + check_round_engine(repo_root)
         except RuntimeError as e:
-            print(f"graftlint: {e}", file=sys.stderr)
-            return 2
-
-    if args.select:
-        keep = {r.strip().upper() for r in args.select.split(",") if r.strip()}
-        findings = [f for f in findings if f.rule in keep]
-
-    baseline_path = args.baseline or baseline_mod.default_baseline_path(
-        repo_root)
-    if args.write_baseline:
-        if args.select:
-            print("graftlint: --write-baseline with --select would drop "
-                  "every other rule's entries from the baseline — refusing",
-                  file=sys.stderr)
-            return 2
-        baseline_mod.save(baseline_path, findings)
-        print(f"graftlint: wrote {len(findings)} finding(s) to "
-              f"{os.path.relpath(baseline_path, repo_root)}")
-        return 0
-
-    if args.no_baseline:
-        new, baselined = findings, []
-    else:
-        new, baselined = baseline_mod.split(
-            findings, baseline_mod.load(baseline_path))
-
-    if args.format == "json":
-        print(json.dumps({
-            "findings": [f.to_json() for f in new],
-            "baselined": len(baselined),
-            "counts": _counts(new),
-            "exit_code": 1 if new else 0,
-        }, indent=2))
-    else:
-        for f in new:
-            print(f.render())
-            if f.hint:
-                print(f"    fix: {f.hint}")
-        summary = (f"graftlint: {len(new)} finding(s)"
-                   f" ({len(baselined)} baselined)")
-        print(summary if new or baselined else "graftlint: clean")
-    return 1 if new else 0
+            # an operator-fixable condition (e.g. jax missing): one line,
+            # exit 2, no traceback; anything else crashes through to the
+            # driver's internal-error handler (also exit 2)
+            raise clikit.SuiteUsageError(str(e)) from e
+    return findings, {}
 
 
-def _counts(findings: List[Finding]) -> dict:
-    out: dict = {}
-    for f in findings:
-        out[f.rule] = out.get(f.rule, 0) + 1
-    return out
+def main(argv: Optional[List[str]] = None) -> int:
+    return clikit.run_suite(
+        argv,
+        tool="graftlint",
+        description="JAX-aware static analysis: trace-safety, donation, "
+                    "recompile and thread-safety linting",
+        rules=RULES,
+        analyze=_analyze,
+        baseline_relpath=DEFAULT_BASELINE_RELPATH,
+        add_arguments=_add_arguments,
+    )
 
 
 if __name__ == "__main__":

@@ -1,0 +1,140 @@
+"""Shared CLI driver for the lint suites (graftlint / graftproto).
+
+One implementation of the common contract so the two suites cannot drift:
+
+- flags: paths, --format text|json (--json alias), --baseline,
+  --no-baseline, --write-baseline (refused with --select), --select,
+  --list-rules, plus suite-specific extras via ``add_arguments``;
+- exit codes: 0 clean (after baseline + pragmas), 1 findings, 2 usage
+  error OR the analyzer itself crashed — CI can tell "the tree regressed"
+  (1) from "the linter broke" (2) at a glance. ANY exception escaping the
+  suite's ``analyze`` maps to 2 (with traceback); a
+  :class:`SuiteUsageError` maps to 2 with a one-line message instead.
+- JSON payload: ``findings`` / ``baselined`` / ``counts`` / ``exit_code``
+  plus whatever extra fields the suite's ``analyze`` returns.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import baseline as baseline_mod
+from .findings import Finding
+
+
+class SuiteUsageError(RuntimeError):
+    """An analysis-time condition the operator must fix (bad flag combo,
+    missing optional dependency): reported as one line, exit 2, no
+    traceback."""
+
+
+AnalyzeFn = Callable[[argparse.Namespace, str], Tuple[List[Finding], Dict]]
+
+
+def run_suite(
+    argv: Optional[List[str]],
+    *,
+    tool: str,
+    description: str,
+    rules: Dict[str, Tuple[str, str]],
+    analyze: AnalyzeFn,
+    baseline_relpath: str,
+    add_arguments: Optional[Callable[[argparse.ArgumentParser], None]] = None,
+) -> int:
+    p = argparse.ArgumentParser(prog=tool, description=description)
+    p.add_argument("paths", nargs="*", default=["fedml_tpu"],
+                   help="files or directories to analyze (default: fedml_tpu)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--json", action="store_true",
+                   help="shorthand for --format json")
+    p.add_argument("--baseline", default="",
+                   help=f"baseline file (default: <repo-root>/"
+                        f"{baseline_relpath.replace(os.sep, '/')}, resolved "
+                        "independent of cwd)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring the baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline from the current findings")
+    p.add_argument("--select", default="",
+                   help="comma-separated rule ids to report")
+    p.add_argument("--list-rules", action="store_true")
+    if add_arguments is not None:
+        add_arguments(p)
+    args = p.parse_args(argv)
+    if args.json:
+        args.format = "json"
+
+    if args.list_rules:
+        for rid, (title, hint) in rules.items():
+            print(f"{rid}  {title}\n      fix: {hint}")
+        return 0
+
+    for path in args.paths:
+        if not os.path.exists(path):
+            print(f"{tool}: no such path: {path}", file=sys.stderr)
+            return 2
+
+    repo_root = baseline_mod.find_repo_root(args.paths[0])
+    try:
+        findings, extra = analyze(args, repo_root)
+    except SuiteUsageError as e:
+        print(f"{tool}: {e}", file=sys.stderr)
+        return 2
+    except Exception:  # noqa: BLE001 — a crashed analyzer is exit 2, not 1
+        import traceback
+
+        traceback.print_exc()
+        print(f"{tool}: internal error while analyzing (this is a bug in "
+              "the analyzer, not a finding)", file=sys.stderr)
+        return 2
+
+    if args.select:
+        keep = {r.strip().upper() for r in args.select.split(",") if r.strip()}
+        findings = [f for f in findings if f.rule in keep]
+
+    baseline_path = args.baseline or os.path.join(repo_root, baseline_relpath)
+    if args.write_baseline:
+        if args.select:
+            print(f"{tool}: --write-baseline with --select would drop "
+                  "every other rule's entries from the baseline — refusing",
+                  file=sys.stderr)
+            return 2
+        baseline_mod.save(baseline_path, findings, tool=tool)
+        print(f"{tool}: wrote {len(findings)} finding(s) to "
+              f"{os.path.relpath(baseline_path, repo_root)}")
+        return 0
+
+    if args.no_baseline:
+        new, baselined = findings, []
+    else:
+        new, baselined = baseline_mod.split(
+            findings, baseline_mod.load(baseline_path))
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_json() for f in new],
+            "baselined": len(baselined),
+            "counts": _counts(new),
+            **extra,
+            "exit_code": 1 if new else 0,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+            if f.hint:
+                print(f"    fix: {f.hint}")
+        summary = (f"{tool}: {len(new)} finding(s)"
+                   f" ({len(baselined)} baselined)")
+        print(summary if new or baselined else f"{tool}: clean")
+    return 1 if new else 0
+
+
+def _counts(findings: List[Finding]) -> dict:
+    out: dict = {}
+    for f in findings:
+        out[f.rule] = out.get(f.rule, 0) + 1
+    return out

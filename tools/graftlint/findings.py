@@ -1,4 +1,9 @@
-"""Finding record + rule registry (ids, one-line docs, autofix hints)."""
+"""Finding record + rule registry (ids, one-line docs, autofix hints).
+
+The registry is shared infrastructure: sibling suites (tools/graftproto's
+P-rules) register their ids via :func:`register_rules` so one
+:class:`Finding` type renders/bases/JSONs identically across suites.
+"""
 
 from __future__ import annotations
 
@@ -36,6 +41,18 @@ RULES: Dict[str, Tuple[str, str]] = {
         "pragma the line",
     ),
 }
+
+
+def register_rules(rules: Dict[str, Tuple[str, str]]) -> None:
+    """Merge a sibling suite's rule registry (id -> (title, hint)) so its
+    findings render with titles/hints. Re-registering the same id with the
+    same payload is a no-op; a conflicting payload is a programming error."""
+    for rid, payload in rules.items():
+        existing = RULES.get(rid)
+        if existing is not None and existing != payload:
+            raise ValueError(f"rule id {rid!r} already registered "
+                             f"with a different title/hint")
+        RULES[rid] = payload
 
 
 @dataclasses.dataclass(frozen=True)

@@ -4,6 +4,10 @@ The pragma suppresses findings of the listed rules on its own physical line.
 A pragma in the file *prologue* — before any code, i.e. among shebang/coding
 /comment/blank lines and the module docstring — suppresses the listed rules
 for the whole file.
+
+Shared infrastructure: sibling suites reuse the machinery under their own
+marker (``parse_pragmas(source, tool="graftproto")`` recognizes
+``# graftproto: disable=P006``); each suite only sees its own pragmas.
 """
 
 from __future__ import annotations
@@ -11,9 +15,20 @@ from __future__ import annotations
 import re
 from typing import Dict, FrozenSet
 
-PRAGMA_RE = re.compile(
-    r"#\s*graftlint:\s*disable=([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
-)
+_PRAGMA_RES: Dict[str, "re.Pattern[str]"] = {}
+
+
+def pragma_re(tool: str = "graftlint") -> "re.Pattern[str]":
+    pat = _PRAGMA_RES.get(tool)
+    if pat is None:
+        pat = _PRAGMA_RES[tool] = re.compile(
+            rf"#\s*{re.escape(tool)}:\s*"
+            r"disable=([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+        )
+    return pat
+
+
+PRAGMA_RE = pragma_re("graftlint")
 
 ALL = frozenset({"all"})
 
@@ -48,14 +63,16 @@ def _prologue_end(lines) -> int:
     return i
 
 
-def parse_pragmas(source: str) -> Dict[int, FrozenSet[str]]:
+def parse_pragmas(source: str,
+                  tool: str = "graftlint") -> Dict[int, FrozenSet[str]]:
     """1-based line -> rules disabled there; key ``FILE_LEVEL`` (0) holds
     rules disabled for the whole file (pragma in the prologue)."""
     out: Dict[int, FrozenSet[str]] = {}
+    pat = pragma_re(tool)
     lines = source.splitlines()
     prologue = _prologue_end(lines)
     for i, text in enumerate(lines, start=1):
-        m = PRAGMA_RE.search(text)
+        m = pat.search(text)
         if not m:
             continue
         rules = frozenset(

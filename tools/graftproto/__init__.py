@@ -1,0 +1,32 @@
+"""graftproto — static protocol & concurrency verification of the
+distributed comm plane (sibling suite to :mod:`tools.graftlint`).
+
+Rules (docs/graftproto.md has the catalog with worked examples):
+
+- **P001 sent-but-never-handled** / **P002 handled-but-never-sent** — the
+  message-flow graph: every ``Message(MSG_TYPE_*, ...)`` construction is
+  resolved (including parameter-typed helpers) and cross-checked against
+  every ``register_message_receive_handler`` site, value-keyed; C2S_*/S2C_*
+  naming is checked against the registering/sending role.
+- **P003 type-constant-drift** — stale ``MSG_TYPE_*`` attribute refs, raw
+  string literals shadowing define-class constants, duplicate wire values
+  in one define class, dead constants.
+- **P004 replay-unsafe-handler** — handlers that mutate round state
+  (``self.round_idx`` writes, keyed stores) with no round comparison in
+  their call closure (the PR 4 replay-idempotence contract).
+- **P005 no-path-to-finish** — FSM classes that can never terminate, and
+  terminal messages no peer sends (protocol deadlock).
+- **P006 send-bypasses-delivery** / **P007 payload-write-skips-digest** —
+  the delivery invariants: seq/epoch stamping and sha256 digesting are
+  only enforced on the ``FedMLCommManager.send_message`` path.
+- **P008 lock-order-inversion** / **P009 blocking-call-under-lock** —
+  lock-acquisition graph cycles and blocking calls (untimed join/get/wait,
+  recv, fsync, sleep) while holding a lock.
+
+Suppression: ``# graftproto: disable=P00X`` pragmas (same machinery as
+graftlint, own marker) and ``tools/graftproto/baseline.json``.
+"""
+
+from .analyzer import analyze_paths, analyze_paths_with_model  # noqa: F401
+from .findings import PROTO_RULES, Finding  # noqa: F401
+from .model import build_model, enumerate_msg_constants  # noqa: F401

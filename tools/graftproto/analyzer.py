@@ -1,0 +1,63 @@
+"""graftproto entry: scan → model → rules → pragma filter.
+
+Mirrors :func:`tools.graftlint.analyzer.analyze_paths`, with graftproto's
+own pragma marker (``# graftproto: disable=P006``) and baseline file
+(``tools/graftproto/baseline.json``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..graftlint.analyzer import collect_files, load_modules
+from ..graftlint.baseline import find_repo_root
+from ..graftlint.pragmas import is_suppressed, parse_pragmas
+from .findings import Finding
+from .locks import check_locks
+from .model import ProtoModel, build_model
+from .rules import check_protocol
+
+PRAGMA_TOOL = "graftproto"
+DEFAULT_BASELINE_RELPATH = os.path.join("tools", "graftproto",
+                                        "baseline.json")
+
+
+def default_baseline_path(repo_root: str) -> str:
+    return os.path.join(repo_root, DEFAULT_BASELINE_RELPATH)
+
+
+def analyze_paths_with_model(
+    paths: Sequence[str], repo_root: Optional[str] = None
+) -> Tuple[List[Finding], ProtoModel]:
+    """Analyze files/dirs → (pragma-filtered findings, protocol model).
+
+    The model rides along so callers (the coverage gate, ``--json``) can
+    inspect the flow-graph classification behind the findings. The baseline
+    is NOT applied here — that's the CLI/caller's job, like graftlint.
+    """
+    if repo_root is None:
+        repo_root = find_repo_root(paths[0] if paths else os.getcwd())
+    files = collect_files(paths)
+    modules = load_modules(files, repo_root)
+    model = build_model(modules)
+    findings = check_protocol(model, modules) + check_locks(modules)
+
+    out: List[Finding] = []
+    pragma_cache: Dict[str, Dict] = {}
+    mods_by_rel = {m.rel: m for m in modules.values()}
+    for f in findings:
+        mod = mods_by_rel.get(f.path)
+        if mod is not None:
+            pragmas = pragma_cache.setdefault(
+                f.path, parse_pragmas(mod.source, tool=PRAGMA_TOOL))
+            if is_suppressed(pragmas, f.rule, f.line):
+                continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out, model
+
+
+def analyze_paths(paths: Sequence[str],
+                  repo_root: Optional[str] = None) -> List[Finding]:
+    return analyze_paths_with_model(paths, repo_root)[0]

@@ -1,0 +1,45 @@
+"""graftproto CLI: ``python -m tools.graftproto [paths...]``.
+
+Thin suite definition over the shared driver
+(:mod:`tools.graftlint.clikit` — flags, baseline handling, rendering, and
+the exit-code contract live there, shared with graftlint). Exit codes:
+0 clean (after baseline + pragmas), 1 findings, 2 usage error OR analyzer
+crash.
+
+The JSON report (``--format json`` / ``--json``) adds ``coverage``: the
+per-wire-value flow-graph classification (constants, send/handler site
+counts), so future PRs can diff protocol surface alongside finding counts.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Dict, List, Optional, Tuple
+
+from ..graftlint import clikit
+from .analyzer import DEFAULT_BASELINE_RELPATH, analyze_paths_with_model
+from .findings import PROTO_RULES, Finding
+
+
+def _analyze(args: argparse.Namespace,
+             repo_root: str) -> Tuple[List[Finding], Dict]:
+    findings, model = analyze_paths_with_model(args.paths,
+                                               repo_root=repo_root)
+    return findings, {"coverage": model.coverage()}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    return clikit.run_suite(
+        argv,
+        tool="graftproto",
+        description="static protocol & concurrency verification of the "
+                    "distributed comm plane: message-flow graph, FSM "
+                    "replay/termination, delivery invariants, lock order",
+        rules=PROTO_RULES,
+        analyze=_analyze,
+        baseline_relpath=DEFAULT_BASELINE_RELPATH,
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,71 @@
+"""graftproto rule registry (P001–P009), merged into the shared graftlint
+Finding infrastructure so both suites render/baseline/JSON identically."""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from ..graftlint.findings import Finding, register_rules
+
+# rule id -> (title, autofix hint)
+PROTO_RULES: Dict[str, Tuple[str, str]] = {
+    "P001": (
+        "sent-but-never-handled",
+        "register a handler for the type on the receiving role's manager "
+        "(register_message_receive_handler), or delete the dead send; a "
+        "C2S_* type needs a *Server* manager handler, an S2C_* type a "
+        "*Client* one",
+    ),
+    "P002": (
+        "handled-but-never-sent",
+        "add the send on the peer role, or delete the dead registration — "
+        "a handler waiting on a message nobody sends blocks that FSM "
+        "forever",
+    ),
+    "P003": (
+        "type-constant-drift",
+        "reference the MSG_TYPE_* constant from the protocol's "
+        "message-define class instead of a raw string / stale attribute; "
+        "keep every wire value defined exactly once per protocol class",
+    ),
+    "P004": (
+        "replay-unsafe-handler",
+        "guard round-state mutation behind a round comparison (the "
+        "_replay_guard/_is_stale pattern): read the message's ROUND_IDX "
+        "and compare it against the FSM's current round before mutating",
+    ),
+    "P005": (
+        "no-path-to-finish",
+        "give the FSM a terminal edge: some handler (or a method it "
+        "reaches) must call self.finish()/self.done.set(), and the "
+        "message type that triggers it must actually be sent by the peer",
+    ),
+    "P006": (
+        "send-bypasses-delivery",
+        "send through FedMLCommManager.send_message so the message gets "
+        "its seq/epoch stamp, payload offload and retry policy — never "
+        "call the raw backend (com_manager.send_message) from FSM code",
+    ),
+    "P007": (
+        "payload-write-skips-digest",
+        "compute arrays_digest(...) and attach MSG_ARG_KEY_PAYLOAD_SHA256 "
+        "before handing arrays to the payload store — undigested blobs "
+        "defeat the receiver's corruption check",
+    ),
+    "P008": (
+        "lock-order-inversion",
+        "acquire the locks in one global order everywhere (or collapse "
+        "them into one lock); a cyclic acquisition order deadlocks the "
+        "comm thread against the trainer under load",
+    ),
+    "P009": (
+        "blocking-call-under-lock",
+        "move the blocking call (join/recv/untimed get/wait/fsync/sleep) "
+        "outside the ``with lock:`` block — snapshot state under the "
+        "lock, then block lock-free",
+    ),
+}
+
+register_rules(PROTO_RULES)
+
+__all__ = ["Finding", "PROTO_RULES"]

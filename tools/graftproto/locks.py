@@ -1,0 +1,371 @@
+"""P008/P009 — lock-order and blocking-call analysis.
+
+Extends graftlint's G005 thread analysis from *data* races to *lock* races:
+
+- build the lock-acquisition graph: a node per lock identity (``(Class,
+  attr)`` for ``with self._lock`` / class-attribute locks, ``(module,
+  name)`` for module-level locks), an edge A→B whenever B is acquired —
+  lexically, or inside any function reached through resolvable calls —
+  while A is held;
+- **P008**: edges inside a cyclic strongly-connected component (the classic
+  A→B / B→A inversion between the comm thread and the trainer), including
+  self-edges (re-acquiring a non-reentrant ``threading.Lock``);
+- **P009**: blocking calls while holding a lock — zero-arg ``join()`` /
+  ``get()`` / ``wait()``, ``recv``/``accept``/``select``, ``os.fsync``
+  (the ledger-commit stall), ``time.sleep`` and Orbax
+  ``wait_until_finished`` — directly or through a resolvable callee.
+
+Resolution is deliberately conservative: intra-class ``self.m()`` calls,
+module-level functions, module-qualified ``alias.fn()`` calls, and a
+class-hierarchy match on distinctive method names (graftlint's CHA with its
+stoplist). ``lock.acquire()`` without ``with`` is out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..graftlint.analyzer import CHA_STOPLIST, FuncInfo, ModuleInfo, dotted
+from ..graftlint.threads import _is_lock_expr
+from .findings import Finding
+from .model import owning_class
+
+LockId = Tuple[str, str]  # (scope: class or module, attr/name)
+
+# extra method names too generic for CHA here, on top of graftlint's list
+PROTO_CHA_STOPLIST = CHA_STOPLIST | {
+    "cancel", "set", "is_set", "serialize", "deserialize", "encode",
+    "decode", "train", "evaluate",
+}
+
+# blocking when called with NO args and NO timeout kwarg
+BLOCKING_IF_UNTIMED = {"join", "get", "wait"}
+# always blocking
+BLOCKING_ALWAYS = {"fsync", "sleep", "recv", "recv_into", "accept",
+                   "select", "wait_until_finished"}
+
+
+class _FnFacts:
+    __slots__ = ("fi", "mod", "own_locks", "direct_edges", "direct_blocks",
+                 "calls", "trans_locks", "trans_blocks")
+
+    def __init__(self, fi: FuncInfo, mod: ModuleInfo):
+        self.fi = fi
+        self.mod = mod
+        self.own_locks: Set[LockId] = set()
+        # (held, acquired, line)
+        self.direct_edges: List[Tuple[LockId, LockId, int]] = []
+        # (description, line, held lock)
+        self.direct_blocks: List[Tuple[str, int, LockId]] = []
+        # (callee key, line, held locks at the call)
+        self.calls: List[Tuple[int, int, Tuple[LockId, ...]]] = []
+        self.trans_locks: Set[LockId] = set()
+        self.trans_blocks: List[Tuple[str, str]] = []  # (desc, "rel:line")
+
+
+def check_locks(modules: Dict[str, ModuleInfo]) -> List[Finding]:
+    facts: Dict[int, _FnFacts] = {}
+    all_methods: Dict[str, List[FuncInfo]] = {}
+    for mod in modules.values():
+        for methods in mod.classes.values():
+            for m in methods.values():
+                all_methods.setdefault(m.name, []).append(m)
+
+    for mod in modules.values():
+        for fi in mod.funcs_by_node.values():
+            facts[id(fi.node)] = _FnFacts(fi, mod)
+    for f in facts.values():
+        _scan_function(f, modules, all_methods)
+    _fixpoint(facts)
+    return _emit(facts)
+
+
+# ---------------------------------------------------------------------------
+# lock identity + call resolution
+# ---------------------------------------------------------------------------
+
+
+def _lock_id(expr: ast.expr, mod: ModuleInfo,
+             fi: FuncInfo) -> Optional[LockId]:
+    ds = dotted(expr)
+    if not _is_lock_expr(ds, set()):
+        return None
+    parts = ds.split(".")
+    if len(parts) == 1:
+        return (mod.name, parts[0])
+    base, attr = parts[0], parts[-1]
+    if base in ("self", "cls"):
+        cls = owning_class(fi)
+        return (cls or mod.name, attr)
+    if base in mod.classes:
+        return (base, attr)
+    tgt = mod.imports.get(base)
+    if tgt is None and base in mod.from_imports:
+        b, orig = mod.from_imports[base]
+        tgt = f"{b}.{orig}" if b else orig
+    if tgt is not None:
+        return (tgt, attr)
+    return (f"{mod.name}.{base}", attr)
+
+
+def _resolve_callees(call: ast.Call, mod: ModuleInfo, fi: FuncInfo,
+                     modules: Dict[str, ModuleInfo],
+                     all_methods: Dict[str, List[FuncInfo]]
+                     ) -> List[FuncInfo]:
+    func = call.func
+    if isinstance(func, ast.Name):
+        target = mod.toplevel.get(func.id)
+        if target is not None:
+            return [target]
+        imp = mod.from_imports.get(func.id)
+        if imp:
+            target_mod = modules.get(imp[0])
+            if target_mod and imp[1] in target_mod.toplevel:
+                return [target_mod.toplevel[imp[1]]]
+        return []
+    if not isinstance(func, ast.Attribute):
+        return []
+    name = func.attr
+    base = func.value
+    if isinstance(base, ast.Name):
+        if base.id in ("self", "cls"):
+            cls = owning_class(fi)
+            if cls:
+                m = mod.classes.get(cls, {}).get(name)
+                if m is not None:
+                    return [m]
+        tgt = mod.imports.get(base.id)
+        if tgt is None and base.id in mod.from_imports:
+            # ``from ..mlops import telemetry`` → telemetry.counter_inc(...)
+            b, orig = mod.from_imports[base.id]
+            cand = f"{b}.{orig}" if b else orig
+            if cand in modules:
+                tgt = cand
+        if tgt and tgt in modules:
+            target_mod = modules[tgt]
+            if name in target_mod.toplevel:
+                return [target_mod.toplevel[name]]
+            return []
+    if name in PROTO_CHA_STOPLIST or name.startswith("__"):
+        return []
+    # lock analysis demands precision graftlint's G-rules don't: an
+    # ambiguous class-hierarchy match manufactures phantom self-edges
+    # (e.g. `h.observe(...)` under MetricsRegistry._lock resolving to
+    # MetricsRegistry.observe instead of Histogram.observe), so only
+    # uniquely-named methods resolve here
+    cands = all_methods.get(name, [])
+    if len(cands) == 1:
+        return list(cands)
+    return []
+
+
+def _blocking_desc(call: ast.Call) -> Optional[str]:
+    ds = dotted(call.func)
+    if ds is None:
+        return None
+    last = ds.split(".")[-1]
+    if last in BLOCKING_ALWAYS:
+        return f"{ds}(...)"
+    if last in BLOCKING_IF_UNTIMED:
+        has_timeout = any(kw.arg == "timeout" for kw in call.keywords)
+        if not call.args and not has_timeout:
+            return f"untimed {ds}()"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# per-function scan
+# ---------------------------------------------------------------------------
+
+
+def _scan_function(f: _FnFacts, modules: Dict[str, ModuleInfo],
+                   all_methods: Dict[str, List[FuncInfo]]) -> None:
+    mod, fi = f.mod, f.fi
+
+    def walk(node: ast.AST, held: Tuple[LockId, ...]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # separate FuncInfo, scanned on its own
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in node.items:
+                walk_children(item.context_expr, held)
+                lock = _lock_id(item.context_expr, mod, fi)
+                if lock is None:
+                    continue
+                f.own_locks.add(lock)
+                for h in new_held:
+                    f.direct_edges.append((h, lock, node.lineno))
+                new_held = new_held + (lock,)
+            for stmt in node.body:
+                walk(stmt, new_held)
+            return
+        if isinstance(node, ast.Call):
+            if held:
+                desc = _blocking_desc(node)
+                if desc is not None:
+                    f.direct_blocks.append((desc, node.lineno, held[-1]))
+            for callee in _resolve_callees(node, mod, fi, modules,
+                                           all_methods):
+                f.calls.append((id(callee.node), node.lineno, held))
+        walk_children(node, held)
+
+    def walk_children(node: ast.AST, held: Tuple[LockId, ...]) -> None:
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    walk_children(fi.node, ())
+
+
+def _fixpoint(facts: Dict[int, _FnFacts]) -> None:
+    for f in facts.values():
+        f.trans_locks = set(f.own_locks)
+        f.trans_blocks = []
+        # every lexical blocking call counts transitively (under a lock or
+        # not) — the CALLER may be holding one
+        _collect_own_blocks(f)
+    changed = True
+    while changed:
+        changed = False
+        for f in facts.values():
+            for callee_key, _line, _held in f.calls:
+                callee = facts.get(callee_key)
+                if callee is None:
+                    continue
+                before = len(f.trans_locks)
+                f.trans_locks |= callee.trans_locks
+                if len(f.trans_locks) != before:
+                    changed = True
+                for entry in callee.trans_blocks:
+                    if entry not in f.trans_blocks:
+                        f.trans_blocks.append(entry)
+                        changed = True
+
+
+def _collect_own_blocks(f: _FnFacts) -> None:
+    from .model import _own_nodes
+
+    for node in _own_nodes(f.fi.node):
+        if isinstance(node, ast.Call):
+            desc = _blocking_desc(node)
+            entry = (desc, f"{f.mod.rel}:{node.lineno}")
+            if desc is not None and entry not in f.trans_blocks:
+                f.trans_blocks.append(entry)
+
+
+# ---------------------------------------------------------------------------
+# findings
+# ---------------------------------------------------------------------------
+
+
+def _emit(facts: Dict[int, _FnFacts]) -> List[Finding]:
+    findings: List[Finding] = []
+    # P009 — direct, then one-hop through calls
+    seen: Set[tuple] = set()
+    for f in facts.values():
+        for desc, line, lock in f.direct_blocks:
+            key = (f.mod.rel, line, "P009")
+            if key not in seen:
+                seen.add(key)
+                findings.append(_mk_lock(
+                    "P009", f, line,
+                    f"blocking call {desc} while holding "
+                    f"{_fmt(lock)} — every other thread contending on the "
+                    "lock stalls for the full blocking duration"))
+        for callee_key, line, held in f.calls:
+            if not held:
+                continue
+            callee = facts.get(callee_key)
+            if callee is None or not callee.trans_blocks:
+                continue
+            desc, where = callee.trans_blocks[0]
+            key = (f.mod.rel, line, "P009")
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(_mk_lock(
+                "P009", f, line,
+                f"call to {callee.fi.qualname}() while holding "
+                f"{_fmt(held[-1])} — it blocks on {desc} ({where})"))
+
+    # P008 — edges, then cyclic SCCs
+    edges: Dict[Tuple[LockId, LockId], Tuple[_FnFacts, int]] = {}
+    for f in facts.values():
+        for a, b, line in f.direct_edges:
+            edges.setdefault((a, b), (f, line))
+        for callee_key, line, held in f.calls:
+            callee = facts.get(callee_key)
+            if callee is None:
+                continue
+            for lock in callee.trans_locks:
+                for h in held:
+                    edges.setdefault((h, lock), (f, line))
+    adj: Dict[LockId, Set[LockId]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set())
+    sccs = _cyclic_sccs(adj)
+    for (a, b), (f, line) in sorted(
+            edges.items(), key=lambda kv: (kv[1][0].mod.rel, kv[1][1])):
+        in_cycle = a == b or any(a in scc and b in scc for scc in sccs)
+        if not in_cycle:
+            continue
+        if a == b:
+            msg = (f"{_fmt(a)} re-acquired while already held — "
+                   "threading.Lock is non-reentrant; this self-deadlocks")
+        else:
+            other = edges.get((b, a))
+            where = (f" (reverse order at {other[0].mod.rel}:{other[1]})"
+                     if other else "")
+            msg = (f"{_fmt(b)} acquired while holding {_fmt(a)}, but the "
+                   f"opposite order also exists{where} — cyclic lock "
+                   "order can deadlock the comm thread against the trainer")
+        findings.append(_mk_lock("P008", f, line, msg))
+    return findings
+
+
+def _cyclic_sccs(adj: Dict[LockId, Set[LockId]]) -> List[Set[LockId]]:
+    """Tarjan SCCs with more than one node (self-loops handled by caller)."""
+    index: Dict[LockId, int] = {}
+    low: Dict[LockId, int] = {}
+    on_stack: Set[LockId] = set()
+    stack: List[LockId] = []
+    out: List[Set[LockId]] = []
+    counter = [0]
+
+    def strongconnect(v: LockId) -> None:
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in adj.get(v, ()):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            scc: Set[LockId] = set()
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                scc.add(w)
+                if w == v:
+                    break
+            if len(scc) > 1:
+                out.append(scc)
+
+    for v in list(adj):
+        if v not in index:
+            strongconnect(v)
+    return out
+
+
+def _fmt(lock: LockId) -> str:
+    return f"`{lock[0]}.{lock[1]}`"
+
+
+def _mk_lock(rule: str, f: _FnFacts, line: int, message: str) -> Finding:
+    return Finding(rule=rule, path=f.mod.rel, line=line, col=0,
+                   message=message, line_text=f.mod.line_text(line))

@@ -1,0 +1,523 @@
+"""Protocol model: AST extraction of the distributed comm plane.
+
+Everything is syntactic (no import of analyzed code), built on graftlint's
+module index. The model captures, per scanned tree:
+
+- **message-type constants** — ``MSG_TYPE_* = "wire_value"`` class attributes
+  (the ``message_define.py`` convention, plus CommunicationConstants and the
+  flow DSL's class constants);
+- **send sites** — every ``Message(<type>, ...)`` construction, with the
+  type expression resolved to a constant, a string literal, or a function
+  parameter (parameter-typed helpers like ``_broadcast_model(msg_type)`` are
+  resolved through their intra-class call sites);
+- **handler registrations** — every ``register_message_receive_handler(
+  <type>, <handler>)`` site (including local aliases of the bound method);
+- **per-class facts** — method send sets, intra-class call edges, round-
+  state mutations, round comparisons, and ``finish()``/``done.set()`` calls.
+
+The flow graph is keyed by **wire value**, not constant name, so aliases
+(``MyMessage.MSG_TYPE_CONNECTION_IS_READY`` vs ``CommunicationConstants.
+MSG_TYPE_CONNECTION_IS_READY``) merge into one node exactly as they do on
+the wire.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..graftlint.analyzer import FuncInfo, ModuleInfo, dotted
+
+MSG_TYPE_PREFIX = "MSG_TYPE"
+
+# dotted-call suffixes that mark an FSM terminal edge
+FINISH_CALLS = ("finish",)
+FINISH_EVENT_CALLS = ("done.set",)
+
+
+class MsgConstant:
+    __slots__ = ("owner", "attr", "value", "rel", "line")
+
+    def __init__(self, owner: str, attr: str, value: str, rel: str,
+                 line: int):
+        self.owner = owner      # defining class name
+        self.attr = attr        # MSG_TYPE_* attribute name
+        self.value = value      # wire string
+        self.rel = rel          # repo-relative module path
+        self.line = line
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.owner}.{self.attr}"
+
+
+class TypeRef:
+    """A resolved message-type expression at a send/registration site."""
+
+    __slots__ = ("kind", "value", "owner", "attr", "param", "line")
+
+    def __init__(self, kind: str, line: int, value: Optional[str] = None,
+                 owner: Optional[str] = None, attr: Optional[str] = None,
+                 param: Optional[str] = None):
+        self.kind = kind  # const | literal | param | missing | unknown
+        self.value = value
+        self.owner = owner
+        self.attr = attr
+        self.param = param
+        self.line = line
+
+
+class SendSite:
+    __slots__ = ("rel", "cls", "method", "line", "value", "ref")
+
+    def __init__(self, rel: str, cls: Optional[str], method: str, line: int,
+                 value: str, ref: TypeRef):
+        self.rel = rel
+        self.cls = cls
+        self.method = method
+        self.line = line
+        self.value = value
+        self.ref = ref
+
+
+class HandlerReg:
+    __slots__ = ("rel", "cls", "method", "line", "value", "ref", "handler")
+
+    def __init__(self, rel: str, cls: Optional[str], method: str, line: int,
+                 value: Optional[str], ref: TypeRef,
+                 handler: Optional[str]):
+        self.rel = rel
+        self.cls = cls            # registering class
+        self.method = method      # method containing the registration
+        self.line = line
+        self.value = value        # wire value (None if unresolved)
+        self.ref = ref
+        self.handler = handler    # handler method name, or None for lambdas
+
+
+class MethodFacts:
+    __slots__ = ("name", "fi", "sends", "self_calls", "finishes",
+                 "round_writes", "subscript_writes", "has_round_compare")
+
+    def __init__(self, name: str, fi: FuncInfo):
+        self.name = name
+        self.fi = fi
+        self.sends: List[TypeRef] = []
+        # (callee name, positional arg exprs, keyword arg exprs, line)
+        self.self_calls: List[Tuple[str, List[ast.expr],
+                                    Dict[str, ast.expr], int]] = []
+        self.finishes = False
+        self.round_writes: List[int] = []       # self.round_idx = ... lines
+        self.subscript_writes: List[Tuple[str, int]] = []  # self.X[...] = ...
+        self.has_round_compare = False
+
+
+class ClassFacts:
+    __slots__ = ("name", "rel", "module", "methods", "registrations",
+                 "finish_anywhere")
+
+    def __init__(self, name: str, rel: str, module: ModuleInfo):
+        self.name = name
+        self.rel = rel
+        self.module = module
+        self.methods: Dict[str, MethodFacts] = {}
+        self.registrations: List[HandlerReg] = []
+        self.finish_anywhere = False
+
+    @property
+    def role(self) -> Optional[str]:
+        """Comm-plane role by naming convention (None = undetermined)."""
+        if "Server" in self.name:
+            return "server"
+        if "Client" in self.name:
+            return "client"
+        return None
+
+    def closure(self, method: str) -> List[MethodFacts]:
+        """``method`` plus every same-class method reachable via self-calls."""
+        seen: Set[str] = set()
+        order: List[MethodFacts] = []
+        work = [method]
+        while work:
+            name = work.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            mf = self.methods.get(name)
+            if mf is None:
+                continue
+            order.append(mf)
+            for callee, _a, _k, _l in mf.self_calls:
+                if callee not in seen:
+                    work.append(callee)
+        return order
+
+
+class ProtoModel:
+    def __init__(self) -> None:
+        self.constants: List[MsgConstant] = []
+        # keyed by (defining module name, class name): the reference-FedML
+        # convention names every define class `MyMessage`, so a bare-name
+        # key would silently merge unrelated protocols the moment a second
+        # package grows its own define class
+        self.constants_by_key: Dict[Tuple[str, str],
+                                    Dict[str, MsgConstant]] = {}
+        self.owner_index: Dict[str, List[Tuple[str, str]]] = {}
+        self.value_to_constants: Dict[str, List[MsgConstant]] = {}
+        self.classes: Dict[Tuple[str, str], ClassFacts] = {}  # (rel, name)
+        self.sends: Dict[str, List[SendSite]] = {}      # value -> sites
+        self.handlers: Dict[str, List[HandlerReg]] = {}  # value -> regs
+        self.missing_refs: List[Tuple[str, Optional[str], str, TypeRef]] = []
+        self.literal_refs: List[Tuple[str, Optional[str], str, TypeRef]] = []
+
+    # -- queries used by the rules and the coverage gate ---------------------
+    def values(self) -> Set[str]:
+        return set(self.sends) | set(self.handlers) | set(
+            self.value_to_constants)
+
+    def classify_value(self, value: str) -> str:
+        sent = bool(self.sends.get(value))
+        handled = bool(self.handlers.get(value))
+        if sent and handled:
+            return "sent+handled"
+        if sent:
+            return "sent-only"
+        if handled:
+            return "handled-only"
+        return "unused"
+
+    def direction(self, value: str) -> Optional[str]:
+        """'c2s' / 's2c' when every alias constant name agrees, else None."""
+        dirs = set()
+        for c in self.value_to_constants.get(value, []):
+            if "C2S" in c.attr:
+                dirs.add("c2s")
+            if "S2C" in c.attr:
+                dirs.add("s2c")
+        return dirs.pop() if len(dirs) == 1 else None
+
+    def coverage(self) -> Dict[str, Dict[str, object]]:
+        """Machine-readable per-value classification (for --json diffing)."""
+        out: Dict[str, Dict[str, object]] = {}
+        for value in sorted(self.values()):
+            out[value] = {
+                "classification": self.classify_value(value),
+                "constants": sorted(
+                    c.qualname for c in self.value_to_constants.get(value, [])
+                ),
+                "send_sites": len(self.sends.get(value, [])),
+                "handler_sites": len(self.handlers.get(value, [])),
+            }
+        return out
+
+
+# ---------------------------------------------------------------------------
+# extraction
+# ---------------------------------------------------------------------------
+
+
+def owning_class(fi: FuncInfo) -> Optional[str]:
+    f: Optional[FuncInfo] = fi
+    while f is not None:
+        if f.class_name:
+            return f.class_name
+        f = f.parent
+    return None
+
+
+def owning_method(fi: FuncInfo) -> str:
+    """Nearest enclosing class method (or top-level function) name."""
+    f, last = fi, fi
+    while f is not None:
+        last = f
+        if f.class_name:
+            return f.name
+        f = f.parent
+    return last.name
+
+
+def _method_params(fi: FuncInfo) -> List[str]:
+    params = fi.params()
+    if params and params[0] in ("self", "cls"):
+        params = params[1:]
+    return params
+
+
+def build_model(modules: Dict[str, ModuleInfo]) -> ProtoModel:
+    model = ProtoModel()
+    _collect_constants(modules, model)
+    for mod in modules.values():
+        _collect_module_facts(mod, model)
+    _resolve_param_sends(model)
+    return model
+
+
+def _collect_constants(modules: Dict[str, ModuleInfo],
+                       model: ProtoModel) -> None:
+    for mod in modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for stmt in node.body:
+                if not (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)):
+                    continue
+                name = stmt.targets[0].id
+                if not name.startswith(MSG_TYPE_PREFIX):
+                    continue
+                if not (isinstance(stmt.value, ast.Constant)
+                        and isinstance(stmt.value.value, str)):
+                    continue
+                c = MsgConstant(node.name, name, stmt.value.value, mod.rel,
+                                stmt.lineno)
+                model.constants.append(c)
+                key = (mod.name, node.name)
+                if key not in model.constants_by_key:
+                    model.constants_by_key[key] = {}
+                    model.owner_index.setdefault(node.name, []).append(key)
+                model.constants_by_key[key][name] = c
+                model.value_to_constants.setdefault(c.value, []).append(c)
+
+
+def _owner_candidates(owner: str, mod: ModuleInfo,
+                      model: ProtoModel) -> List[Tuple[str, str]]:
+    """Define-class keys a bare class name may resolve to FROM ``mod``:
+    the module's own class first, then the from-import target, then (only
+    when unambiguous or nothing local matched) every same-named class."""
+    keys = model.owner_index.get(owner, [])
+    if len(keys) <= 1:
+        return keys
+    local = [k for k in keys if k[0] == mod.name]
+    if local:
+        return local
+    imp = mod.from_imports.get(owner)
+    if imp:
+        imported = [k for k in keys if k[0] == imp[0]]
+        if imported:
+            return imported
+    return keys
+
+
+def _resolve_type_expr(expr: ast.expr, mod: ModuleInfo, cls: Optional[str],
+                       fi: FuncInfo, model: ProtoModel,
+                       _depth: int = 0) -> TypeRef:
+    line = getattr(expr, "lineno", fi.node.lineno)
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return TypeRef("literal", line, value=expr.value)
+    if isinstance(expr, ast.Name):
+        if expr.id in _method_params(fi):
+            return TypeRef("param", line, param=expr.id)
+        if _depth < 2:
+            # single-assignment local: t = MyMessage.MSG_TYPE_X; Message(t)
+            for node in ast.walk(fi.node):
+                if (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and node.targets[0].id == expr.id):
+                    return _resolve_type_expr(node.value, mod, cls, fi,
+                                              model, _depth + 1)
+        return TypeRef("unknown", line)
+    ds = dotted(expr)
+    if ds is None:
+        return TypeRef("unknown", line)
+    parts = ds.split(".")
+    if len(parts) < 2:
+        return TypeRef("unknown", line)
+    attr = parts[-1]
+    owner = parts[-2]
+    if owner in ("self", "cls"):
+        owner = cls or owner
+    candidates = _owner_candidates(owner, mod, model)
+    if candidates:
+        for key in candidates:
+            c = model.constants_by_key[key].get(attr)
+            if c is not None:
+                return TypeRef("const", line, value=c.value, owner=owner,
+                               attr=attr)
+        if attr.startswith(MSG_TYPE_PREFIX):
+            # absent from EVERY candidate define class -> renamed/removed
+            return TypeRef("missing", line, owner=owner, attr=attr)
+    return TypeRef("unknown", line)
+
+
+def _collect_module_facts(mod: ModuleInfo, model: ProtoModel) -> None:
+    for fi in mod.funcs_by_node.values():
+        if isinstance(fi.node, ast.Lambda):
+            continue
+        cls = owning_class(fi)
+        method = owning_method(fi)
+        cf = None
+        if cls is not None:
+            cf = model.classes.get((mod.rel, cls))
+            if cf is None:
+                cf = model.classes[(mod.rel, cls)] = ClassFacts(
+                    cls, mod.rel, mod)
+            mf = cf.methods.get(method)
+            if mf is None:
+                mf = cf.methods[method] = MethodFacts(method, fi)
+        else:
+            mf = MethodFacts(method, fi)
+
+        # local aliases of the registration method:
+        #   reg = self.register_message_receive_handler
+        reg_aliases: Set[str] = set()
+        for node in _own_nodes(fi.node):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                vds = dotted(node.value)
+                if vds and vds.endswith("register_message_receive_handler"):
+                    reg_aliases.add(node.targets[0].id)
+
+        for node in _own_nodes(fi.node):
+            if isinstance(node, ast.Call):
+                _collect_call(node, mod, cls, method, fi, mf, cf, model,
+                              reg_aliases)
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    base = t
+                    if isinstance(base, ast.Subscript):
+                        inner = base.value
+                        if (isinstance(inner, ast.Attribute)
+                                and isinstance(inner.value, ast.Name)
+                                and inner.value.id == "self"):
+                            mf.subscript_writes.append(
+                                (inner.attr, t.lineno))
+                        continue
+                    if (isinstance(base, ast.Attribute)
+                            and isinstance(base.value, ast.Name)
+                            and base.value.id == "self"
+                            and base.attr == "round_idx"):
+                        mf.round_writes.append(t.lineno)
+            elif isinstance(node, ast.Compare):
+                try:
+                    text = ast.unparse(node).lower()
+                except Exception:  # pragma: no cover — unparse is total
+                    text = ""
+                if "round" in text or "rnd" in text:
+                    mf.has_round_compare = True
+
+
+def _collect_call(node: ast.Call, mod: ModuleInfo, cls: Optional[str],
+                  method: str, fi: FuncInfo, mf: MethodFacts,
+                  cf: Optional[ClassFacts], model: ProtoModel,
+                  reg_aliases: Set[str]) -> None:
+    ds = dotted(node.func)
+    last = ds.split(".")[-1] if ds else ""
+
+    # Message(<type>, ...) construction == a send site (everything the
+    # managers construct is destined for the wire; zero-arg Message() is
+    # the deserialization shell and is skipped)
+    if last == "Message" and node.args:
+        ref = _resolve_type_expr(node.args[0], mod, cls, fi, model)
+        mf.sends.append(ref)
+        _index_type_site(model, mod, cls, method, ref, is_send=True)
+
+    # handler registration (direct or via a local alias)
+    is_reg = (ds is not None
+              and ds.endswith("register_message_receive_handler")) or (
+        isinstance(node.func, ast.Name) and node.func.id in reg_aliases)
+    if is_reg and node.args:
+        ref = _resolve_type_expr(node.args[0], mod, cls, fi, model)
+        handler = None
+        if len(node.args) > 1:
+            hds = dotted(node.args[1])
+            if hds and hds.startswith("self."):
+                handler = hds.split(".", 1)[1]
+        reg = HandlerReg(mod.rel, cls, method, node.lineno, ref.value, ref,
+                         handler)
+        if cf is not None:
+            cf.registrations.append(reg)
+        if ref.value is not None:
+            model.handlers.setdefault(ref.value, []).append(reg)
+        _index_type_site(model, mod, cls, method, ref, is_send=False)
+
+    # terminal edges
+    if ds is not None and (
+            ds in tuple(f"self.{n}" for n in FINISH_CALLS)
+            or any(ds.endswith(f".{n}") for n in FINISH_EVENT_CALLS)):
+        mf.finishes = True
+        if cf is not None:
+            cf.finish_anywhere = True
+
+    # intra-class call edge
+    if (ds is not None and ds.startswith("self.")
+            and len(ds.split(".")) == 2 and cf is not None):
+        callee = ds.split(".")[1]
+        kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+        mf.self_calls.append((callee, list(node.args), kwargs, node.lineno))
+
+
+def _index_type_site(model: ProtoModel, mod: ModuleInfo, cls: Optional[str],
+                     method: str, ref: TypeRef, is_send: bool) -> None:
+    if ref.kind == "missing":
+        model.missing_refs.append((mod.rel, cls, method, ref))
+        return
+    if ref.kind == "literal":
+        model.literal_refs.append((mod.rel, cls, method, ref))
+    if ref.value is None:
+        return
+    if is_send:
+        model.sends.setdefault(ref.value, []).append(
+            SendSite(mod.rel, cls, method, ref.line, ref.value, ref))
+
+
+def _resolve_param_sends(model: ProtoModel) -> None:
+    """Resolve parameter-typed sends (``def _broadcast_model(self,
+    msg_type): ... Message(msg_type, ...)``) through intra-class call
+    sites, attributing the send to the construction site."""
+    for cf in model.classes.values():
+        for mf in cf.methods.values():
+            param_sends = [r for r in mf.sends if r.kind == "param"]
+            if not param_sends:
+                continue
+            params = _method_params(mf.fi)
+            for ref in param_sends:
+                if ref.param not in params:
+                    continue
+                idx = params.index(ref.param)
+                for caller in cf.methods.values():
+                    for callee, args, kwargs, _line in caller.self_calls:
+                        if callee != mf.name:
+                            continue
+                        arg = kwargs.get(ref.param)
+                        if arg is None and idx < len(args):
+                            arg = args[idx]
+                        if arg is None:
+                            continue
+                        sub = _resolve_type_expr(
+                            arg, cf.module, cf.name, caller.fi, model)
+                        if sub.value is not None:
+                            model.sends.setdefault(sub.value, []).append(
+                                SendSite(cf.rel, cf.name, mf.name, ref.line,
+                                         sub.value, sub))
+                        elif sub.kind == "missing":
+                            model.missing_refs.append(
+                                (cf.rel, cf.name, caller.name, sub))
+
+
+def _own_nodes(root: ast.AST):
+    """Nodes lexically in ``root``, not descending into nested defs."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def enumerate_msg_constants(paths: Sequence[str], repo_root: str
+                            ) -> List[MsgConstant]:
+    """Standalone AST enumeration of every MSG_TYPE_* constant under
+    ``paths`` — used by the coverage gate to prove the flow graph has no
+    silent gaps (it must classify every constant this finds)."""
+    from ..graftlint.analyzer import collect_files, load_modules
+
+    modules = load_modules(collect_files(paths), repo_root)
+    model = ProtoModel()
+    _collect_constants(modules, model)
+    return model.constants

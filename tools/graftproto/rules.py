@@ -1,0 +1,282 @@
+"""Protocol rules P001–P007 over the extracted :class:`ProtoModel`.
+
+P001 sent-but-never-handled (incl. handled-only-on-the-wrong-role)
+P002 handled-but-never-sent
+P003 type-constant drift (stale attribute refs, literals shadowing
+     constants, duplicate wire values in one define class, dead constants)
+P004 replay-unsafe handlers (round-state mutation without a round guard)
+P005 no-path-to-finish (FSM classes that can never terminate; terminal
+     messages nobody sends)
+P006 sends bypassing the delivery layer's stamping
+P007 payload-store writes skipping the sha256 digest
+
+P006/P007 exempt ``fedml_tpu/core/distributed/`` — that package IS the
+delivery plane the rules protect.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..graftlint.analyzer import ModuleInfo, dotted
+from .findings import Finding
+from .model import ClassFacts, ProtoModel, _own_nodes
+
+DELIVERY_PLANE_PREFIX = "fedml_tpu/core/distributed/"
+
+# tokens whose presence in an enclosing function marks the digest path
+DIGEST_TOKENS = ("arrays_digest", "PAYLOAD_SHA256")
+
+
+def _mk(rule: str, mod_rel: str, line: int, message: str,
+        modules_by_rel: Dict[str, ModuleInfo]) -> Finding:
+    mod = modules_by_rel.get(mod_rel)
+    line_text = mod.line_text(line) if mod is not None else ""
+    return Finding(rule=rule, path=mod_rel, line=line, col=0,
+                   message=message, line_text=line_text)
+
+
+def check_protocol(model: ProtoModel,
+                   modules: Dict[str, ModuleInfo]) -> List[Finding]:
+    by_rel = {m.rel: m for m in modules.values()}
+    findings: List[Finding] = []
+    findings += _check_flow_graph(model, by_rel)
+    findings += _check_drift(model, by_rel)
+    findings += _check_replay_safety(model, by_rel)
+    findings += _check_termination(model, by_rel)
+    findings += _check_delivery_invariants(model, modules, by_rel)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# P001 / P002 — the message-flow graph
+# ---------------------------------------------------------------------------
+
+
+def _check_flow_graph(model: ProtoModel, by_rel) -> List[Finding]:
+    findings: List[Finding] = []
+    for value in sorted(model.values()):
+        sends = model.sends.get(value, [])
+        regs = model.handlers.get(value, [])
+        if sends and not regs:
+            for s in sends:
+                findings.append(_mk(
+                    "P001", s.rel, s.line,
+                    f"message type {value!r} is sent here but no "
+                    "register_message_receive_handler site handles it "
+                    "anywhere — the message is silently dropped by every "
+                    "receiver", by_rel))
+        elif regs and not sends:
+            for r in regs:
+                findings.append(_mk(
+                    "P002", r.rel, r.line,
+                    f"message type {value!r} is handled here but never "
+                    "sent by any peer — this handler is dead code (or the "
+                    "sender was renamed away)", by_rel))
+        elif sends and regs:
+            findings += _check_roles(model, value, sends, regs, by_rel)
+    return findings
+
+
+def _check_roles(model: ProtoModel, value: str, sends, regs,
+                 by_rel) -> List[Finding]:
+    """Direction check for C2S_* / S2C_* named constants: the type must be
+    handled on the receiving role (and sent from the originating one)."""
+    direction = model.direction(value)
+    if direction is None:
+        return []
+    recv_role = "server" if direction == "c2s" else "client"
+    send_role = "client" if direction == "c2s" else "server"
+    findings: List[Finding] = []
+
+    def role_of(cls: Optional[str], rel: str) -> Optional[str]:
+        cf = model.classes.get((rel, cls)) if cls else None
+        return cf.role if cf is not None else None
+
+    reg_roles = {role_of(r.cls, r.rel) for r in regs}
+    if reg_roles and None not in reg_roles and recv_role not in reg_roles:
+        r = regs[0]
+        findings.append(_mk(
+            "P001", r.rel, r.line,
+            f"{direction.upper()} message type {value!r} is registered "
+            f"only on {'/'.join(sorted(x for x in reg_roles if x))} "
+            f"managers — the receiving role ({recv_role}) has no handler, "
+            "so the message is dropped where it matters", by_rel))
+    for s in sends:
+        r = role_of(s.cls, s.rel)
+        if r is not None and r != send_role:
+            findings.append(_mk(
+                "P001", s.rel, s.line,
+                f"{direction.upper()} message type {value!r} is sent from "
+                f"a {r}-role manager ({s.cls}) — the naming convention "
+                f"says only the {send_role} originates it", by_rel))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# P003 — type-constant drift
+# ---------------------------------------------------------------------------
+
+
+def _check_drift(model: ProtoModel, by_rel) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel, _cls, _method, ref in model.missing_refs:
+        findings.append(_mk(
+            "P003", rel, ref.line,
+            f"{ref.owner}.{ref.attr} does not exist on the protocol class "
+            f"{ref.owner} — a renamed/removed MSG_TYPE constant; this "
+            "raises AttributeError the first time the path runs", by_rel))
+    for rel, _cls, _method, ref in model.literal_refs:
+        aliases = model.value_to_constants.get(ref.value or "", [])
+        if aliases:
+            names = ", ".join(sorted(c.qualname for c in aliases))
+            findings.append(_mk(
+                "P003", rel, ref.line,
+                f"raw string {ref.value!r} at a message-type position "
+                f"duplicates the protocol constant {names} — a rename in "
+                "the define class silently strands this site", by_rel))
+    # duplicate wire values inside one define class (per defining module:
+    # two packages may legitimately both name their define class MyMessage)
+    for (_mod_name, owner), consts in sorted(model.constants_by_key.items()):
+        seen: Dict[str, str] = {}
+        for attr, c in consts.items():
+            first = seen.get(c.value)
+            if first is not None:
+                findings.append(_mk(
+                    "P003", c.rel, c.line,
+                    f"{owner}.{attr} re-uses wire value {c.value!r} already "
+                    f"bound to {owner}.{first} — two FSM edges collapse "
+                    "into one on the wire", by_rel))
+            else:
+                seen[c.value] = attr
+    # dead constants: defined, never at any send/registration site
+    for c in model.constants:
+        if not model.sends.get(c.value) and not model.handlers.get(c.value):
+            findings.append(_mk(
+                "P003", c.rel, c.line,
+                f"{c.qualname} ({c.value!r}) is defined but never sent nor "
+                "handled anywhere — dead protocol surface (or the use "
+                "sites drifted to a different constant)", by_rel))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# P004 — replay-unsafe handlers
+# ---------------------------------------------------------------------------
+
+
+def _check_replay_safety(model: ProtoModel, by_rel) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[tuple] = set()
+    for cf in model.classes.values():
+        for reg in cf.registrations:
+            if reg.handler is None:
+                continue
+            closure = cf.closure(reg.handler)
+            if not closure:
+                continue
+            guarded = any(mf.has_round_compare for mf in closure)
+            if guarded:
+                continue
+            mutations = []
+            for mf in closure:
+                mutations += [(line, "self.round_idx") for line in
+                              mf.round_writes]
+                mutations += [(line, f"self.{attr}[...]")
+                              for attr, line in mf.subscript_writes]
+            if not mutations:
+                continue
+            line, what = min(mutations)
+            key = (cf.rel, cf.name, reg.handler, line)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(_mk(
+                "P004", cf.rel, line,
+                f"handler {cf.name}.{reg.handler} (for "
+                f"{reg.value or '?'!r}) mutates round state ({what}) "
+                "without any round comparison in its call closure — a "
+                "replayed or stale message re-enters the round "
+                "(PR 4 replay-idempotence contract)", by_rel))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# P005 — termination
+# ---------------------------------------------------------------------------
+
+
+def _check_termination(model: ProtoModel, by_rel) -> List[Finding]:
+    findings: List[Finding] = []
+    for cf in model.classes.values():
+        if not cf.registrations:
+            continue
+        first = min(r.line for r in cf.registrations)
+        if not cf.finish_anywhere:
+            findings.append(_mk(
+                "P005", cf.rel, first,
+                f"{cf.name} registers message handlers but no method ever "
+                "calls self.finish() or done.set() — the receive loop can "
+                "never terminate (protocol deadlock on shutdown)", by_rel))
+            continue
+        # pairing check: the terminal handlers' trigger types must be sent
+        terminal_regs = [
+            r for r in cf.registrations
+            if r.handler is not None
+            and any(mf.finishes for mf in cf.closure(r.handler))
+        ]
+        for r in terminal_regs:
+            if r.value is not None and not model.sends.get(r.value):
+                findings.append(_mk(
+                    "P005", r.rel, r.line,
+                    f"{cf.name}'s only path to finish() runs on "
+                    f"{r.value!r}, which no peer ever sends — both roles "
+                    "block forever waiting on each other", by_rel))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# P006 / P007 — delivery invariants
+# ---------------------------------------------------------------------------
+
+
+def _check_delivery_invariants(model: ProtoModel,
+                               modules: Dict[str, ModuleInfo],
+                               by_rel) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in modules.values():
+        if mod.rel.startswith(DELIVERY_PLANE_PREFIX):
+            continue
+        for fi in mod.funcs_by_node.values():
+            fn_src = None
+            for node in _own_nodes(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                ds = dotted(node.func)
+                if ds is None:
+                    continue
+                if ".com_manager.send_message" in f".{ds}":
+                    findings.append(_mk(
+                        "P006", mod.rel, node.lineno,
+                        "raw backend send (com_manager.send_message) "
+                        "bypasses FedMLCommManager.send_message — the "
+                        "message leaves without its seq/epoch stamp, "
+                        "payload offload or retry policy, so the "
+                        "receiver's dedup window cannot recognize its "
+                        "duplicates", by_rel))
+                if ".payload_store.put" in f".{ds}":
+                    if fn_src is None:
+                        try:
+                            fn_src = ast.unparse(fi.node)
+                        except Exception:  # pragma: no cover
+                            fn_src = ""
+                    if not any(tok in fn_src for tok in DIGEST_TOKENS):
+                        findings.append(_mk(
+                            "P007", mod.rel, node.lineno,
+                            "payload-store write without a sha256 digest "
+                            "in the enclosing function — attach "
+                            "MSG_ARG_KEY_PAYLOAD_SHA256 (arrays_digest) "
+                            "before offloading, or a torn/corrupt blob "
+                            "reaches the FSM unverified", by_rel))
+    return findings

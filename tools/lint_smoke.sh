@@ -1,10 +1,17 @@
 #!/usr/bin/env bash
-# CI smoke for the graftlint static-analysis gate: the shipped tree must have
-# ZERO non-baselined findings (tools/graftlint/baseline.json holds the
-# suppressed-but-visible pre-existing debt), and the JSON output must parse.
+# CI smoke for BOTH static-analysis gates:
+#  - graftlint  (G001–G005, JAX trace/donation/recompile/thread safety)
+#  - graftproto (P001–P009, comm-plane protocol + lock-order verification)
+# The shipped tree must have ZERO non-baselined findings in each suite
+# (tools/<suite>/baseline.json holds the suppressed-but-visible debt), the
+# JSON reports must parse, and each gate must bite on a known-bad fixture.
 #
-# This is the cheap half of the tier-1 lint gate (tests/test_graftlint.py is
-# the full one): pure-AST, no jax import, sub-second.
+# Exit-code contract (both suites): 0 clean, 1 findings, 2 analyzer crash —
+# a CI failure here is diagnosable at a glance.
+#
+# This is the cheap half of the tier-1 lint gate (tests/test_graftlint.py +
+# tests/test_graftproto.py are the full ones): pure-AST, no jax import,
+# sub-second.
 #
 # Usage: tools/lint_smoke.sh          (CI: exits non-zero on any regression)
 set -uo pipefail
@@ -26,18 +33,59 @@ import sys
 payload = json.loads(sys.argv[1])
 assert payload["exit_code"] == 0, payload
 assert payload["findings"] == [], payload["findings"]
-print(f"lint_smoke: OK — 0 findings ({payload['baselined']} baselined)")
+print(f"lint_smoke: graftlint OK — 0 findings "
+      f"({payload['baselined']} baselined)")
 EOF
 rc=$?
 if [ "$rc" -ne 0 ]; then
-    echo "lint_smoke: FAIL — JSON output did not validate" >&2
+    echo "lint_smoke: FAIL — graftlint JSON output did not validate" >&2
     exit 1
 fi
 
 # the gate must actually bite: a known-bad fixture has to exit non-zero
 if python -m tools.graftlint tests/fixtures/graftlint/g001_bad.py \
         --no-baseline >/dev/null 2>&1; then
-    echo "lint_smoke: FAIL — analyzer passed a known-bad fixture" >&2
+    echo "lint_smoke: FAIL — graftlint passed a known-bad fixture" >&2
+    exit 1
+fi
+
+# ---- graftproto: the protocol pass, machine-readable -----------------------
+proto_out=$(timeout -k 10 120 python -m tools.graftproto fedml_tpu/ --json)
+rc=$?
+
+if [ "$rc" -ne 0 ]; then
+    echo "lint_smoke: FAIL — graftproto exited rc=$rc" >&2
+    printf '%s\n' "$proto_out" >&2
+    exit 1
+fi
+
+python - "$proto_out" <<'EOF'
+import json
+import sys
+
+payload = json.loads(sys.argv[1])
+assert payload["exit_code"] == 0, payload
+assert payload["findings"] == [], payload["findings"]
+# the flow graph must have classified every wire value — future PRs diff
+# these counts to see protocol surface grow/shrink
+cov = payload["coverage"]
+assert cov, "empty flow-graph coverage"
+bad = {v: c for v, c in cov.items()
+       if c["classification"] != "sent+handled"}
+assert bad == {}, f"unclassified wire values: {bad}"
+print(f"lint_smoke: graftproto OK — 0 findings "
+      f"({payload['baselined']} baselined, "
+      f"{len(cov)} wire values sent+handled)")
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "lint_smoke: FAIL — graftproto JSON output did not validate" >&2
+    exit 1
+fi
+
+if python -m tools.graftproto tests/fixtures/graftproto/p008_bad.py \
+        --no-baseline >/dev/null 2>&1; then
+    echo "lint_smoke: FAIL — graftproto passed a known-bad fixture" >&2
     exit 1
 fi
 
