@@ -80,6 +80,22 @@ FEDAVG_OVERRIDES = dict(
     learning_rate=0.1, frequency_of_the_test=1000,
 )
 
+# Million-client cohort leg (fedml_tpu/scale/ — ROADMAP "Million-client
+# simulation substrate"): N registered clients in a packed registry,
+# 10k-client cohorts sampled K-of-N on device, shards streamed through the
+# double-buffered prefetcher. Deliberately CPU-runnable (lr on synthetic
+# shapes): the leg measures the SUBSTRATE — rounds/s at population scale,
+# prefetch overlap fraction, and zero cohort-driven recompiles in steady
+# state — not model FLOPs. BENCH_REGISTRY_N / BENCH_COHORT_K scale it down
+# for smoke runs.
+MILLION_OVERRIDES = dict(
+    dataset="synthetic", model="lr", client_num_in_total=64,
+    client_num_per_round=16, comm_round=16, epochs=1, batch_size=8,
+    learning_rate=0.05, frequency_of_the_test=1000,
+)
+MILLION_REGISTRY_N = 1_000_000
+MILLION_COHORT_K = 10_000
+
 # The flagship is the PRODUCT shape: Llama-standard head_dim 128 with GQA
 # 16q/4kv on a wide-shallow d2048 x 8L body — chosen product-shape-first,
 # not max-MFU-first. Two levers got it to 75.7% MFU on the v5e
@@ -144,6 +160,11 @@ _FEDAVG_SOURCES = [
     "fedml_tpu/simulation/sp_api.py", "fedml_tpu/simulation/round_engine.py",
     "fedml_tpu/ml/local_train.py", "fedml_tpu/core/mlops/telemetry.py",
     "fedml_tpu/models/vision.py", "fedml_tpu/data/datasets.py", "bench.py",
+]
+_MILLION_SOURCES = [
+    "fedml_tpu/scale/registry.py", "fedml_tpu/scale/cohort_engine.py",
+    "fedml_tpu/scale/prefetch.py", "fedml_tpu/simulation/sp_api.py",
+    "fedml_tpu/simulation/round_engine.py", "bench.py",
 ]
 
 
@@ -369,6 +390,92 @@ def bench_fedavg() -> dict:
     }
 
 
+def bench_million_client() -> dict:
+    """FedAvg over a million-client registry with 10k-client streamed
+    cohorts (fedml_tpu/scale/). Headline numbers:
+
+    - ``million_rounds_per_sec`` — steady-state rounds/s with N registered
+      clients and K-client cohorts streaming through the prefetcher;
+    - ``million_prefetch_overlap`` — fraction of shard-gather time hidden
+      behind device compute over the measured window (>0 required: the
+      pipeline must actually overlap, not serialize);
+    - ``million_steady_compiles`` — XLA compiles during the measured
+      window (must be 0: cohort resampling every round is recompile-free
+      by construction — pad-to-bucket static shapes + jit'd K-of-N
+      sampling with a traced round index).
+    """
+    _maybe_force_platform()
+    import numpy as np  # noqa: F401  (jax init ordering)
+
+    import jax
+
+    import fedml_tpu as fedml
+    from fedml_tpu import data as data_mod
+    from fedml_tpu import models as model_mod
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.constants import BENCH_COMPILE_CACHE_DIR_DEFAULT
+    from fedml_tpu.core.mlops import telemetry
+    from fedml_tpu.simulation.sp_api import FedAvgAPI
+
+    # count compiles from the very first jit so the steady-state window's
+    # delta is trustworthy
+    telemetry.install_jax_listeners()
+
+    n = int(os.environ.get("BENCH_REGISTRY_N", MILLION_REGISTRY_N))
+    k = int(os.environ.get("BENCH_COHORT_K", MILLION_COHORT_K))
+    warmup, measured = 2, 6
+    args = Arguments(overrides=dict(
+        MILLION_OVERRIDES, client_registry=str(n), cohort_size=k,
+        cohort_prefetch=1,
+    ))
+    args.compilation_cache_dir = os.environ.get(
+        "BENCH_COMPILE_CACHE_DIR", BENCH_COMPILE_CACHE_DIR_DEFAULT
+    )
+    args = fedml.init(args, should_init_logs=False)
+    ds, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    api = FedAvgAPI(args, fedml.get_device(args), ds, bundle)
+
+    t0 = time.perf_counter()
+    args.round_idx = 0
+    for r in range(warmup):
+        api.run_round(r)
+    _sync(api.global_params)
+    compile_s = time.perf_counter() - t0
+
+    reg = telemetry.registry()
+    compiles0 = reg.counter("jax.compiles")
+    pf0 = api.cohort_engine.stats()
+    t0 = time.perf_counter()
+    for r in range(warmup, warmup + measured):
+        api.run_round(r)
+    _sync(api.global_params)
+    dt = time.perf_counter() - t0
+    steady_compiles = reg.counter("jax.compiles") - compiles0
+    pf1 = api.cohort_engine.stats()
+    api.cohort_engine.close()
+
+    win_gather = pf1["gather_s"] - pf0["gather_s"]
+    win_wait = pf1["wait_s"] - pf0["wait_s"]
+    overlap = (
+        max(0.0, min(1.0, 1.0 - win_wait / win_gather))
+        if win_gather > 1e-12 else 0.0
+    )
+    return {
+        "million_rounds_per_sec": round(measured / dt, 4),
+        "million_registry_n": n,
+        "million_cohort_k": k,
+        "million_prefetch_overlap": round(overlap, 4),
+        "million_prefetch_gather_s": round(win_gather, 4),
+        "million_prefetch_wait_s": round(win_wait, 4),
+        "million_steady_compiles": int(steady_compiles),
+        "million_compile_s": round(compile_s, 3),
+        "million_round_fused": api._round_step is not None,
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+    }
+
+
 def bench_cheetah() -> dict:
     """Single-chip flagship-transformer pretrain throughput + MFU."""
     import gc
@@ -589,15 +696,26 @@ def _translate_cheetah(parsed: dict):
     return parsed, platform
 
 
+def _translate_million(parsed: dict):
+    platform = parsed.pop("platform", None)
+    out = {"million_device_kind": parsed.pop("device_kind", None), **parsed}
+    return out, platform
+
+
 def leg_specs() -> list:
     """(name, argv, digest, translate) per leg, priority order: the headline
     FedAvg metric first, then the flagship, then the secondary shapes."""
     mfu = os.path.join(HERE, "tools", "mfu_sweep.py")
     me = os.path.join(HERE, "bench.py")
     py = sys.executable
+    million_n = int(os.environ.get("BENCH_REGISTRY_N", MILLION_REGISTRY_N))
+    million_k = int(os.environ.get("BENCH_COHORT_K", MILLION_COHORT_K))
     return [
         ("fedavg", [py, me, "--leg", "fedavg"],
          _digest(FEDAVG_OVERRIDES, _FEDAVG_SOURCES), _translate_fedavg),
+        ("fedavg_million_client", [py, me, "--leg", "million"],
+         _digest({"cfg": MILLION_OVERRIDES, "n": million_n, "k": million_k},
+                 _MILLION_SOURCES), _translate_million),
         ("cheetah", [py, me, "--leg", "cheetah"],
          _digest({"base": CHEETAH_BASE, "ladder": CHEETAH_LADDER,
                   "run": CHEETAH_RUN}, _CHEETAH_SOURCES), _translate_cheetah),
@@ -650,10 +768,19 @@ def _probe_device_kind(timeout: float = 90.0):
 
     None kinds ACCEPT cached rows (the insurance case) rather than
     discarding them."""
+    # honor BENCH_PLATFORM in the probe snippet: a bare `import jax` dials
+    # the pinned axon backend (see _maybe_force_platform), so on a
+    # BENCH_PLATFORM=cpu host the probe would burn up to `timeout` seconds
+    # on a tunnel the legs never touch — and its "error"/"timeout" verdict
+    # would needlessly shrink leg timeouts for legs that run fine on CPU
+    plat = os.environ.get("BENCH_PLATFORM", "")
+    snippet = "import jax; "
+    if plat:
+        snippet += f"jax.config.update('jax_platforms', {plat!r}); "
+    snippet += "print(jax.devices()[0].device_kind)"
     try:
         p = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].device_kind)"],
+            [sys.executable, "-c", snippet],
             capture_output=True, text=True, timeout=timeout,
         )
         if p.returncode == 0 and p.stdout.strip():
@@ -777,7 +904,8 @@ def run_legs(budget_s: float, ttl_s: float, min_leg_s: float = 240.0,
 
 def main() -> None:
     if len(sys.argv) > 2 and sys.argv[1] == "--leg":
-        fn = {"fedavg": bench_fedavg, "cheetah": bench_cheetah}[sys.argv[2]]
+        fn = {"fedavg": bench_fedavg, "cheetah": bench_cheetah,
+              "million": bench_million_client}[sys.argv[2]]
         print(json.dumps(fn()), flush=True)
         return
     budget = float(os.environ.get("BENCH_BUDGET_S", "2400"))

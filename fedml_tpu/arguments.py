@@ -142,6 +142,25 @@ _SCHEMA: Dict[str, tuple] = {
     "superround_k": (int, 0),
     # sp cohort execution: vmap | map | auto (see FedAvgAPI.cohort_impl)
     "sp_cohort_impl": (str, ""),
+    # million-client cohort substrate (fedml_tpu/scale/ — docs/scale.md).
+    # client_registry: a client count ("1000000" registers N virtual
+    # clients over the dataset's shards) or a path to a registry saved
+    # with ClientRegistry.save; empty = off (legacy sampling).
+    "client_registry": (str, ""),
+    # sampled clients per round at registry scale (0 = client_num_per_round
+    # capped to the registry). Static per run — never a recompile source.
+    "cohort_size": (int, 0),
+    # cohorts prefetched ahead of the round (host→HBM double buffering);
+    # 0 disables streaming (synchronous gather, same semantics)
+    "cohort_prefetch": (int, 1),
+    # synthetic-registry sampling-weight skew: Gamma(k) heterogeneous
+    # participation propensities; 0 = uniform weights
+    "registry_weight_concentration": (float, 0.0),
+    # mesh placement rules (scale/partition_rules.py syntax, e.g.
+    # "cohort/.*=clients;.*="): cohort-plane and round-state leaf
+    # placement; empty = the built-in first-axis/replicated defaults
+    "mesh_partition_rules": (str, ""),
+    "mesh_state_rules": (str, ""),
     # persistent XLA compilation cache — repeat runs (and bench legs) skip
     # the compile wall entirely. Empty = disabled. Wired in fedml.init().
     "compilation_cache_dir": (str, ""),
@@ -249,6 +268,15 @@ class Arguments:
                 f"client_num_per_round ({self.client_num_per_round}) > "
                 f"client_num_in_total ({self.client_num_in_total})"
             )
+        if int(getattr(self, "cohort_size", 0) or 0) > 0 and not str(
+            getattr(self, "client_registry", "") or ""
+        ).strip():
+            raise ValueError(
+                "cohort_size requires client_registry (the registry defines "
+                "the population the cohort is sampled from)"
+            )
+        if int(getattr(self, "cohort_size", 0) or 0) < 0:
+            raise ValueError("cohort_size must be >= 0")
         for positive in ("batch_size", "comm_round", "epochs"):
             if getattr(self, positive) <= 0:
                 raise ValueError(f"{positive} must be positive")
@@ -322,6 +350,31 @@ def add_args() -> argparse.Namespace:
         choices=("auto", "never", "require"),
         help="what an existing checkpoint means at startup: auto resumes "
         "when present, never demands a fresh dir, require errors without one",
+    )
+    # million-client cohort substrate (fedml_tpu/scale/ — docs/scale.md)
+    parser.add_argument(
+        "--client_registry", type=str, default=None, metavar="N|PATH",
+        help="register N virtual clients over the dataset's shards (or "
+        "load a saved ClientRegistry npz); cohorts sample K-of-N on device",
+    )
+    parser.add_argument(
+        "--cohort_size", type=int, default=None, metavar="K",
+        help="clients sampled per round from the registry "
+        "(0 = client_num_per_round)",
+    )
+    parser.add_argument(
+        "--cohort_prefetch", type=int, default=None, metavar="D",
+        help="cohorts prefetched ahead of the round (0 disables streaming)",
+    )
+    parser.add_argument(
+        "--mesh_partition_rules", type=str, default=None,
+        help="regex=axes;... placement rules for the mesh cohort plane "
+        "(docs/scale.md)",
+    )
+    parser.add_argument(
+        "--mesh_state_rules", type=str, default=None,
+        help="regex=axes;... placement rules for the mesh round state "
+        "(docs/scale.md)",
     )
     # telemetry plane (defaults None so YAML keys win when the flag is absent)
     parser.add_argument(

@@ -102,7 +102,23 @@ class GRPCCommManager(BaseCommunicationManager):
             return b"ok"
 
         def handle_send_stream(request_iter, context) -> bytes:
-            data = b"".join(request_iter)
+            # bounded reassembly: the unary path is capped by the channel's
+            # max_receive_message_length, so the stream must enforce the
+            # same ceiling — otherwise any peer on the insecure channel
+            # could grow server memory without limit in a single RPC
+            chunks: List[bytes] = []
+            total = 0
+            for chunk in request_iter:
+                total += len(chunk)
+                if total > MAX_MESSAGE_BYTES:
+                    telemetry.counter_inc("comm.grpc.stream_overflows")
+                    context.abort(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED,
+                        f"streamed payload exceeds {MAX_MESSAGE_BYTES} "
+                        "bytes",
+                    )
+                chunks.append(chunk)
+            data = b"".join(chunks)
             telemetry.counter_inc("comm.grpc.messages_received")
             telemetry.counter_inc("comm.grpc.bytes_received", len(data))
             self._queue.put(data)

@@ -76,7 +76,23 @@ class Message:
     def set_arrays(self, arrays: List[np.ndarray]) -> None:
         self.arrays = [np.asarray(a) for a in arrays]
 
-    def get_arrays(self) -> List[np.ndarray]:
+    def get_arrays(self, copy: bool = False) -> List[np.ndarray]:
+        """The payload arrays, in canonical pytree-leaf order.
+
+        **Treat the result as READ-ONLY unless ``copy=True``.** On a
+        received message the arrays may be zero-copy views over the wire
+        buffer (``grpc_wire_format=raw`` decodes with ``np.frombuffer``
+        over immutable bytes — in-place mutation raises ``ValueError``),
+        while the npz path happens to return writable copies. That
+        asymmetry is a wire-format detail, not API surface: code that
+        mutates received arrays works or crashes depending on a transport
+        flag. ``copy=True`` returns fresh writable arrays on every call —
+        the explicit opt-in for consumers that must mutate in place.
+        (FL aggregation stacks/averages into new arrays, so the hot path
+        never needs the copy.)
+        """
+        if copy:
+            return [np.array(a) for a in self.arrays]
         return self.arrays
 
     # -- wire format ---------------------------------------------------------

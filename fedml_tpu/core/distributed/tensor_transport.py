@@ -23,6 +23,7 @@ analog. Measured by ``tools/bench_tensor_transport.py`` →
 from __future__ import annotations
 
 import json
+import math
 from typing import List, Sequence, Union
 
 import numpy as np
@@ -70,16 +71,55 @@ def decode_frames(buf: Union[bytes, memoryview]) -> List[np.ndarray]:
     view = memoryview(buf)
     if view[:4] != RAW_MAGIC:
         raise ValueError("not a raw tensor-frame body")
+    if len(view) < 8:
+        raise ValueError("truncated tensor frame (no header length)")
     hlen = int.from_bytes(view[4:8], "big")
-    metas = json.loads(bytes(view[8:8 + hlen]).decode("utf-8"))
+    if 8 + hlen > len(view):
+        raise ValueError(
+            f"truncated tensor frame (header wants {hlen} bytes, body has "
+            f"{len(view) - 8})"
+        )
+    try:
+        metas = json.loads(bytes(view[8:8 + hlen]).decode("utf-8"))
+    except ValueError as e:  # bit-flipped header bytes: clean error, not
+        raise ValueError(    # a raw JSONDecodeError/UnicodeDecodeError
+            f"corrupt tensor frame header: {e}"
+        ) from None
+    if not isinstance(metas, list):
+        raise ValueError("corrupt tensor frame header: not a tensor list")
     base = 8 + hlen
     out = []
-    for m in metas:
-        dt = np.dtype(m["dtype"])
-        n = int(np.prod(m["shape"])) if m["shape"] else 1
-        start = base + int(m["off"])
-        frame = view[start:start + n * dt.itemsize]
-        out.append(np.frombuffer(frame, dtype=dt).reshape(m["shape"]))
+    for i, m in enumerate(metas):
+        # validate the header's dtype/shape/off BEFORE touching the buffer:
+        # a truncated or corrupt frame must surface as a clean error (the
+        # receive loop counts + drops it), not a confusing np.frombuffer /
+        # reshape failure mid-decode
+        try:
+            dt = np.dtype(m["dtype"])
+            shape = [int(s) for s in m["shape"]]
+            off = int(m["off"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(
+                f"corrupt tensor frame header (tensor {i}): {e}"
+            ) from None
+        if off < 0 or any(s < 0 for s in shape):
+            raise ValueError(
+                f"corrupt tensor frame header (tensor {i}: negative "
+                "offset/shape)"
+            )
+        # arbitrary-precision Python ints: np.prod would wrap in int64 and
+        # an adversarial shape like [2**40, 2**40] could slip past the
+        # bounds check below with a garbage (even negative) byte count
+        n = math.prod(shape) if shape else 1
+        start = base + off
+        end = start + n * dt.itemsize
+        if end > len(view):
+            raise ValueError(
+                f"truncated tensor frame (tensor {i}: needs bytes "
+                f"[{start}, {end}) of a {len(view)}-byte body)"
+            )
+        frame = view[start:end]
+        out.append(np.frombuffer(frame, dtype=dt).reshape(shape))
     return out
 
 

@@ -1,0 +1,41 @@
+"""``fedml_tpu.scale`` — the million-client cohort substrate.
+
+Decouples federated population size N from device memory (ROADMAP
+"Million-client simulation substrate"): a compact packed client registry
+with on-device seeded K-of-N sampling (``registry.py``), a double-buffered
+host→HBM shard prefetcher that streams only the sampled cohort's data
+(``prefetch.py``), regex-over-named-pytree partition rules generalizing
+the mesh path's sharding (``partition_rules.py``), and the engine gluing
+them into the sp/mesh FedAvg loops (``cohort_engine.py``).
+
+Enable with ``--client_registry N`` (or a saved registry path) and
+``--cohort_size K``; see ``docs/scale.md``.
+"""
+
+from .cohort_engine import CohortEngine, build_cohort_engine
+from .partition_rules import (
+    DEFAULT_COHORT_RULES,
+    DEFAULT_STATE_RULES,
+    make_shardings,
+    match_partition_rules,
+    named_tree_map,
+    named_tree_paths,
+    parse_partition_rules,
+)
+from .prefetch import ShardPrefetcher, cohort_key
+from .registry import ClientRegistry
+
+__all__ = [
+    "ClientRegistry",
+    "CohortEngine",
+    "ShardPrefetcher",
+    "build_cohort_engine",
+    "cohort_key",
+    "DEFAULT_COHORT_RULES",
+    "DEFAULT_STATE_RULES",
+    "make_shardings",
+    "match_partition_rules",
+    "named_tree_map",
+    "named_tree_paths",
+    "parse_partition_rules",
+]

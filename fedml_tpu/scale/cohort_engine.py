@@ -1,0 +1,235 @@
+"""Cohort engine: registry-backed sampling + streamed shard prefetch.
+
+The glue that turns the sp/mesh FedAvg engines into million-client
+federations without touching their round math:
+
+- **Sampling** (`data_cohort`): the round's cohort is drawn from the
+  :class:`~.registry.ClientRegistry` — seeded, weighted, K-of-N,
+  on-device (one jit'd program, never a recompile source) — and mapped
+  through the registry's shard pointers to backing dataset rows. The
+  FedAvg engines keep operating on dataset rows exactly as before; only
+  WHO participates each round now comes from a population of N ≥ 1M.
+- **Streaming** (`gather`): cohort shards are gathered host-side and
+  placed on device by a :class:`~.prefetch.ShardPrefetcher`; serving
+  round *r* schedules round *r+1*'s gather in the background, so
+  steady-state rounds find their data already in HBM. Placement is a
+  callable supplied per call — the sp path places plain device arrays,
+  the mesh path places rule-driven ``NamedSharding`` arrays
+  (`partition_rules.py`) — the engine never needs to know.
+- **Accounting**: participation/staleness counters fold in per sampled
+  cohort; the registry identity (size, seed, column digest) extends the
+  run ledger's ``run_meta`` so ``--resume`` against a different registry
+  fails loudly instead of silently resampling every remaining round.
+
+Determinism: cohorts depend only on (registry seed, round index), so a
+resumed run samples the exact cohorts the dead run would have — the same
+property host-side ``np.random.RandomState(round_idx)`` sampling gave the
+small-N path, now at population scale.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from .prefetch import ShardPrefetcher, cohort_key
+from .registry import ClientRegistry
+
+logger = logging.getLogger(__name__)
+
+HostGatherFn = Callable[[np.ndarray], Any]
+PlaceFn = Callable[[Any], Any]
+
+# rounds of sampled-cohort cache kept for ledger replay / prefetch keying
+_COHORT_CACHE_ROUNDS = 8
+
+
+def build_cohort_engine(args, ds) -> Optional["CohortEngine"]:
+    """Construct the engine from ``--client_registry`` / ``--cohort_size``
+    (None when no registry is configured). ``client_registry`` is either a
+    client count (synthetic population over the dataset's shards) or a path
+    to a registry saved with :meth:`ClientRegistry.save`."""
+    spec = str(getattr(args, "client_registry", "") or "").strip()
+    if not spec:
+        return None
+    seed = int(getattr(args, "random_seed", 0))
+    try:
+        n = int(spec)  # accepts "1_000_000" spellings too
+    except ValueError:
+        n = None
+    if n is not None:
+        if n <= 0:
+            raise ValueError(
+                f"client_registry count must be positive, got {n}"
+            )
+        registry = ClientRegistry.synthetic(
+            n, backing_shards=ds.client_num, seed=seed,
+            weight_concentration=float(
+                getattr(args, "registry_weight_concentration", 0.0) or 0.0
+            ),
+        )
+    elif os.path.exists(spec):
+        registry = ClientRegistry.load(spec)
+        if int(registry.shard_ptrs.max()) >= ds.client_num:
+            raise ValueError(
+                f"registry {spec} points at shard "
+                f"{int(registry.shard_ptrs.max())} but the dataset has only "
+                f"{ds.client_num} client shards"
+            )
+    else:
+        raise ValueError(
+            "client_registry must be a client count or a path to a saved "
+            f"registry npz, got {spec!r} (no such file)"
+        )
+    k = int(getattr(args, "cohort_size", 0) or 0)
+    if k <= 0:
+        k = min(int(args.client_num_per_round), registry.num_clients)
+    depth = int(getattr(args, "cohort_prefetch", 1) or 0)
+    from ..core.mlops import telemetry
+
+    telemetry.gauge_set("scale.registry_clients", registry.num_clients)
+    telemetry.gauge_set("scale.cohort_size", k)
+    return CohortEngine(registry, cohort_size=k, prefetch_depth=depth,
+                        total_rounds=int(getattr(args, "comm_round", 0)
+                                         or 0))
+
+
+class CohortEngine:
+    """Per-run orchestration of one registry + one prefetcher."""
+
+    def __init__(self, registry: ClientRegistry, cohort_size: int,
+                 prefetch_depth: int = 1, total_rounds: int = 0):
+        self.registry = registry
+        self.cohort_size = int(cohort_size)
+        # when > 0, no prefetch is scheduled past the last round — the
+        # final round must not pay for a cohort nothing will consume
+        self.total_rounds = int(total_rounds)
+        if not 0 < self.cohort_size <= registry.num_clients:
+            raise ValueError(
+                f"cohort_size {cohort_size} must be in "
+                f"[1, {registry.num_clients}]"
+            )
+        self.prefetcher = ShardPrefetcher(depth=prefetch_depth)
+        self._sampler = registry.device_sampler(self.cohort_size)
+        # round -> (registry ids, dataset rows); bounded LRU-ish cache so
+        # the ledger's post-round replay of _client_sampling is free
+        self._cohorts: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        self._round_of_key: Dict[str, int] = {}
+        # rounds whose cohort was folded into the participation/staleness
+        # counters — sampling alone must NOT count (the prefetcher samples
+        # round r+1 ahead of time, and r+1 may never run)
+        self._noted: set = set()
+        self._host_gather: Optional[HostGatherFn] = None
+        # maps sampled rows → the rows the engine will actually be asked to
+        # gather (the mesh path pads cohorts to an axis multiple; prefetch
+        # keys must match the padded request or every take would miss)
+        self._transform: Callable[[np.ndarray], np.ndarray] = lambda r: r
+
+    # -- sampling ------------------------------------------------------------
+
+    def data_cohort(self, round_idx: int) -> np.ndarray:
+        """Dataset rows for round ``round_idx``'s cohort (deterministic)."""
+        return self._cohort(round_idx)[1]
+
+    def registry_cohort(self, round_idx: int) -> np.ndarray:
+        """Registry client ids for round ``round_idx``'s cohort."""
+        return self._cohort(round_idx)[0]
+
+    def _cohort(self, round_idx: int) -> Tuple[np.ndarray, np.ndarray]:
+        r = int(round_idx)
+        hit = self._cohorts.get(r)
+        if hit is not None:
+            return hit
+        import jax.numpy as jnp
+
+        ids = np.asarray(self._sampler(jnp.int32(r)))
+        rows = self.registry.shard_rows(ids)
+        self._cohorts[r] = (ids, rows)
+        self._round_of_key[cohort_key(self._transform(rows))] = r
+        while len(self._cohorts) > _COHORT_CACHE_ROUNDS:
+            oldest = min(self._cohorts)
+            old_rows = self._cohorts.pop(oldest)[1]
+            self._round_of_key.pop(cohort_key(self._transform(old_rows)),
+                                   None)
+        return self._cohorts[r]
+
+    def note_rounds(self, start_round: int, k: int) -> None:
+        """Replay participation accounting for a superround scan: the scan
+        body sampled rounds ``[start, start+k)`` ON DEVICE with this same
+        sampler, so re-deriving the cohorts host-side folds the identical
+        ids into the counters."""
+        for r in range(int(start_round), int(start_round) + int(k)):
+            self._note_round(r)
+
+    def _note_round(self, r: int) -> None:
+        """Fold round ``r``'s cohort into the counters exactly once, and
+        only for rounds that actually TRAIN (gather/scan), never for
+        lookahead sampling — the prefetcher samples r+1 speculatively and
+        a preempted run may never execute it."""
+        r = int(r)
+        if r in self._noted:
+            return
+        self.registry.note_participation(self._cohort(r)[0])
+        self._noted.add(r)
+        if len(self._noted) > 4 * _COHORT_CACHE_ROUNDS:
+            # the set only guards against double-noting recent rounds;
+            # ancient entries can go (rounds never repeat going forward)
+            for old in sorted(self._noted)[:_COHORT_CACHE_ROUNDS]:
+                self._noted.discard(old)
+
+    # -- streaming gather ----------------------------------------------------
+
+    def set_host_gather(self, fn: HostGatherFn) -> None:
+        """Install the host-side shard reader (rows → host arrays)."""
+        self._host_gather = fn
+
+    def set_cohort_transform(self, fn: Callable[[np.ndarray], np.ndarray]) \
+            -> None:
+        """Install the sampled-rows → requested-rows map (cohort padding).
+        Must be set before the first round is sampled."""
+        if self._cohorts:
+            raise RuntimeError(
+                "set_cohort_transform after cohorts were sampled would "
+                "desynchronize the prefetch keys"
+            )
+        self._transform = fn
+
+    def gather(self, cohort_rows: np.ndarray, place: PlaceFn) -> Any:
+        """Device arrays for ``cohort_rows`` — from the prefetched buffer
+        when round r-1 scheduled it, else a synchronous gather — and
+        schedule the NEXT round's cohort in the background."""
+        if self._host_gather is None:
+            raise RuntimeError("CohortEngine.set_host_gather was never called")
+        rows = np.asarray(cohort_rows)
+        key = cohort_key(rows)
+        host_gather = self._host_gather
+
+        out = self.prefetcher.take(
+            key, lambda: place(host_gather(rows))
+        )
+        r = self._round_of_key.get(key)
+        if r is not None:
+            self._note_round(r)  # this round really trains: count it
+            if self.total_rounds <= 0 or r + 1 < self.total_rounds:
+                nxt_rows = self._transform(self.data_cohort(r + 1))
+                self.prefetcher.schedule(
+                    cohort_key(nxt_rows),
+                    lambda: place(host_gather(nxt_rows)),
+                )
+        return out
+
+    # -- identity / lifecycle ------------------------------------------------
+
+    def ledger_identity(self) -> Dict[str, Any]:
+        ident = self.registry.identity()
+        ident["cohort_size"] = self.cohort_size
+        return ident
+
+    def stats(self) -> Dict[str, float]:
+        return self.prefetcher.stats()
+
+    def close(self) -> None:
+        self.prefetcher.stop()

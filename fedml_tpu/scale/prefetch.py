@@ -1,0 +1,226 @@
+"""Double-buffered host→HBM shard prefetcher for streamed cohorts.
+
+Registry-scale federations (``registry.py``) never hold the population's
+data resident: each round materializes ONLY the sampled cohort's shards.
+Done naively that serializes ``host gather → device_put → train`` every
+round and the accelerator idles through the I/O. This prefetcher overlaps
+them: while round *r* trains on device, a worker thread gathers and places
+round *r+1*'s shards, so a steady-state round finds its inputs already in
+HBM — the classic double-buffered input pipeline, applied to FL cohorts.
+
+Contract (pinned by ``tests/test_scale.py``):
+
+- **Never blocks the round beyond its own data.** ``schedule`` is
+  non-blocking; ``take`` waits only for the buffer it asked for (and
+  gathers synchronously on a miss — a cold start costs one gather, never a
+  deadlock).
+- **Never serves a stale shard.** Buffers are keyed by a digest of the
+  exact cohort row indices; ``take`` with a different cohort than what was
+  scheduled is a counted miss + fresh gather, not a wrong answer.
+- **Bounded memory.** At most ``depth`` prefetched cohorts are in flight
+  or parked; older unclaimed buffers are evicted (counted).
+
+Telemetry (all under the ``io.`` family, zero-cost when the registry/
+prefetcher is off):
+
+    io.prefetch_requests / hits / misses / stale_drops / errors
+    io.prefetch_bytes      bytes placed ahead of demand
+    io.prefetch_gather_s   seconds the worker spent gathering+placing
+    io.prefetch_wait_s     seconds ``take`` blocked on an unfinished buffer
+
+Overlap fraction = 1 - wait/gather (see :meth:`ShardPrefetcher.stats`):
+1.0 means every gather fully hid behind device compute; 0 means the
+pipeline is I/O-bound end-to-end. The million-client bench leg reports it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..core.mlops import telemetry
+
+logger = logging.getLogger(__name__)
+
+GatherFn = Callable[[], Any]
+
+
+def cohort_key(cohort: np.ndarray) -> str:
+    """Digest of the exact cohort rows — the staleness-proof buffer key."""
+    a = np.ascontiguousarray(np.asarray(cohort, np.int64))
+    return hashlib.sha256(a.tobytes()).hexdigest()[:24]
+
+
+class ShardPrefetcher:
+    """Background gather of the next cohort's shards into device memory.
+
+    ``depth`` bounds the number of prefetched cohorts held at once
+    (1 = classic double buffering). ``depth=0`` disables the thread
+    entirely — ``take`` degrades to synchronous gathering with the same
+    API, so callers never branch.
+    """
+
+    def __init__(self, depth: int = 1, name: str = "cohort"):
+        self.depth = max(int(depth), 0)
+        self.name = str(name)
+        # all cross-thread state lives behind this Condition (its lock):
+        # _slots maps key -> ("pending" | "ready" | "error", value, order)
+        self._lock = threading.Condition()
+        self._slots: Dict[str, Tuple[str, Any, int]] = {}
+        self._order = 0
+        self._work: "queue.Queue[Optional[Tuple[str, GatherFn]]]" = \
+            queue.Queue()
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._gather_s = 0.0  # guarded by _lock
+        self._wait_s = 0.0    # guarded by _lock
+
+    # -- worker --------------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is not None or self.depth == 0:
+                return
+            self._thread = threading.Thread(
+                target=self._run, name=f"prefetch-{self.name}", daemon=True
+            )
+            self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop_evt.is_set():
+            item = self._work.get()
+            if item is None:
+                break
+            key, gather = item
+            t0 = time.perf_counter()
+            try:
+                value = gather()
+                status = "ready"
+            except Exception as e:  # served as a counted miss by take()
+                telemetry.counter_inc("io.prefetch_errors")
+                logger.warning("prefetch %s: gather failed: %s", key, e)
+                value, status = e, "error"
+            dt = time.perf_counter() - t0
+            with self._lock:
+                if status == "ready":
+                    # errored gathers must not count as hidden I/O: the
+                    # take() fallback will do (and account) the real work,
+                    # so crediting the failed attempt would inflate the
+                    # overlap fraction
+                    self._gather_s += dt
+                if key in self._slots:  # not evicted while gathering
+                    self._slots[key] = (status, value, self._slots[key][2])
+                self._lock.notify_all()
+            if status == "ready":
+                telemetry.counter_inc("io.prefetch_gather_s", dt)
+                telemetry.counter_inc(
+                    "io.prefetch_bytes", _nbytes(value)
+                )
+
+    # -- API -----------------------------------------------------------------
+
+    def schedule(self, key: str, gather: GatherFn) -> bool:
+        """Queue a background gather for ``key``. Returns False when the
+        prefetcher is off, the key is already in flight/ready, or the
+        buffer budget is full after eviction of the oldest unclaimed
+        entry."""
+        if self.depth == 0 or self._stop_evt.is_set():
+            return False
+        self._ensure_thread()
+        with self._lock:
+            if key in self._slots:
+                return False
+            while len(self._slots) >= self.depth:
+                oldest = min(self._slots, key=lambda k: self._slots[k][2])
+                if self._slots[oldest][0] == "pending":
+                    # never race the worker for an in-flight gather; the
+                    # caller retries next round
+                    return False
+                del self._slots[oldest]
+                telemetry.counter_inc("io.prefetch_stale_drops")
+            self._order += 1
+            self._slots[key] = ("pending", None, self._order)
+        self._work.put((key, gather))
+        telemetry.counter_inc("io.prefetch_requests")
+        return True
+
+    def take(self, key: str, gather: GatherFn) -> Any:
+        """The shards for ``key``: the prefetched buffer when one matches
+        (waiting out an in-flight gather), else a synchronous gather."""
+        telemetry.counter_inc("io.prefetch_takes")
+        if self.depth == 0:
+            return self._sync_gather(gather)
+        with self._lock:
+            entry = self._slots.get(key)
+            if entry is None:
+                telemetry.counter_inc("io.prefetch_misses")
+            else:
+                t0 = time.perf_counter()
+                while self._slots.get(key, ("gone",))[0] == "pending":
+                    self._lock.wait(timeout=0.5)
+                    if self._stop_evt.is_set():
+                        break
+                waited = time.perf_counter() - t0
+                self._wait_s += waited
+                if waited > 1e-9:
+                    telemetry.counter_inc("io.prefetch_wait_s", waited)
+                entry = self._slots.pop(key, None)
+                if entry is not None and entry[0] == "ready":
+                    telemetry.counter_inc("io.prefetch_hits")
+                    return entry[1]
+                telemetry.counter_inc("io.prefetch_misses")
+        return self._sync_gather(gather)
+
+    def _sync_gather(self, gather: GatherFn) -> Any:
+        """On-demand gather: its full latency is exposed (counts as wait)."""
+        t0 = time.perf_counter()
+        value = gather()
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self._gather_s += dt
+            self._wait_s += dt
+        telemetry.counter_inc("io.prefetch_gather_s", dt)
+        telemetry.counter_inc("io.prefetch_wait_s", dt)
+        return value
+
+    def stats(self) -> Dict[str, float]:
+        """Lifetime gather/wait seconds and the overlap fraction
+        (``1 - wait/gather``: the share of I/O hidden behind compute)."""
+        with self._lock:
+            gather_s, wait_s = self._gather_s, self._wait_s
+        overlap = 0.0
+        if gather_s > 1e-12:
+            overlap = max(0.0, min(1.0, 1.0 - wait_s / gather_s))
+        return {"gather_s": gather_s, "wait_s": wait_s,
+                "overlap_fraction": overlap}
+
+    def stop(self) -> None:
+        """Stop the worker and drop all buffers (idempotent)."""
+        self._stop_evt.set()
+        self._work.put(None)
+        t = None
+        with self._lock:
+            t = self._thread
+            self._thread = None
+            self._slots.clear()
+            self._lock.notify_all()
+        if t is not None:
+            t.join(timeout=5.0)
+
+
+def _nbytes(value: Any) -> int:
+    total = 0
+    try:
+        import jax
+
+        for leaf in jax.tree.leaves(value):
+            total += int(getattr(leaf, "nbytes", 0) or 0)
+    except Exception:
+        pass
+    return total

@@ -1,0 +1,279 @@
+"""Packed client registry: population size N decoupled from device memory.
+
+The sp/mesh simulators keep the whole client population's DATA resident
+(HBM or host RAM) and sample cohorts by indexing it — which caps N at
+whatever the packed ``[clients, cap, ...]`` arrays fit, ~100 clients for
+real shapes. Production FL populations are millions of devices of which a
+cohort of thousands participates per round (Bonawitz et al., MLSys 2019;
+Papaya/FedBuff-style async serving), and large-population benchmarking
+(FedScale) works the same way: a compact per-client RECORD array scales to
+N, the data plane only ever materializes the sampled cohort.
+
+This module is that record array. Four packed columns over N registered
+clients (ids are implicit ``0..N-1``):
+
+    weight        f32[N]  sampling weight (participation propensity)
+    shard_ptr     i32[N]  row of the backing :class:`~..data.FedDataset`
+                          holding this client's data shard
+    participation i32[N]  rounds this client was sampled into (counter)
+    staleness     i32[N]  rounds since last sampled (∞-ish until first)
+
+At N = 1,000,000 the registry is 16 MB — it lives comfortably on device,
+so cohort sampling is ONE jit'd program: seeded Gumbel-top-K over the
+weights (weighted K-of-N without replacement), keyed by
+``fold_in(PRNGKey(seed), round_idx)``. The same program serves the
+host-driven per-round path and the ``lax.scan`` superround body
+(round_engine), so both paths sample IDENTICAL cohorts for a given seed.
+K and N are static per registry — cohort sampling can never trigger a
+recompile.
+
+``shard_ptr`` is the level of indirection that lets a million registered
+clients share a bounded backing dataset: many virtual clients may point at
+the same (or overlapping) data shards, exactly like FedScale replays a
+bounded trace over a large population. With real per-client data, the
+pointer is the identity map.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# staleness value meaning "never sampled yet" — large but far from i32 wrap
+# even after adding the per-round +1 for billions of rounds is impossible,
+# so clamp growth at this ceiling
+NEVER_SAMPLED = np.int32(1 << 28)
+
+
+class ClientRegistry:
+    """Packed per-client record array with on-device seeded K-of-N sampling.
+
+    Construction is host-side numpy; :meth:`sample` and
+    :meth:`note_participation` run as jit'd device programs over the
+    device-resident columns. The host copies are kept authoritative for
+    save/identity; counters are pulled back lazily via :meth:`counters`.
+    """
+
+    def __init__(
+        self,
+        weights: np.ndarray,
+        shard_ptrs: np.ndarray,
+        seed: int = 0,
+        participation: Optional[np.ndarray] = None,
+        staleness: Optional[np.ndarray] = None,
+    ):
+        weights = np.asarray(weights, np.float32).reshape(-1)
+        shard_ptrs = np.asarray(shard_ptrs, np.int32).reshape(-1)
+        if weights.shape != shard_ptrs.shape:
+            raise ValueError(
+                f"registry columns disagree: {weights.shape[0]} weights vs "
+                f"{shard_ptrs.shape[0]} shard pointers"
+            )
+        if weights.size == 0:
+            raise ValueError("registry must hold at least one client")
+        if not np.all(weights > 0):
+            raise ValueError("registry weights must be strictly positive")
+        self.weights = weights
+        self.shard_ptrs = shard_ptrs
+        self.seed = int(seed)
+        n = weights.shape[0]
+        if np.any(shard_ptrs < 0):
+            raise ValueError(
+                "registry shard pointers must be non-negative (negative "
+                "values would silently gather the wrong client's shard "
+                "via numpy wraparound indexing)"
+            )
+        self.participation = (
+            np.zeros(n, np.int32) if participation is None
+            else np.asarray(participation, np.int32).reshape(-1)
+        )
+        self.staleness = (
+            np.full(n, NEVER_SAMPLED, np.int32) if staleness is None
+            else np.asarray(staleness, np.int32).reshape(-1)
+        )
+        for name, col in (("participation", self.participation),
+                          ("staleness", self.staleness)):
+            if col.shape != weights.shape:
+                raise ValueError(
+                    f"registry column {name!r} has {col.shape[0]} entries "
+                    f"for {n} clients"
+                )
+        self._root = jax.random.PRNGKey(self.seed)
+        # device mirrors, built lazily on first sample (a registry used only
+        # for identity/save never touches the device)
+        self._dev: Optional[Dict[str, jax.Array]] = None
+        self._sample_fn: Dict[int, Any] = {}
+        self._note_fn = None
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def synthetic(cls, n: int, backing_shards: int, seed: int = 0,
+                  weight_concentration: float = 0.0) -> "ClientRegistry":
+        """A population of ``n`` virtual clients over ``backing_shards`` data
+        rows. ``weight_concentration > 0`` draws heterogeneous sampling
+        weights from ``Gamma(k)`` (device-churn-like skew); 0 = uniform."""
+        n = int(n)
+        backing = int(backing_shards)
+        if n <= 0 or backing <= 0:
+            raise ValueError("n and backing_shards must be positive")
+        rs = np.random.RandomState(seed)
+        # permuted modular map: virtual clients spread over the backing rows
+        # in a seed-stable shuffle (not blocks, so any cohort mixes shards)
+        ptrs = rs.permutation(n).astype(np.int64) % backing
+        if weight_concentration > 0:
+            w = rs.gamma(weight_concentration, 1.0, n).astype(np.float32)
+            w = np.maximum(w, 1e-6)
+        else:
+            w = np.ones(n, np.float32)
+        return cls(w, ptrs.astype(np.int32), seed=seed)
+
+    @classmethod
+    def from_dataset(cls, ds, seed: int = 0) -> "ClientRegistry":
+        """Identity registry: one registered client per backing data shard."""
+        n = int(ds.client_num)
+        return cls(np.ones(n, np.float32), np.arange(n, dtype=np.int32),
+                   seed=seed)
+
+    # -- basics --------------------------------------------------------------
+
+    @property
+    def num_clients(self) -> int:
+        return int(self.weights.shape[0])
+
+    def __len__(self) -> int:
+        return self.num_clients
+
+    def shard_rows(self, client_ids: np.ndarray) -> np.ndarray:
+        """Registry client ids → backing dataset rows."""
+        return self.shard_ptrs[np.asarray(client_ids)]
+
+    def injective_shards(self) -> bool:
+        """True when no two clients share a backing shard — the invariant
+        per-client state (SCAFFOLD control variates) needs: with aliased
+        pointers a cohort holds duplicate rows and a per-row scatter
+        becomes order-dependent."""
+        return (len(np.unique(self.shard_ptrs)) == self.num_clients)
+
+    # -- on-device sampling --------------------------------------------------
+
+    def _ensure_device(self) -> None:
+        if self._dev is not None:
+            return
+        self._dev = {
+            "log_w": jnp.log(jnp.asarray(self.weights)),
+            "ptrs": jnp.asarray(self.shard_ptrs),
+            "participation": jnp.asarray(self.participation),
+            "staleness": jnp.asarray(self.staleness),
+        }
+
+    def device_sampler(self, k: int):
+        """``sample(round_idx) -> i32[k]`` registry client ids — ONE jit'd
+        program, weighted K-of-N without replacement via Gumbel-top-K.
+
+        ``round_idx`` is a traced scalar: every round runs the same compiled
+        program (N and K are the only static shapes), so population-scale
+        sampling can never be a recompile source. Deterministic given
+        (seed, round_idx) — the superround scan body and the host-driven
+        path call this same function and agree on every cohort.
+        """
+        k = int(k)
+        if not 0 < k <= self.num_clients:
+            raise ValueError(
+                f"cohort size {k} must be in [1, {self.num_clients}]"
+            )
+        self._ensure_device()
+        log_w = self._dev["log_w"]
+        root = self._root
+
+        def sample(round_idx):
+            key = jax.random.fold_in(root, round_idx)
+            g = jax.random.gumbel(key, log_w.shape, log_w.dtype)
+            _, ids = jax.lax.top_k(log_w + g, k)
+            return ids.astype(jnp.int32)
+
+        fn = self._sample_fn.get(k)
+        if fn is None:
+            fn = jax.jit(sample)
+            self._sample_fn[k] = fn
+        return fn
+
+    def sample(self, round_idx: int, k: int) -> np.ndarray:
+        """Host-side view of :meth:`device_sampler` (np.ndarray out)."""
+        return np.asarray(self.device_sampler(k)(jnp.int32(round_idx)))
+
+    def device_shard_ptrs(self) -> jax.Array:
+        """The shard-pointer column on device (superround gathers need it)."""
+        self._ensure_device()
+        return self._dev["ptrs"]
+
+    def note_participation(self, cohort_ids: np.ndarray) -> None:
+        """Fold one sampled cohort into the participation/staleness counters
+        (device-side scatter; the donated update keeps one live copy)."""
+        self._ensure_device()
+
+        if self._note_fn is None:
+            def note(part, stale, ids):
+                part = part.at[ids].add(1)
+                stale = jnp.minimum(stale + 1, NEVER_SAMPLED)
+                stale = stale.at[ids].set(0)
+                return part, stale
+
+            self._note_fn = jax.jit(note, donate_argnums=(0, 1))
+        part, stale = self._note_fn(
+            self._dev["participation"], self._dev["staleness"],
+            jnp.asarray(cohort_ids, jnp.int32),
+        )
+        self._dev["participation"] = part
+        self._dev["staleness"] = stale
+
+    def counters(self) -> Dict[str, np.ndarray]:
+        """Pull the participation/staleness counters back to host."""
+        if self._dev is not None:
+            self.participation = np.asarray(self._dev["participation"])
+            self.staleness = np.asarray(self._dev["staleness"])
+        return {"participation": self.participation,
+                "staleness": self.staleness}
+
+    # -- identity / persistence ---------------------------------------------
+
+    def identity(self) -> Dict[str, Any]:
+        """Run-identity fields for the run ledger: a resumed run against a
+        DIFFERENT registry (size, seed, weights or shard map) would silently
+        change every remaining cohort, so the ledger pins a digest of the
+        sampling-relevant columns and ``RunLedger.ensure_meta`` turns any
+        mismatch into a loud error."""
+        h = hashlib.sha256()
+        h.update(self.weights.tobytes())
+        h.update(self.shard_ptrs.tobytes())
+        return {
+            "num_clients": self.num_clients,
+            "seed": self.seed,
+            "columns_sha256": h.hexdigest()[:16],
+        }
+
+    def save(self, path: str) -> None:
+        self.counters()  # fold device-side counters into the host copies
+        np.savez(
+            path, weights=self.weights, shard_ptrs=self.shard_ptrs,
+            participation=self.participation, staleness=self.staleness,
+            seed=np.int64(self.seed),
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "ClientRegistry":
+        with np.load(path) as z:
+            return cls(
+                z["weights"], z["shard_ptrs"], seed=int(z["seed"]),
+                participation=z["participation"], staleness=z["staleness"],
+            )
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"ClientRegistry(n={self.num_clients}, seed={self.seed}, "
+            f"backing={int(self.shard_ptrs.max()) + 1})"
+        )

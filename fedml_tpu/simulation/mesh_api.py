@@ -5,8 +5,15 @@ The TPU-native replacement for BOTH of the reference's multi-process backends
 MPI messages — and ``simulation/nccl/base_framework/*`` — per-GPU local
 aggregators doing torch.distributed broadcast/reduce; see SURVEY.md §3.2/§3.3):
 
-- the cohort's packed arrays are sharded over a 1-D ``clients`` mesh axis
-  (`jax.sharding.NamedSharding`); global params are replicated
+- the cohort's packed arrays are sharded over the mesh by RULE-DRIVEN
+  ``NamedSharding`` specs: an ordered list of ``(regex, PartitionSpec)``
+  rules over named pytree leaves (``scale/partition_rules.py``, the
+  ``match_partition_rules`` pattern from the large-model JAX ecosystem —
+  SNIPPETS.md [2]/[3]). The defaults reproduce the original hard-coded
+  behavior exactly — cohort arrays split on the leading ``clients`` axis,
+  round state replicated — and ``--mesh_partition_rules`` /
+  ``--mesh_state_rules`` override per-leaf placement without code changes
+  (pinned bitwise-equal in ``tests/test_scale.py``)
 - the round runs the SAME engine as the sp backend (`FedAvgAPI._train_round`):
   vmap(local_train) over the sharded cohort → attack → defend → weighted
   average → DP. XLA propagates the input shardings through the jit'd cohort
@@ -18,23 +25,31 @@ aggregators doing torch.distributed broadcast/reduce; see SURVEY.md §3.2/§3.3)
 
 There are no messages, no pickling, no per-worker processes: a round is one
 device program launch. Because the whole FedAvg-family engine is inherited,
-every federated optimizer (FedProx/FedOpt/FedNova/FedSGD/SCAFFOLD) and the
-full trust pipeline (attack → defend → aggregate → DP, ``sp_api.py``) work
+every federated optimizer (FedProx/FedOpt/FedNova/FedSGD/SCAFFOLD), the
+full trust pipeline (attack → defend → aggregate → DP, ``sp_api.py``) and
+the million-client registry/prefetch substrate (``scale/``) work
 identically on the multi-chip path.
 """
 
 from __future__ import annotations
 
 import logging
+import threading
 
 import jax
-import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import constants
 from ..core.mlops import telemetry
 from ..device import build_mesh
+from ..scale.partition_rules import (
+    DEFAULT_COHORT_RULES,
+    DEFAULT_STATE_RULES,
+    is_scalar_leaf,
+    make_shardings,
+    match_partition_rules,
+    parse_partition_rules,
+)
 from .sp_api import FedAvgAPI
 
 logger = logging.getLogger(__name__)
@@ -62,11 +77,32 @@ class MeshFedAvgAPI(FedAvgAPI):
                 f"'{constants.MESH_AXIS_CLIENTS}' axis"
             )
         self.axis_size = self.mesh.shape[constants.MESH_AXIS_CLIENTS]
-        self._shard = NamedSharding(self.mesh, P(constants.MESH_AXIS_CLIENTS))
-        self._repl = NamedSharding(self.mesh, P())
+        # rule-driven placement (scale/partition_rules.py): cohort-plane
+        # leaves are named "cohort/{x,y,counts,aux}" (aux = the per-round
+        # rngs and padding weight mask), round-state leaves keep their
+        # pytree paths ("global_params/...", "server_opt_state/...") —
+        # the defaults reproduce the legacy first-axis sharding byte for
+        # byte
+        self.cohort_rules = (
+            parse_partition_rules(getattr(args, "mesh_partition_rules", ""))
+            or list(DEFAULT_COHORT_RULES)
+        )
+        self.state_rules = (
+            parse_partition_rules(getattr(args, "mesh_state_rules", ""))
+            or list(DEFAULT_STATE_RULES)
+        )
+        # rule resolution is derivable from (rule set, tree structure,
+        # scalar pattern) — cache the resulting NamedSharding pytrees so
+        # the per-round hot path never re-runs regex matching (the
+        # prefetch worker thread also resolves through here, hence the
+        # lock around the memo)
+        self._sharding_cache = {}
+        self._sharding_lock = threading.Lock()
         logger.info(
-            "mesh simulator: %d-way client sharding over %s",
+            "mesh simulator: %d-way client sharding over %s "
+            "(%d cohort rules, %d state rules)",
             self.axis_size, self.mesh,
+            len(self.cohort_rules), len(self.state_rules),
         )
 
     def _ledger_world(self):
@@ -82,6 +118,34 @@ class MeshFedAvgAPI(FedAvgAPI):
         world["device_count"] = int(len(self.mesh.devices.flat))
         return world
 
+    # -- rule resolution ----------------------------------------------------
+    def _resolve_shardings(self, which: str, rules, tree):
+        """Rules + named pytree → ``NamedSharding`` pytree, memoized on
+        (rule set, tree structure, scalar pattern) — all static per run."""
+        from jax.tree_util import tree_leaves, tree_structure
+
+        key = (
+            which,
+            tree_structure(tree),
+            # the SAME scalar predicate match_partition_rules applies —
+            # the memo is only sound if the key classifies leaves
+            # identically to the resolver
+            tuple(is_scalar_leaf(leaf) for leaf in tree_leaves(tree)),
+        )
+        with self._sharding_lock:
+            hit = self._sharding_cache.get(key)
+        if hit is None:
+            hit = make_shardings(
+                self.mesh, match_partition_rules(rules, tree)
+            )
+            with self._sharding_lock:
+                self._sharding_cache[key] = hit
+        return hit
+
+    def _cohort_shardings(self, named):
+        """Resolve the cohort rules over named host arrays → shardings."""
+        return self._resolve_shardings("cohort", self.cohort_rules, named)
+
     # -- FedAvgAPI placement hooks ------------------------------------------
     def _pad_cohort(self, cohort: np.ndarray):
         pad = (-len(cohort)) % self.axis_size
@@ -91,33 +155,54 @@ class MeshFedAvgAPI(FedAvgAPI):
             cohort = np.concatenate([cohort, np.zeros(pad, cohort.dtype)])
         return cohort, wmask
 
-    def _gather_cohort(self, cohort: np.ndarray):
-        # host-side gather + sharded device_put: the mesh path's own
-        # "gather" phase (the sp base times this callsite — this shard
-        # placement is what its span measures here)
-        cx = jax.device_put(self.ds.train_x[cohort], self._shard)
-        cy = jax.device_put(self.ds.train_y[cohort], self._shard)
-        cn = jax.device_put(
-            self.ds.train_counts[cohort].astype(np.int32), self._shard
+    def _place_cohort(self, arrays):
+        # one rule resolution + sharded device_put per gather; this is the
+        # mesh path's own "gather" phase AND the streamed-cohort placement
+        # hook (the prefetcher's worker thread calls it for round r+1)
+        cx, cy, cn = arrays
+        named = {
+            "cohort/x": np.asarray(cx),
+            "cohort/y": np.asarray(cy),
+            "cohort/counts": np.asarray(cn, np.int32),
+        }
+        sh = self._cohort_shardings(named)
+        return (
+            jax.device_put(named["cohort/x"], sh["cohort/x"]),
+            jax.device_put(named["cohort/y"], sh["cohort/y"]),
+            jax.device_put(named["cohort/counts"], sh["cohort/counts"]),
         )
-        return cx, cy, cn
+
+    def _gather_resident(self, cohort: np.ndarray):
+        # host-side gather + sharded device_put: the sp base times this
+        # callsite — this shard placement is what its span measures here
+        return self._place_cohort((
+            self.ds.train_x[cohort],
+            self.ds.train_y[cohort],
+            self.ds.train_counts[cohort],
+        ))
 
     def _place(self, arr):
-        return jax.device_put(jax.device_get(arr), self._shard)
+        # per-client auxiliaries (per-round rngs, the padding weight mask)
+        # ride the cohort rules under "cohort/aux" — leading axis = clients
+        named = {"cohort/aux": jax.device_get(arr)}
+        sh = self._cohort_shardings(named)
+        return jax.device_put(named["cohort/aux"], sh["cohort/aux"])
 
     def _prepare_round(self):
-        # keep global params replicated across the mesh so the cohort program
-        # reads them without broadcast inside the hot loop
+        # keep global params placed per the state rules (default replicated)
+        # so the cohort program reads them without broadcast in the hot loop
         with telemetry.phase("place_params", record=False):
-            self.global_params = jax.device_put(self.global_params, self._repl)
+            self.global_params = self._place_state(
+                {"global_params": self.global_params}
+            )["global_params"]
 
     def _place_state(self, state):
         # the fused program's donated state must live on the SAME device set
-        # as the sharded cohort inputs: commit every leaf replicated over the
-        # mesh (a no-op copy once steady state re-feeds program outputs).
-        # XLA then propagates the input shardings through the fused round and
-        # lowers the cross-shard reduction to collectives over ICI.
+        # as the sharded cohort inputs: commit every leaf per the state
+        # rules (default: replicated over the mesh — a no-op copy once
+        # steady state re-feeds program outputs). XLA then propagates the
+        # input shardings through the fused round and lowers the
+        # cross-shard reduction to collectives over ICI.
         with telemetry.phase("place_state", record=False):
-            return jax.tree.map(
-                lambda x: jax.device_put(x, self._repl), state
-            )
+            sh = self._resolve_shardings("state", self.state_rules, state)
+            return jax.tree.map(jax.device_put, state, sh)

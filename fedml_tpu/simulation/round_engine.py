@@ -229,11 +229,21 @@ def make_superround_step(api, k: int, n_cohort: int):
     total = int(api.ds.client_num)
     per = int(n_cohort)
     root_rng = api.root_rng
+    # registry mode (fedml_tpu/scale/): cohorts come from the SAME jit'd
+    # Gumbel-top-K sampler the host-driven path uses — keyed only by
+    # (registry seed, round), so the scan's cohort trajectory is identical
+    # to per-round launches and the engine can replay it for accounting
+    eng = getattr(api, "cohort_engine", None)
+    if eng is not None:
+        reg_sample = eng.registry.device_sampler(per)
+        reg_ptrs = eng.registry.device_shard_ptrs()
 
     def superround(state: RoundState, start_round):
         def body(st, r):
             rkey = jax.random.fold_in(root_rng, r)
-            if total == per:  # full participation: matches the host path
+            if eng is not None:  # registry K-of-N → backing shard rows
+                cohort = jnp.take(reg_ptrs, reg_sample(r), axis=0)
+            elif total == per:  # full participation: matches the host path
                 cohort = jnp.arange(per, dtype=jnp.int32)
             else:
                 cohort = jax.random.choice(

@@ -103,6 +103,43 @@ class FedAvgAPI:
         self.custom_trainer = client_trainer
         self.custom_aggregator = server_aggregator
 
+        # million-client cohort substrate (fedml_tpu/scale/ — docs/scale.md):
+        # when --client_registry is set, WHO participates each round comes
+        # from a registry of N virtual clients (on-device seeded K-of-N
+        # sampling) and the cohort's shards stream in through a
+        # double-buffered prefetcher instead of a resident gather. The round
+        # math below is untouched — cohorts are still dataset rows.
+        from ..scale import build_cohort_engine
+
+        self.cohort_engine = build_cohort_engine(args, dataset)
+        if (self.cohort_engine is not None
+                and self.opt_name
+                == constants.FEDML_FEDERATED_OPTIMIZER_SCAFFOLD
+                and not self.cohort_engine.registry.injective_shards()):
+            # aliased shard pointers put duplicate rows in every cohort;
+            # SCAFFOLD's per-client variate scatter (.at[rows].set) is
+            # order-unspecified under duplicates — refuse loudly rather
+            # than silently break the bitwise-determinism guarantee
+            raise ValueError(
+                "SCAFFOLD needs per-client control variates, but this "
+                "registry aliases multiple clients onto the same data "
+                "shard (non-injective shard pointers). Use an injective "
+                "registry (ClientRegistry.from_dataset) or a different "
+                "federated_optimizer."
+            )
+        if self.cohort_engine is not None:
+            self.cohort_engine.set_host_gather(self._host_gather_rows)
+            self.cohort_engine.set_cohort_transform(
+                lambda rows: self._pad_cohort(rows)[0]
+            )
+            logger.info(
+                "cohort engine: %d registered clients, cohort %d, "
+                "prefetch depth %d",
+                self.cohort_engine.registry.num_clients,
+                self.cohort_engine.cohort_size,
+                self.cohort_engine.prefetcher.depth,
+            )
+
         seed = int(getattr(args, "random_seed", 0))
         self.root_rng = jax.random.PRNGKey(seed)
         self.global_params = model.init(self.root_rng)
@@ -189,6 +226,13 @@ class FedAvgAPI:
         self.hbm_resident = self.hbm_resident_default and bool(
             getattr(args, "hbm_resident", total_bytes < self._hbm_budget())
         )
+        if (self.cohort_engine is not None
+                and max(int(getattr(args, "superround_k", 0) or 0), 0) <= 1):
+            # registry rounds stream through the prefetcher — a resident
+            # dataset copy would be dead HBM for the whole run. Superround
+            # is the exception: its scan gathers on device and needs
+            # _dev_x et al.
+            self.hbm_resident = False
         if self.hbm_resident:
             self._dev_x = jax.device_put(self.ds.train_x)
             self._dev_y = jax.device_put(self.ds.train_y)
@@ -243,7 +287,18 @@ class FedAvgAPI:
             logger.info("round fusion off: %s", "; ".join(blockers))
 
     # -- sampling (reference: fedavg_api.py:125-140) ------------------------
+    def _cohort_size(self) -> int:
+        """Real (unpadded) clients per round — registry cohort size when the
+        scale substrate is on, the reference min() rule otherwise."""
+        if self.cohort_engine is not None:
+            return self.cohort_engine.cohort_size
+        return min(int(self.args.client_num_per_round), self.ds.client_num)
+
     def _client_sampling(self, round_idx: int) -> np.ndarray:
+        if self.cohort_engine is not None:
+            # registry path: seeded on-device K-of-N over the population,
+            # mapped through shard pointers to dataset rows (scale/)
+            return self.cohort_engine.data_cohort(round_idx)
         total = self.ds.client_num
         per_round = min(int(self.args.client_num_per_round), total)
         if total == per_round:
@@ -263,7 +318,30 @@ class FedAvgAPI:
         return cohort, None
 
     def _gather_cohort(self, cohort: np.ndarray):
-        """Gather the cohort's packed shards → (cx, cy, cn) on device."""
+        """Gather the cohort's packed shards → (cx, cy, cn) on device.
+
+        Registry mode streams through the cohort engine's prefetcher
+        (round r's gather was scheduled while round r-1 trained);
+        otherwise the resident gather below runs."""
+        if self.cohort_engine is not None:
+            return self.cohort_engine.gather(cohort, self._place_cohort)
+        return self._gather_resident(cohort)
+
+    def _host_gather_rows(self, rows: np.ndarray):
+        """Host-side shard read for the streaming path (runs on the
+        prefetcher's worker thread)."""
+        return (
+            self.ds.train_x[rows],
+            self.ds.train_y[rows],
+            self.ds.train_counts[rows].astype(np.int32),
+        )
+
+    def _place_cohort(self, arrays):
+        """Commit gathered host shards to device (mesh: rule-sharded)."""
+        cx, cy, cn = arrays
+        return jnp.asarray(cx), jnp.asarray(cy), jnp.asarray(cn)
+
+    def _gather_resident(self, cohort: np.ndarray):
         if self.hbm_resident:
             idx = jnp.asarray(cohort)
             cx = jnp.take(self._dev_x, idx, axis=0)
@@ -322,8 +400,10 @@ class FedAvgAPI:
             return
         from .round_engine import make_fused_round_step, make_superround_step
 
-        per = min(int(self.args.client_num_per_round), self.ds.client_num)
-        cohort0, wmask0 = self._pad_cohort(np.arange(per))
+        per = self._cohort_size()
+        cohort0, wmask0 = self._pad_cohort(
+            np.arange(per) % self.ds.client_num
+        )
         self._round_step = make_fused_round_step(
             self, n_cohort=len(cohort0), n_valid=per
         )
@@ -398,6 +478,11 @@ class FedAvgAPI:
                 self._place_state(self._round_state()), jnp.int32(start_round)
             )
             self._set_round_state(state)
+            if self.cohort_engine is not None:
+                # the scan sampled rounds [start, start+k) on device with
+                # the registry's own sampler; replay them host-side so the
+                # participation/staleness counters stay truthful
+                self.cohort_engine.note_rounds(start_round, k)
             if tracked:
                 # one record per scanned round, unpacked from the scan's
                 # stacked on-device counters (the only host sync tracking
@@ -649,12 +734,18 @@ class FedAvgAPI:
         """Run-identity fields pinned into the ledger's run_meta line; the
         mesh engine extends this with its device topology so a resumed run
         on a mismatched mesh fails loudly instead of silently resharding."""
-        return {
+        world = {
             "engine": type(self).__name__,
             "optimizer": self.opt_name,
             "client_num_in_total": int(self.ds.client_num),
             "client_num_per_round": int(self.args.client_num_per_round),
         }
+        if self.cohort_engine is not None:
+            # registry identity (population size, seed, column digest):
+            # resuming against a DIFFERENT registry would silently resample
+            # every remaining cohort — ensure_meta turns that into an error
+            world["registry"] = self.cohort_engine.ledger_identity()
+        return world
 
     def train(self) -> Dict[str, float]:
         from ..core import mlops, runstate
@@ -795,6 +886,8 @@ class FedAvgAPI:
         finally:
             if ckpt is not None:  # release Orbax threads even on a crash
                 ckpt.close()
+            if self.cohort_engine is not None:
+                self.cohort_engine.close()
             self._finalize_history()
         return last_eval
 

@@ -42,6 +42,11 @@ def _tpu_runner(argv, timeout):
     if "--leg cheetah" in joined:
         return {"cheetah_mfu": 0.758, "cheetah_tokens_per_sec_per_chip": 1e5,
                 "cheetah_device_kind": "TPU v5 lite", "platform": "tpu"}
+    if "--leg million" in joined:
+        return {"million_rounds_per_sec": 2.5, "million_registry_n": 1000000,
+                "million_cohort_k": 10000, "million_prefetch_overlap": 0.9,
+                "million_steady_compiles": 0, "platform": "tpu",
+                "device_kind": "TPU v5 lite"}
     return {"mfu": 0.5, "tok_s": 9e4, "params_m": 600.0, "n_chips": 1,
             "step_s": 0.2, "device_kind": "TPU v5 lite"}
 

@@ -125,6 +125,34 @@ def test_raw_frames_roundtrip_and_sniffing():
             np.testing.assert_array_equal(np.ascontiguousarray(a), b)
 
 
+def test_streamed_payload_past_cap_is_resource_exhausted(monkeypatch):
+    """The stream handler must bound reassembly at MAX_MESSAGE_BYTES like
+    the unary path does — an over-cap stream aborts RESOURCE_EXHAUSTED
+    instead of growing server memory without limit (ADVICE.md)."""
+    from fedml_tpu.core.distributed import grpc_backend
+
+    base = _free_consecutive_ports(4)
+    recv = GRPCCommManager("127.0.0.1", base + 2, rank=2, world_size=3,
+                           base_port=base, wire_format="raw",
+                           stream_threshold_bytes=1 << 20)
+    send = GRPCCommManager("127.0.0.1", base + 1, rank=1, world_size=3,
+                           base_port=base, wire_format="raw",
+                           stream_threshold_bytes=1 << 20)
+    # shrink the cap AFTER server start: the handler reads the module
+    # global per request, so the 12 MB payload below is now over-limit
+    monkeypatch.setattr(grpc_backend, "MAX_MESSAGE_BYTES", 4 * 1024 * 1024)
+    try:
+        big = np.zeros(3 * 1024 * 1024, np.float32)  # 12 MB > 4 MB cap
+        msg = Message("big_model", 1, 2)
+        msg.set_arrays([big])
+        with pytest.raises(grpc.RpcError) as ei:
+            send.send_message(msg)
+        assert ei.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+    finally:
+        send.stop_receive_message()
+        recv.stop_receive_message()
+
+
 def test_streamed_raw_payload_roundtrip():
     """A payload past the stream threshold rides Comm/SendStream in chunks
     and reassembles bit-exact (wire_format='raw')."""

@@ -18,11 +18,13 @@ cd "$(dirname "$0")/.."
 track_dir=$(mktemp -d /tmp/fedml_bench_smoke_track.XXXXXX)
 trap 'rm -rf "$track_dir"' EXIT
 
-out=$(timeout -k 10 120 env \
+out=$(timeout -k 10 180 env \
     BENCH_PLATFORM=cpu \
     BENCH_SMOKE=1 \
-    BENCH_LEGS=fedavg \
-    BENCH_BUDGET_S=110 \
+    BENCH_LEGS=fedavg,fedavg_million_client \
+    BENCH_REGISTRY_N=20000 \
+    BENCH_COHORT_K=256 \
+    BENCH_BUDGET_S=170 \
     BENCH_MIN_LEG_S=5 \
     BENCH_LEG_TIMEOUT_S=100 \
     BENCH_CACHE_TTL_S=0 \
@@ -87,10 +89,24 @@ assert samples > 0, "metrics exposition is empty"
 assert "fedavg_resume_overhead_s" in line, f"no resume probe in line: {line}"
 assert 0 < line["fedavg_resume_overhead_s"] < 120, line
 
+# registry leg (fedml_tpu/scale/, scaled down to N=20k / K=256): the
+# cohort substrate must sustain registry-scale rounds with ZERO
+# steady-state compiles (cohort resampling is recompile-free by
+# construction) and a measured prefetch overlap > 0 (docs/scale.md)
+assert "fedavg_million_client_error" not in line, line
+assert "fedavg_million_client_skipped" not in line, line
+assert line.get("million_rounds_per_sec", 0) > 0, line
+assert line.get("million_steady_compiles", -1) == 0, line
+assert line.get("million_prefetch_overlap", 0) > 0, line
+assert line.get("million_registry_n") == 20000, line
+
 print("bench_smoke: OK —",
       f"{line['fedavg_cpu_smoke_rounds_per_sec']:.2f} rounds/s,",
       f"compile {line.get('fedavg_compile_s', '?')}s,",
       f"fused={line.get('fedavg_round_fused')},",
       f"resume {line['fedavg_resume_overhead_s']:.2f}s,",
+      f"registry {line['million_registry_n']}cl",
+      f"@ {line['million_rounds_per_sec']:.2f} rounds/s",
+      f"(overlap {line['million_prefetch_overlap']:.2f}),",
       f"{len(records)} round records, {samples} metric samples")
 EOF
