@@ -164,6 +164,30 @@ _SCHEMA: Dict[str, tuple] = {
     # persistent XLA compilation cache — repeat runs (and bench legs) skip
     # the compile wall entirely. Empty = disabled. Wired in fedml.init().
     "compilation_cache_dir": (str, ""),
+    # async traffic plane (fedml_tpu/traffic/ — docs/traffic.md).
+    # aggregation_mode: sync keeps the per-round cohort barrier (the
+    # reference semantics, bitwise-unchanged); async is FedBuff-style
+    # buffered aggregation — staleness-weighted updates fold as they
+    # arrive, a server step fires per async_buffer_size accepted updates.
+    "aggregation_mode": (str, "sync"),
+    # updates per server step (K); 0 = min(10, client count), the FedBuff
+    # paper default capped to the world size
+    "async_buffer_size": (int, 0),
+    # staleness decay exponent: weight = num_samples * (1+s)^-alpha;
+    # 0 = flat weights (the sync-parity setting)
+    "async_staleness_alpha": (float, 0.0),
+    # drop updates staler than this many versions (the sender gets a fresh
+    # model so it rejoins at version head); 0 = accept any staleness
+    "async_max_staleness": (int, 0),
+    # flush a partial buffer after this many seconds without progress so a
+    # dropped-out tail cohort can't wedge the federation; 0 = never
+    "async_flush_s": (float, 10.0),
+    # admission control on C2S_SEND_MODEL: token-bucket rate (updates/s;
+    # 0 = unlimited) + burst (0 = 2x buffer) and the bounded fold-queue
+    # depth (0 = 4x buffer). Overload degrades to shed/NACK-retry-after.
+    "async_admit_rate": (float, 0.0),
+    "async_admit_burst": (int, 0),
+    "async_queue_limit": (int, 0),
 }
 
 
@@ -277,6 +301,17 @@ class Arguments:
             )
         if int(getattr(self, "cohort_size", 0) or 0) < 0:
             raise ValueError("cohort_size must be >= 0")
+        mode = str(getattr(self, "aggregation_mode", "sync") or "sync")
+        if mode.lower() not in ("sync", "async"):
+            raise ValueError(
+                f"aggregation_mode must be sync|async, got {mode!r}"
+            )
+        for non_negative in ("async_buffer_size", "async_max_staleness",
+                             "async_admit_rate", "async_queue_limit",
+                             "async_staleness_alpha", "async_flush_s",
+                             "async_admit_burst"):
+            if float(getattr(self, non_negative, 0) or 0) < 0:
+                raise ValueError(f"{non_negative} must be >= 0")
         for positive in ("batch_size", "comm_round", "epochs"):
             if getattr(self, positive) <= 0:
                 raise ValueError(f"{positive} must be positive")
@@ -375,6 +410,46 @@ def add_args() -> argparse.Namespace:
         "--mesh_state_rules", type=str, default=None,
         help="regex=axes;... placement rules for the mesh round state "
         "(docs/scale.md)",
+    )
+    # async traffic plane (fedml_tpu/traffic/ — docs/traffic.md)
+    parser.add_argument(
+        "--aggregation_mode", type=str, default=None,
+        choices=("sync", "async"),
+        help="sync = per-round cohort barrier (reference semantics); "
+        "async = FedBuff-style buffered aggregation with staleness "
+        "weighting and admission control",
+    )
+    parser.add_argument(
+        "--async_buffer_size", type=int, default=None, metavar="K",
+        help="server step fires per K accepted updates "
+        "(0 = min(10, client count))",
+    )
+    parser.add_argument(
+        "--async_staleness_alpha", type=float, default=None,
+        help="staleness decay exponent: weight = n * (1+s)^-alpha "
+        "(0 = flat weights)",
+    )
+    parser.add_argument(
+        "--async_max_staleness", type=int, default=None,
+        help="drop updates staler than this many versions (0 = unlimited)",
+    )
+    parser.add_argument(
+        "--async_flush_s", type=float, default=None,
+        help="flush a partial async buffer after this stall (0 = never)",
+    )
+    parser.add_argument(
+        "--async_admit_rate", type=float, default=None,
+        help="token-bucket admission rate on C2S_SEND_MODEL, updates/s "
+        "(0 = unlimited)",
+    )
+    parser.add_argument(
+        "--async_admit_burst", type=int, default=None,
+        help="token-bucket burst (0 = 2x buffer size)",
+    )
+    parser.add_argument(
+        "--async_queue_limit", type=int, default=None,
+        help="bounded fold-queue depth; overflow is shed with retry-after "
+        "(0 = 4x buffer size)",
     )
     # telemetry plane (defaults None so YAML keys win when the flag is absent)
     parser.add_argument(

@@ -74,7 +74,11 @@ def build_fault_plan(rank: int, seed: int, loss: float, duplicate: float,
 
 def run_world(a, run_id: str, checkpoint_dir: str, faulty: bool,
               kill_round: int = -1) -> Dict:
-    """One loopback cross-silo federation (server + clients as threads).
+    """One cross-silo federation: server in THIS process; clients either as
+    loopback threads (default) or — with ``--transport grpc`` on a faulty
+    leg — as REAL client OS processes over multiprocess gRPC, spawned
+    through the swarm harness's :class:`ProcSpawner` (ISSUE 7 satellite:
+    chaos matrices beyond loopback).
 
     Returns {"params": leaves, "server": manager, "preempted": bool}. With
     ``kill_round >= 0`` a watcher thread SIGTERMs THIS process as soon as
@@ -88,12 +92,21 @@ def run_world(a, run_id: str, checkpoint_dir: str, faulty: bool,
     from fedml_tpu.core import runstate
     from fedml_tpu.cross_silo import FedMLCrossSiloClient, FedMLCrossSiloServer
 
+    from fedml_tpu.parallel.multihost import free_port
+
+    grpc_leg = faulty and str(
+        getattr(a, "transport", "loopback")).lower() == "grpc"
+    port = free_port() if grpc_leg else 0
+
     def mk(role, rank=0):
         overrides = dict(
             _world_overrides(a), role=role, rank=rank, run_id=run_id,
             checkpoint_dir=checkpoint_dir,
             checkpoint_rounds=int(a.checkpoint_rounds),
         )
+        if grpc_leg:
+            overrides.update(backend="GRPC", comm_port=port,
+                             comm_host="127.0.0.1")
         return fedml.init(Arguments(overrides=overrides),
                           should_init_logs=False)
 
@@ -103,14 +116,29 @@ def run_world(a, run_id: str, checkpoint_dir: str, faulty: bool,
     server = FedMLCrossSiloServer(args_s, None, ds, bundle)
 
     clients = []
-    for rank in range(1, int(a.clients) + 1):
-        args_c = mk("client", rank)
-        if faulty:
-            args_c.fault_plan = build_fault_plan(
-                rank, int(a.seed), float(a.loss), float(a.duplicate),
-                float(a.corrupt),
-            )
-        clients.append(FedMLCrossSiloClient(args_c, None, ds, bundle))
+    spawner = None
+    if grpc_leg:
+        from fedml_tpu.traffic.swarm import ProcSpawner, python_module_cmd
+
+        spawner = ProcSpawner()
+        for rank in range(1, int(a.clients) + 1):
+            spawner.spawn(python_module_cmd(
+                "fedml_tpu.cli", "chaos", "--client",
+                "--client_rank", str(rank), "--port", str(port),
+                "--clients", str(a.clients), "--rounds", str(a.rounds),
+                "--epochs", str(a.epochs), "--seed", str(a.seed),
+                "--loss", str(a.loss), "--duplicate", str(a.duplicate),
+                "--corrupt", str(a.corrupt),
+            ))
+    else:
+        for rank in range(1, int(a.clients) + 1):
+            args_c = mk("client", rank)
+            if faulty:
+                args_c.fault_plan = build_fault_plan(
+                    rank, int(a.seed), float(a.loss), float(a.duplicate),
+                    float(a.corrupt),
+                )
+            clients.append(FedMLCrossSiloClient(args_c, None, ds, bundle))
 
     if kill_round >= 0:
         ledger = runstate.RunLedger.for_checkpoint_dir(checkpoint_dir)
@@ -137,6 +165,14 @@ def run_world(a, run_id: str, checkpoint_dir: str, faulty: bool,
         server.run()
     except runstate.PreemptionError:
         pass  # expected under kill_round; reported via the preempted flag
+    finally:
+        if spawner is not None:
+            # a preempted server leaves its client processes blocked on a
+            # dead endpoint: reap them (the resumed leg spawns fresh ones,
+            # which re-train the resumed round from its re-broadcast INIT)
+            if not server.manager.preempted:
+                spawner.wait_all(timeout_s=30.0)
+            spawner.kill_all()
     if kill_round >= 0:
         stop_watch.set()
     import jax
@@ -195,6 +231,7 @@ def _worker_cmd(a, out: str, ckpt_dir: str, kill_round: int) -> List[str]:
         "--corrupt", str(a.corrupt),
         "--checkpoint_rounds", str(a.checkpoint_rounds),
         "--kill-round", str(kill_round),
+        "--transport", str(getattr(a, "transport", "loopback")),
     ]
 
 
@@ -307,7 +344,40 @@ def orchestrate(a) -> int:
     return 0 if verdict["ok"] else 1
 
 
+def run_client_worker(a) -> int:
+    """One REAL cross-silo client as its own OS process — the multiprocess
+    gRPC chaos leg's client side, spawned by the chaos worker's
+    ProcSpawner. It builds its own fault plan from the matrix flags (the
+    same seeding as the loopback leg, so the fault stream per rank is
+    transport-independent) and runs the production client FSM to FINISH."""
+    import fedml_tpu as fedml
+    from fedml_tpu import data as data_mod
+    from fedml_tpu import models as model_mod
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.cross_silo import FedMLCrossSiloClient
+
+    rank = int(a.client_rank)
+    overrides = dict(
+        _world_overrides(a), role="client", rank=rank,
+        run_id=f"chaos-grpc-{rank}", backend="GRPC",
+        comm_port=int(a.port), comm_host="127.0.0.1",
+    )
+    args_c = fedml.init(Arguments(overrides=overrides),
+                        should_init_logs=False)
+    args_c.fault_plan = build_fault_plan(
+        rank, int(a.seed), float(a.loss), float(a.duplicate),
+        float(a.corrupt),
+    )
+    ds, od = data_mod.load(args_c)
+    bundle = model_mod.create(args_c, od)
+    client = FedMLCrossSiloClient(args_c, None, ds, bundle)
+    client.run()
+    return 0 if client.manager.done.is_set() else 1
+
+
 def main(a) -> int:
+    if getattr(a, "client", False):
+        return run_client_worker(a)
     if a.worker:
         return run_worker(a)
     return orchestrate(a)

@@ -374,6 +374,25 @@ def cmd_chaos(args) -> int:
     return chaos_main(args)
 
 
+def cmd_swarm(args) -> int:
+    """Client-swarm traffic soak (fedml_tpu/traffic/swarm.py): drive the
+    async cross-silo server (``aggregation_mode=async``, FedBuff-style
+    buffered aggregation + admission control) with thousands of concurrent
+    simulated devices — seeded think-time/dropout processes over loopback
+    or real multiprocess gRPC — and report p99 dispatch→ready latency plus
+    the traffic.* backpressure counters as JSON. CI entry:
+    ``tools/swarm_smoke.sh``."""
+    import logging as _logging
+
+    from .traffic.swarm import run_device_worker, run_swarm
+
+    _logging.basicConfig(
+        level=_logging.WARNING if args.worker else _logging.INFO)
+    if args.worker:
+        return run_device_worker(args)
+    return run_swarm(args)
+
+
 def cmd_multihost(args) -> int:
     """Spawn N coordinated worker processes (analog: mpirun -np N).
 
@@ -503,11 +522,72 @@ def main(argv=None) -> int:
                          help="scratch dir (default: a fresh temp dir)")
     p_chaos.add_argument("--timeout", type=float, default=240.0,
                          help="per-leg subprocess timeout (seconds)")
+    p_chaos.add_argument("--transport", choices=("loopback", "grpc"),
+                         default="loopback",
+                         help="faulty-leg transport: loopback threads, or "
+                         "REAL multiprocess gRPC clients (the reference "
+                         "leg stays loopback — parity must hold across "
+                         "transports)")
     # internal: run ONE chaos leg in this process (the orchestrator's child)
     p_chaos.add_argument("--worker", action="store_true",
                          help=argparse.SUPPRESS)
     p_chaos.add_argument("--out", default="", help=argparse.SUPPRESS)
     p_chaos.add_argument("--checkpoint_dir", default="",
+                         help=argparse.SUPPRESS)
+    # internal: run ONE real gRPC client in this process (spawned by the
+    # chaos worker's ProcSpawner for the multiprocess transport leg)
+    p_chaos.add_argument("--client", action="store_true",
+                         help=argparse.SUPPRESS)
+    p_chaos.add_argument("--client_rank", type=int, default=0,
+                         help=argparse.SUPPRESS)
+    p_chaos.add_argument("--port", type=int, default=0,
+                         help=argparse.SUPPRESS)
+
+    p_swarm = sub.add_parser(
+        "swarm",
+        help="client-swarm traffic soak against the async (FedBuff-style) "
+        "server: seeded arrival/dropout, admission control, p99 "
+        "dispatch→ready report",
+    )
+    p_swarm.add_argument("--clients", type=int, default=200,
+                         help="concurrent simulated devices")
+    p_swarm.add_argument("--steps", type=int, default=20,
+                         help="server steps (model versions) to run")
+    p_swarm.add_argument("--buffer", type=int, default=0,
+                         help="async buffer size K (0 = min(10, clients))")
+    p_swarm.add_argument("--staleness_alpha", type=float, default=0.5,
+                         help="staleness decay exponent (1+s)^-alpha")
+    p_swarm.add_argument("--max_staleness", type=int, default=0,
+                         help="drop updates staler than this (0 = never)")
+    p_swarm.add_argument("--flush_s", type=float, default=5.0,
+                         help="flush a partial buffer after this stall")
+    p_swarm.add_argument("--admit_rate", type=float, default=0.0,
+                         help="token-bucket admission rate, updates/s "
+                         "(0 = unlimited)")
+    p_swarm.add_argument("--admit_burst", type=int, default=0,
+                         help="token-bucket burst (0 = 2x buffer)")
+    p_swarm.add_argument("--queue_limit", type=int, default=0,
+                         help="bounded fold-queue depth (0 = 4x buffer)")
+    p_swarm.add_argument("--think_s", type=float, default=0.2,
+                         help="mean device think time, seconds "
+                         "(exponential — Poisson arrivals at the server)")
+    p_swarm.add_argument("--dropout", type=float, default=0.0,
+                         help="per-dispatch device dropout probability")
+    p_swarm.add_argument("--seed", type=int, default=7)
+    p_swarm.add_argument("--backend", choices=("loopback", "grpc"),
+                         default="loopback")
+    p_swarm.add_argument("--procs", type=int, default=2,
+                         help="device-host processes (grpc backend)")
+    p_swarm.add_argument("--port", type=int, default=18950,
+                         help="gRPC base port")
+    p_swarm.add_argument("--timeout", type=float, default=300.0)
+    p_swarm.add_argument("--run_id", default="swarm")
+    # internal: one gRPC device-host process (the orchestrator's child)
+    p_swarm.add_argument("--worker", action="store_true",
+                         help=argparse.SUPPRESS)
+    p_swarm.add_argument("--rank_base", type=int, default=1,
+                         help=argparse.SUPPRESS)
+    p_swarm.add_argument("--count", type=int, default=0,
                          help=argparse.SUPPRESS)
 
     p_mh = sub.add_parser(
@@ -537,6 +617,7 @@ def main(argv=None) -> int:
         "cache": cmd_cache,
         "lint": cmd_lint,
         "chaos": cmd_chaos,
+        "swarm": cmd_swarm,
         "multihost": cmd_multihost,
     }
     if args.command is None:

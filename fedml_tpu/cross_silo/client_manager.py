@@ -79,6 +79,9 @@ class ClientMasterManager(FedMLCommManager):
         self.register_message_receive_handler(
             MyMessage.MSG_TYPE_S2C_FINISH, self._on_finish
         )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_SHED_NOTICE, self._on_shed
+        )
 
     def _on_connection_ready(self, msg: Message) -> None:
         status = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
@@ -141,6 +144,43 @@ class ClientMasterManager(FedMLCommManager):
                 self._last_trained_round,
             )
         return True
+
+    def _on_shed(self, msg: Message) -> None:
+        """The async server's admission control shed our update
+        (docs/traffic.md): back off retry_after_s, then re-offer the SAME
+        trained update as a freshly-stamped message — the shed happened
+        AFTER the server's dedup window recorded the original seq, so a
+        verbatim re-send of the cached message would be dropped as a wire
+        duplicate and the contribution lost for good."""
+        from ..core.mlops import telemetry
+
+        shed_round = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, -1))
+        if shed_round != self._last_trained_round \
+                or self._last_model_msg is None:
+            return  # a newer round superseded the shed update
+        delay = max(
+            float(msg.get(MyMessage.MSG_ARG_KEY_RETRY_AFTER_S, 0.1)), 0.01)
+        telemetry.counter_inc("traffic.client_retries")
+        logger.info(
+            "client %d: round %d update shed (%s) — re-offering in %.3fs",
+            self.rank, shed_round,
+            msg.get(MyMessage.MSG_ARG_KEY_SHED_REASON, "?"), delay,
+        )
+        t = threading.Timer(delay, self._reoffer_model, args=(shed_round,))
+        t.daemon = True
+        t.start()
+
+    def _reoffer_model(self, shed_round: int) -> None:
+        cached = self._last_model_msg
+        if cached is None or shed_round != self._last_trained_round:
+            return  # superseded while we backed off
+        fresh = Message(cached.get_type(), self.rank, 0)
+        fresh.init({
+            k: v for k, v in cached.get_params().items()
+            if k not in (Message.MSG_ARG_KEY_SEQ, Message.MSG_ARG_KEY_EPOCH)
+        })
+        fresh.set_arrays(cached.get_arrays())
+        self.send_message(fresh)
 
     def _on_finish(self, msg: Message) -> None:
         self._install_params(msg)

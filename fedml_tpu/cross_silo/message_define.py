@@ -15,6 +15,10 @@ class MyMessage:
     MSG_TYPE_S2C_INIT_CONFIG = "s2c_init_config"
     MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT = "s2c_sync_model_to_client"
     MSG_TYPE_S2C_FINISH = "s2c_finish"
+    # async traffic plane (aggregation_mode=async, docs/traffic.md):
+    # admission control shed a C2S model — the explicit NACK carrying the
+    # shed update's version and a retry_after_s the client backs off by
+    MSG_TYPE_S2C_SHED_NOTICE = "s2c_shed_notice"
 
     # intra-silo master <-> slave plane (hierarchical cross-silo;
     # reference: cross_silo/client/fedml_client_slave_manager.py)
@@ -27,6 +31,11 @@ class MyMessage:
     MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
     MSG_ARG_KEY_CLIENT_STATUS = "client_status"
     MSG_ARG_KEY_TRAIN_LOSS = "train_loss"
+    # async traffic plane: in aggregation_mode=async the round index IS the
+    # server model version (version-tagged dispatch → exact staleness);
+    # these keys ride the shed NACK
+    MSG_ARG_KEY_RETRY_AFTER_S = "retry_after_s"
+    MSG_ARG_KEY_SHED_REASON = "shed_reason"
 
     CLIENT_STATUS_ONLINE = "ONLINE"
     CLIENT_STATUS_OFFLINE = "OFFLINE"

@@ -5,13 +5,33 @@ reference: ``cross_silo/server/fedml_server_manager.py`` (276 LoC) +
 CONNECTION_READY → wait for ONLINE from all selected clients → S2C_INIT with
 the global model → collect C2S models → aggregate (attack/defense/DP hook
 order preserved) → eval → S2C_SYNC … → S2C_FINISH.
+
+Two aggregation modes (``--aggregation_mode``, docs/traffic.md):
+
+- **sync** (default, the reference semantics above): one global round
+  barrier; a round aggregates when every live client answered (or the
+  round deadline fires). Bitwise-identical to the pre-traffic-plane
+  server — pinned by tests/test_traffic.py.
+- **async** (FedBuff-style, ISSUE 7 tentpole): no cohort barrier. The
+  round index doubles as the **server model version**; every dispatched
+  model is version-tagged, accepted updates fold into a K-update buffer
+  with staleness-decayed weights (fedml_tpu/traffic/async_aggregator.py),
+  and a server step fires per K folds. C2S_SEND_MODEL sits behind
+  admission control (token bucket + bounded fold queue,
+  fedml_tpu/traffic/admission.py): overload degrades to an explicit
+  S2C_SHED_NOTICE NACK with retry_after, never to memory growth. Both
+  modes share ONE aggregation core (``_aggregate_models`` — the
+  attack → defend → DP hook chain), which is what makes the sync-parity
+  pin (async K=N, alpha=0 ≡ sync FedAvg, bitwise) possible.
 """
 
 from __future__ import annotations
 
 import logging
+import queue
 import threading
-from typing import Dict, Optional
+import time
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -69,6 +89,41 @@ class FedMLServerManager(FedMLCommManager):
         self.final_metrics: Optional[dict] = None
         self.done = threading.Event()
         self.preempted = False
+        # -- async traffic plane (fedml_tpu/traffic/, docs/traffic.md) ------
+        self.async_mode = (
+            str(getattr(args, "aggregation_mode", "sync") or "sync").lower()
+            == "async"
+        )
+        self._rx: Optional["queue.Queue"] = None
+        self._async_worker: Optional[threading.Thread] = None
+        if self.async_mode:
+            from ..traffic.admission import (
+                AdmissionController, queue_limit_from_args,
+            )
+            from ..traffic.async_aggregator import AsyncConfig, AsyncUpdateBuffer
+
+            if str(getattr(args, "compression", "") or ""):
+                # a compressed delta decodes against the GLOBAL the client
+                # trained from; the async server has moved past that
+                # version, so decoding against the head silently corrupts
+                # the update — refuse loudly until a version-indexed
+                # reference store exists
+                raise ValueError(
+                    "aggregation_mode=async does not support update "
+                    "compression yet (the delta's reference global is "
+                    "version-specific); drop --compression or use sync"
+                )
+            self.async_cfg = AsyncConfig.from_args(args, self.client_num)
+            self.buffer = AsyncUpdateBuffer(self.async_cfg)
+            self.admission = AdmissionController.from_args(
+                args, self.async_cfg.buffer_size)
+            self._rx = queue.Queue(
+                maxsize=queue_limit_from_args(args, self.async_cfg.buffer_size)
+            )
+            self._async_worker = threading.Thread(
+                target=self._async_worker_loop, daemon=True,
+                name="async-aggregator",
+            )
         # per-round contribution counters: how many times each client's
         # model was ACCEPTED into a round's aggregation. The delivery-layer
         # dedup keeps every count at 1 even under retries/duplication —
@@ -121,12 +176,25 @@ class FedMLServerManager(FedMLCommManager):
             # a finished federation with a larger round budget is the
             # supported "extend the run" pattern
             self._ledger = runstate.RunLedger.for_checkpoint_dir(ckpt_dir)
+            world = {
+                "engine": type(self).__name__,
+                "client_num": self.client_num,
+            }
+            if self.async_mode:
+                # buffer state is run identity: resuming an async ledger
+                # with a different mode/buffer/decay is a different
+                # federation — ensure_meta's world comparison rejects it.
+                # (sync ledgers stay byte-identical to the pre-traffic
+                # format, so old checkpoints keep resuming.)
+                world.update(
+                    aggregation_mode="async",
+                    buffer_size=self.async_cfg.buffer_size,
+                    staleness_alpha=self.async_cfg.staleness_alpha,
+                    max_staleness=self.async_cfg.max_staleness,
+                )
             self._ledger.ensure_meta(
                 seed=int(getattr(args, "random_seed", 0)),
-                world={
-                    "engine": type(self).__name__,
-                    "client_num": self.client_num,
-                },
+                world=world,
             )
             # preemption-safe drain: SIGTERM/SIGINT latches; the in-flight
             # round finishes aggregating, commits checkpoint + ledger, and
@@ -144,9 +212,18 @@ class FedMLServerManager(FedMLCommManager):
         self.register_message_receive_handler(
             MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self._on_client_status
         )
-        self.register_message_receive_handler(
-            MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self._on_model_received
-        )
+        if self.async_mode:
+            self.register_message_receive_handler(
+                MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+                self._on_model_received_async,
+            )
+            if not self._async_worker.is_alive():
+                self._async_worker.start()
+        else:
+            self.register_message_receive_handler(
+                MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+                self._on_model_received,
+            )
 
     def _on_connection_ready(self, msg: Message) -> None:
         logger.info("server: connection ready")
@@ -154,6 +231,7 @@ class FedMLServerManager(FedMLCommManager):
     def _on_client_status(self, msg: Message) -> None:
         status = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS)
         finish = False
+        finish_round = -1
         with self._lock:
             if status == MyMessage.CLIENT_STATUS_ONLINE:
                 self._online.add(msg.get_sender_id())
@@ -168,7 +246,8 @@ class FedMLServerManager(FedMLCommManager):
                 logger.warning(
                     "server: client %d went OFFLINE", msg.get_sender_id()
                 )
-                finish = self._round_complete_locked()
+                finish = not self.async_mode and self._round_complete_locked()
+                finish_round = self.round_idx
             # init barrier counts the dead as resolved — a client that died
             # during startup must not stall the federation forever
             ready = (
@@ -182,27 +261,17 @@ class FedMLServerManager(FedMLCommManager):
             # a RESTART of an already-completed federation (resumed
             # round_idx == comm_round): do not train an extra round past
             # the budget — deliver the final model and finish
-            leaves = [np.asarray(l)
-                      for l in jax.tree.leaves(self.global_params)]
-            for client_rank in range(1, self.size):
-                msg = Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank,
-                              client_rank)
-                msg.set_arrays(leaves)
-                self._send_or_mark_dead(client_rank, msg)
+            self._broadcast_finish(
+                "server: federation already complete after %d rounds")
             if self.ds is not None and self.final_metrics is None:
                 self.final_metrics = make_eval_fn(self.bundle)(
                     self.global_params, self.ds.test_x, self.ds.test_y
                 )
-            logger.info("server: federation already complete (round %d)",
-                        self.round_idx)
-            if self._ckpt is not None:
-                self._ckpt.close()
-            self.done.set()
-            self.finish()
+            self._close_and_finish()
         elif ready:
             self._send_init_msg()
         elif finish:
-            self._finish_round()
+            self._finish_round(finish_round)
 
     def _round_complete_locked(self) -> bool:
         """Caller holds the lock. True when every still-live client of the
@@ -215,8 +284,8 @@ class FedMLServerManager(FedMLCommManager):
         return live_models >= max(expected, self.min_clients) > 0
 
     def _arm_round_timer(self) -> None:
-        if self.round_timeout <= 0:
-            return
+        if self.round_timeout <= 0 or self.async_mode:
+            return  # async mode has no cohort barrier to deadline
         if self._round_timer is not None:
             self._round_timer.cancel()
         self._round_timer = threading.Timer(
@@ -251,7 +320,7 @@ class FedMLServerManager(FedMLCommManager):
                 "aggregating %d/%d models",
                 round_idx, sorted(missing), len(self._models), self.client_num,
             )
-        self._finish_round()
+        self._finish_round(round_idx)
 
     def _send_init_msg(self) -> None:
         """reference: fedml_server_manager.py:93-118 (online barrier → init)."""
@@ -298,10 +367,54 @@ class FedMLServerManager(FedMLCommManager):
             self._offline_declared.discard(sender)
             have_all = self._round_complete_locked()
         if have_all:
-            self._finish_round()
+            self._finish_round(msg_round)
 
-    def _finish_round(self) -> None:
+    def _aggregate_models(self, raw, senders, round_r):
+        """The ONE aggregation core both modes share: attack hooks →
+        defense → weighted average → central DP → post hooks. ``raw`` is
+        ``[(weight, params), ...]`` in ``senders`` order (sync passes raw
+        sample counts; async passes staleness-decayed weights). The rng
+        folds ``round_r + 1`` — the value the pre-refactor code read from
+        ``self.round_idx`` after its increment — so the sync trajectory is
+        bitwise-unchanged."""
+        raw = self.aggregator.on_before_aggregation(raw)
+        weights = jnp.asarray([n for n, _ in raw])
+        stacked = stack_trees([p for _, p in raw])
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(int(getattr(self.args, "random_seed", 0))),
+            round_r + 1,
+        )
+        if self.defender.is_defense_enabled():
+            gvec, treedef, shapes = tree_flatten_to_vector(self.global_params)
+            flat = jax.vmap(lambda t: tree_flatten_to_vector(t)[0])(stacked)
+            agg_vec = self.defender.defend(
+                flat, weights, gvec, rng, client_ids=senders
+            )
+            agg = tree_unflatten_from_vector(agg_vec, treedef, shapes)
+        else:
+            agg = weighted_average(stacked, weights)
+        if self.dp is not None and self.dp.dp_type == "cdp":
+            agg = self.dp.randomize_global(agg, jax.random.fold_in(rng, 7))
+        agg = self.aggregator.on_after_aggregation(agg)
         with self._lock:
+            # published under the lock: in async mode this runs on the
+            # aggregator worker while the comm thread reads the global for
+            # FINISH/INIT broadcasts
+            self.global_params = agg
+        self.aggregator.set_model_params(agg)
+        return agg
+
+    def _finish_round(self, expected_round: int) -> None:
+        with self._lock:
+            if expected_round != self.round_idx:
+                # the round this caller saw already closed (a late timer
+                # callback racing a completing model arrival, or vice
+                # versa): the early arrivals of round expected_round+1 now
+                # sitting in self._models belong to THAT round — touching
+                # them here would aggregate a partial cohort early and
+                # double-count the closing round (ISSUE 7 satellite;
+                # regression-pinned in tests/test_faults.py)
+                return
             if not self._models:
                 return  # already aggregated (timeout/model-arrival race)
             if self._round_timer is not None:
@@ -322,77 +435,16 @@ class FedMLServerManager(FedMLCommManager):
             per_round = self.contrib_counts.setdefault(round_r, {})
             for s in senders:
                 per_round[s] = per_round.get(s, 0) + 1
-        raw = self.aggregator.on_before_aggregation(raw)
-        weights = jnp.asarray([n for n, _ in raw])
-        stacked = stack_trees([p for _, p in raw])
-        rng = jax.random.fold_in(
-            jax.random.PRNGKey(int(getattr(self.args, "random_seed", 0))),
-            self.round_idx,
-        )
-        if self.defender.is_defense_enabled():
-            gvec, treedef, shapes = tree_flatten_to_vector(self.global_params)
-            flat = jax.vmap(lambda t: tree_flatten_to_vector(t)[0])(stacked)
-            agg_vec = self.defender.defend(
-                flat, weights, gvec, rng, client_ids=senders
-            )
-            agg = tree_unflatten_from_vector(agg_vec, treedef, shapes)
-        else:
-            agg = weighted_average(stacked, weights)
-        if self.dp is not None and self.dp.dp_type == "cdp":
-            agg = self.dp.randomize_global(agg, jax.random.fold_in(rng, 7))
-        agg = self.aggregator.on_after_aggregation(agg)
-        self.global_params = agg
-        self.aggregator.set_model_params(agg)
-        preempt = self._guard is not None and self._guard.requested()
-        if self._ckpt is not None:
-            from ..core import runstate
-
-            every = runstate.checkpoint_cadence(self.args)
-            # the save blocks the FSM thread (Orbax wait_until_finished) —
-            # the checkpoint cadence bounds that cost, same as the sp
-            # engine; a preemption drain commits regardless of cadence
-            if ((round_r + 1) % every == 0 or round_r == self.round_num - 1
-                    or preempt):
-                self._ckpt.save({"global_params": agg}, step=round_r)
-                if self._ledger is not None:
-                    with self._lock:
-                        contrib = dict(self.contrib_counts.get(round_r, {}))
-                    self._ledger.commit_round(
-                        round_r, ckpt_step=round_r, cohort=senders,
-                        contrib={str(k): v for k, v in contrib.items()},
-                    )
-
-        if self.ds is not None:
-            freq = max(int(getattr(self.args, "frequency_of_the_test", 1)), 1)
-            if round_r % freq == 0 or round_r == self.round_num - 1:
-                self.final_metrics = make_eval_fn(self.bundle)(
-                    agg, self.ds.test_x, self.ds.test_y
-                )
-                logger.info(
-                    "server round %d: acc=%.4f", round_r,
-                    self.final_metrics["test_acc"],
-                )
-
+        agg = self._aggregate_models(raw, senders, round_r)
+        preempt = self._commit_and_eval(round_r, agg, senders,
+                                        log_label="server round")
         if preempt and self.round_idx < self.round_num:
-            # preemption drain: round_r is aggregated + committed; stop
-            # HERE instead of dispatching round_r+1 — the restarted server
-            # resumes at exactly round_r+1 with the committed global
-            from ..core.mlops import telemetry
-
-            telemetry.counter_inc("run.preemptions")
-            logger.warning(
-                "server: preempted after committing round %d — resumable "
-                "with --resume auto", round_r,
-            )
-            self.preempted = True
-            if self._ckpt is not None:
-                self._ckpt.close()
-            self.done.set()
-            self.finish()
+            self._preempt_exit(round_r)
             return
 
-        leaves = [np.asarray(l) for l in jax.tree.leaves(self.global_params)]
         if self.round_idx < self.round_num:
+            leaves = [np.asarray(l)
+                      for l in jax.tree.leaves(self.global_params)]
             for client_rank in range(1, self.size):
                 # dropped clients still receive the sync (maybe the stall was
                 # transient); they rejoin the quorum when a model arrives.
@@ -408,15 +460,79 @@ class FedMLServerManager(FedMLCommManager):
                 self._send_or_mark_dead(client_rank, msg)
             self._arm_round_timer()
         else:
-            for client_rank in range(1, self.size):
-                msg = Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, client_rank)
-                msg.set_arrays(leaves)
-                self._send_or_mark_dead(client_rank, msg)
-            logger.info("server: training finished after %d rounds", self.round_num)
-            if self._ckpt is not None:
-                self._ckpt.close()
-            self.done.set()
-            self.finish()
+            self._broadcast_finish(
+                "server: training finished after %d rounds")
+            self._close_and_finish()
+
+    # -- the post-aggregation tail both modes share -------------------------
+
+    def _commit_and_eval(self, round_r, agg, senders, log_label,
+                         **ledger_extra) -> bool:
+        """Checkpoint + ledger commit on cadence, eval on cadence.
+        Returns whether a preemption drain is latched (the caller stops
+        instead of dispatching the next round/version)."""
+        preempt = self._guard is not None and self._guard.requested()
+        if self._ckpt is not None:
+            from ..core import runstate
+
+            every = runstate.checkpoint_cadence(self.args)
+            # the save blocks the calling thread (Orbax
+            # wait_until_finished) — the checkpoint cadence bounds that
+            # cost, same as the sp engine; a preemption drain commits
+            # regardless of cadence
+            if ((round_r + 1) % every == 0 or round_r == self.round_num - 1
+                    or preempt):
+                self._ckpt.save({"global_params": agg}, step=round_r)
+                if self._ledger is not None:
+                    with self._lock:
+                        contrib = dict(self.contrib_counts.get(round_r, {}))
+                    self._ledger.commit_round(
+                        round_r, ckpt_step=round_r, cohort=senders,
+                        contrib={str(k): v for k, v in contrib.items()},
+                        **ledger_extra,
+                    )
+        if self.ds is not None:
+            freq = max(int(getattr(self.args, "frequency_of_the_test", 1)),
+                       1)
+            if round_r % freq == 0 or round_r == self.round_num - 1:
+                metrics = make_eval_fn(self.bundle)(
+                    agg, self.ds.test_x, self.ds.test_y
+                )
+                with self._lock:
+                    self.final_metrics = metrics
+                logger.info("%s %d: acc=%.4f", log_label, round_r,
+                            metrics["test_acc"])
+        return preempt
+
+    def _preempt_exit(self, round_r: int) -> None:
+        """Preemption drain: round_r is aggregated + committed; stop HERE
+        instead of dispatching round_r+1 — the restarted server resumes at
+        exactly round_r+1 with the committed global."""
+        from ..core.mlops import telemetry
+
+        telemetry.counter_inc("run.preemptions")
+        logger.warning(
+            "server: preempted after committing round %d — resumable "
+            "with --resume auto", round_r,
+        )
+        with self._lock:
+            self.preempted = True
+        self._close_and_finish()
+
+    def _broadcast_finish(self, log_msg: str) -> None:
+        leaves = [np.asarray(l) for l in jax.tree.leaves(self.global_params)]
+        for client_rank in range(1, self.size):
+            msg = Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank,
+                          client_rank)
+            msg.set_arrays(leaves)
+            self._send_or_mark_dead(client_rank, msg)
+        logger.info(log_msg, self.round_num)
+
+    def _close_and_finish(self) -> None:
+        if self._ckpt is not None:
+            self._ckpt.close()
+        self.done.set()
+        self.finish()
 
     def _send_or_mark_dead(self, client_rank: int, msg: Message) -> None:
         """Transport-level liveness: an unreachable peer (dead gRPC channel)
@@ -430,3 +546,189 @@ class FedMLServerManager(FedMLCommManager):
             )
             with self._lock:
                 self._dead.add(client_rank)
+
+    # -- async traffic plane (aggregation_mode=async; docs/traffic.md) ------
+
+    def _on_model_received_async(self, msg: Message) -> None:
+        """C2S_SEND_MODEL behind admission control. The comm thread only
+        gates and enqueues (header-cheap); decode, staleness judgment and
+        folding run on the aggregator worker — a slow defense/DP step
+        backpressures into load-shedding, never into queue growth."""
+        sender = msg.get_sender_id()
+        client_version = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, -1))
+        item = (
+            time.monotonic(), sender, client_version,
+            float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0)),
+            msg.get_arrays(),
+        )
+        verdict = self.admission.offer(lambda: self._try_enqueue(item))
+        if not verdict.admitted:
+            self._shed_reply(sender, client_version, verdict)
+
+    def _try_enqueue(self, item) -> bool:
+        try:
+            self._rx.put_nowait(item)
+            return True
+        except queue.Full:
+            return False
+
+    def _shed_reply(self, sender: int, client_version: int,
+                    verdict) -> None:
+        """Explicit NACK: the client re-offers the SAME trained update after
+        retry_after_s (as a freshly-stamped message — the shed happened
+        after dedup recorded the original seq)."""
+        logger.info(
+            "server: shed update from client %d (version %d, %s) — "
+            "retry after %.3fs", sender, client_version, verdict.reason,
+            verdict.retry_after_s,
+        )
+        nack = Message(MyMessage.MSG_TYPE_S2C_SHED_NOTICE, self.rank, sender)
+        nack.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, client_version)
+        nack.add(MyMessage.MSG_ARG_KEY_RETRY_AFTER_S,
+                 float(verdict.retry_after_s))
+        nack.add(MyMessage.MSG_ARG_KEY_SHED_REASON, verdict.reason)
+        self._send_or_mark_dead(sender, nack)
+
+    def _async_worker_loop(self) -> None:
+        """Aggregator worker: drain the bounded queue, fold, and take a
+        server step per ``buffer_size`` accepted updates — or flush a
+        partial buffer after ``async_flush_s`` of stall, so a dropped-out
+        tail cohort can never wedge the federation."""
+        last_progress = time.monotonic()
+        while not self.done.is_set():
+            try:
+                item = self._rx.get(timeout=0.05)
+            except queue.Empty:
+                item = None
+            if item is not None:
+                try:
+                    self._async_fold(item)
+                except Exception:
+                    # one malformed update (wrong leaf count, hostile
+                    # client, version skew) must cost ITSELF, not the
+                    # aggregator thread — a dead worker would livelock the
+                    # federation behind queue_full sheds with no error
+                    from ..core.mlops import telemetry
+
+                    telemetry.counter_inc("traffic.fold_errors")
+                    logger.exception(
+                        "server: dropping malformed update from client %s",
+                        item[1],
+                    )
+                last_progress = time.monotonic()
+            if self.done.is_set():
+                return
+            stepped = False
+            try:
+                if self.buffer.ready():
+                    stepped = self._async_step()
+                elif (self.async_cfg.flush_s > 0
+                        and self.buffer.occupancy() > 0
+                        and time.monotonic() - last_progress
+                        >= self.async_cfg.flush_s):
+                    logger.warning(
+                        "server: flushing a partial async buffer (%d/%d) "
+                        "after %.1fs without progress",
+                        self.buffer.occupancy(),
+                        self.async_cfg.buffer_size, self.async_cfg.flush_s,
+                    )
+                    stepped = self._async_step()
+            except Exception:
+                # a failed step already drained its buffer; surface the
+                # error loudly but keep serving — the next K updates get
+                # their step
+                from ..core.mlops import telemetry
+
+                telemetry.counter_inc("traffic.step_errors")
+                logger.exception("server: async step failed")
+                stepped = True
+            if stepped:
+                last_progress = time.monotonic()
+
+    def _async_fold(self, item) -> None:
+        """Decode one admitted update and fold it into the buffer with its
+        exact staleness (server version at fold minus the version tag the
+        dispatched model carried)."""
+        from ..core.mlops import telemetry
+
+        t_enq, sender, client_version, n, arrays = item
+        leaves = [jnp.asarray(a) for a in arrays]
+        params = jax.tree.unflatten(
+            jax.tree.structure(self.global_params), leaves
+        )
+        verdict = self.buffer.fold(
+            sender, n, params, client_version, self.round_idx
+        )
+        with self._lock:
+            # an accepted (or even stale) update proves the client lives
+            self._dead.discard(sender)
+            self._offline_declared.discard(sender)
+        if verdict == "stale":
+            # beyond max_staleness: the update is discarded, but the
+            # sender rejoins at version head with a fresh model
+            self._send_model_to(
+                sender, MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
+            return
+        telemetry.observe(
+            "traffic.dispatch_ready_s", time.monotonic() - t_enq)
+
+    def _async_step(self) -> bool:
+        """One FedBuff server step: drain the buffer, aggregate through the
+        shared hook chain, bump the model version, commit/eval on cadence,
+        and dispatch the new version to this step's contributors."""
+        from ..core.mlops import telemetry
+
+        t0 = time.monotonic()
+        entries = self.buffer.drain()
+        if not entries:
+            return False
+        senders = [e.sender for e in entries]
+        raw = [(e.weight, e.params) for e in entries]
+        with self._lock:
+            # close the version window NOW (same discipline as the sync
+            # round): updates folded after this belong to the next version
+            round_r = self.round_idx
+            self.round_idx += 1
+            per_round = self.contrib_counts.setdefault(round_r, {})
+            for e in entries:
+                per_round[e.sender] = per_round.get(e.sender, 0) + 1
+        agg = self._aggregate_models(raw, senders, round_r)
+        telemetry.counter_inc("traffic.server_steps")
+        preempt = self._commit_and_eval(
+            round_r, agg, senders, log_label="server step",
+            mode="async", staleness=[e.staleness for e in entries],
+        )
+        telemetry.observe("traffic.step_s", time.monotonic() - t0)
+        if preempt and self.round_idx < self.round_num:
+            self._preempt_exit(round_r)
+            return True
+        if self.round_idx >= self.round_num:
+            self._broadcast_finish(
+                "server: async training finished after %d steps")
+            self._close_and_finish()
+            return True
+        # FedBuff dispatch rule: a client re-enters training when its
+        # update is consumed — ship the new version to the contributors
+        # (pytree→numpy conversion hoisted out of the per-recipient loop)
+        with self._lock:
+            skip = set(self._offline_declared)
+        leaves = [np.asarray(l) for l in jax.tree.leaves(agg)]
+        for client_rank in sorted(set(senders)):
+            if client_rank in skip:
+                continue
+            self._send_model_to(
+                client_rank, MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                leaves=leaves)
+        return True
+
+    def _send_model_to(self, client_rank: int, msg_type: str,
+                       leaves=None) -> None:
+        """Version-tagged model dispatch (the version IS the round index —
+        the client echoes it back, making staleness exact)."""
+        if leaves is None:
+            leaves = [np.asarray(l)
+                      for l in jax.tree.leaves(self.global_params)]
+        m = Message(msg_type, self.rank, client_rank)
+        m.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
+        m.set_arrays(leaves)
+        self._send_or_mark_dead(client_rank, m)

@@ -1,0 +1,32 @@
+"""``fedml_tpu.traffic`` — the production traffic plane (ISSUE 7).
+
+Three pieces, composed by the cross-silo server manager and the swarm CLI:
+
+- :mod:`async_aggregator` — FedBuff-style buffered asynchronous aggregation
+  with exact, version-tagged staleness weighting;
+- :mod:`admission` — token-bucket admission control + bounded fold queue on
+  ``C2S_SEND_MODEL`` (overload → explicit shed/NACK, never OOM);
+- :mod:`swarm` — the client-swarm traffic generator (``fedml_tpu swarm``):
+  thousands of concurrent simulated devices with seeded Poisson think-time
+  and dropout processes, over loopback or real multiprocess gRPC.
+
+See docs/traffic.md for the knobs and the ``traffic.*`` telemetry family.
+"""
+
+from .admission import AdmissionController, AdmissionVerdict, TokenBucket
+from .async_aggregator import (
+    AsyncConfig,
+    AsyncUpdateBuffer,
+    BufferedUpdate,
+    staleness_weight,
+)
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionVerdict",
+    "TokenBucket",
+    "AsyncConfig",
+    "AsyncUpdateBuffer",
+    "BufferedUpdate",
+    "staleness_weight",
+]

@@ -1,0 +1,141 @@
+"""Admission control for the async traffic plane: token bucket + bounded
+receive queue on ``C2S_SEND_MODEL``.
+
+reference: none — the reference server (SURVEY §"Octopus") accepts every
+model message unconditionally; under a production arrival process the
+receive path is the OOM. Papaya (Huba et al., MLSys 2022) runs its async
+aggregator behind admission control for exactly this reason: overload must
+degrade to *load-shedding with an explicit retry-after*, never to memory
+growth.
+
+Two gates, both cheap enough for the comm receive thread:
+
+- :class:`TokenBucket` — seeded-rate admission (``async_admit_rate``
+  updates/s, ``async_admit_burst`` capacity). A denied take returns the
+  time until a token is available, which rides the shed NACK as
+  ``retry_after_s`` so clients back off instead of hammering.
+- the **bounded fold queue** — the server manager's worker thread drains a
+  ``queue.Queue(maxsize=async_queue_limit)``; when the aggregator falls
+  behind, ``put_nowait`` fails and the update is shed. Memory held by
+  pending updates is bounded by ``queue_limit × model size`` no matter the
+  arrival rate.
+
+Every decision is counted into the ``traffic.*`` telemetry family
+(docs/telemetry.md): accepted / shed / queue-full, plus a queue-depth
+gauge — the backpressure counters the swarm harness asserts on.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..core.mlops import telemetry
+
+
+@dataclass(frozen=True)
+class AdmissionVerdict:
+    admitted: bool
+    reason: str = ""           # "" | "rate" | "queue_full"
+    retry_after_s: float = 0.0
+
+
+_ADMIT = AdmissionVerdict(True)
+
+
+class TokenBucket:
+    """Classic token bucket; thread-safe; monotonic-clock based.
+
+    ``rate`` tokens/s refill up to ``burst``. ``rate <= 0`` disables the
+    bucket (every take succeeds) — admission off is the default so the
+    sync path and small worlds never pay for it. ``clock`` is injectable
+    for deterministic tests.
+    """
+
+    def __init__(self, rate: float, burst: int,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate)
+        self.burst = max(int(burst), 1)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tokens = float(self.burst)
+        self._last = clock()
+
+    def take(self) -> float:
+        """Take one token. Returns 0.0 on success, else the seconds until
+        one will be available (the shed NACK's retry_after_s)."""
+        if self.rate <= 0:
+            return 0.0
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._last) * self.rate
+            )
+            self._last = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return 0.0
+            return (1.0 - self._tokens) / self.rate
+
+    def refund(self) -> None:
+        """Return a token taken for an update that was NOT admitted after
+        all (e.g. the bounded queue was full) — otherwise a queue-full
+        shed would double-penalize the client by also draining the rate
+        budget its retry needs."""
+        if self.rate <= 0:
+            return
+        with self._lock:
+            self._tokens = min(self.burst, self._tokens + 1.0)
+
+
+class AdmissionController:
+    """The C2S_SEND_MODEL admission gate the async server handler calls.
+
+    ``offer(queue_put)`` runs the token bucket, then the caller-supplied
+    bounded enqueue (a ``queue.Queue.put_nowait`` wrapper returning bool).
+    Returns an :class:`AdmissionVerdict`; counters are bumped here so every
+    call site reports identically.
+    """
+
+    def __init__(self, rate: float = 0.0, burst: int = 0,
+                 retry_after_floor_s: float = 0.05,
+                 clock: Callable[[], float] = time.monotonic):
+        self.bucket = TokenBucket(rate, burst or 1, clock=clock)
+        self.retry_after_floor_s = float(retry_after_floor_s)
+
+    def offer(self, queue_put: Optional[Callable[[], bool]] = None
+              ) -> AdmissionVerdict:
+        wait = self.bucket.take()
+        if wait > 0:
+            telemetry.counter_inc("traffic.shed_updates")
+            telemetry.counter_inc("traffic.shed_rate_limited")
+            return AdmissionVerdict(
+                False, "rate", max(wait, self.retry_after_floor_s))
+        if queue_put is not None and not queue_put():
+            self.bucket.refund()  # the token was never really spent
+            telemetry.counter_inc("traffic.shed_updates")
+            telemetry.counter_inc("traffic.shed_queue_full")
+            return AdmissionVerdict(
+                False, "queue_full", self.retry_after_floor_s)
+        telemetry.counter_inc("traffic.accepted_updates")
+        return _ADMIT
+
+    @classmethod
+    def from_args(cls, args, buffer_size: int) -> "AdmissionController":
+        rate = float(getattr(args, "async_admit_rate", 0.0) or 0.0)
+        burst = int(getattr(args, "async_admit_burst", 0) or 0)
+        if burst <= 0:
+            burst = max(2 * int(buffer_size), 8)
+        return cls(rate=rate, burst=burst)
+
+
+def queue_limit_from_args(args, buffer_size: int) -> int:
+    """Bounded fold-queue depth: ``--async_queue_limit`` or 4x the buffer
+    (never below the buffer itself — a queue smaller than one server step
+    could starve the step forever)."""
+    limit = int(getattr(args, "async_queue_limit", 0) or 0)
+    if limit <= 0:
+        limit = 4 * int(buffer_size)
+    return max(limit, int(buffer_size))

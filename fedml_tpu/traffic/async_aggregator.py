@@ -1,0 +1,141 @@
+"""FedBuff-style buffered asynchronous aggregation (the traffic-plane
+tentpole, ISSUE 7).
+
+reference: Nguyen et al., *Federated Learning with Buffered Asynchronous
+Aggregation* (AISTATS 2022) and Papaya (Huba et al., MLSys 2022). Instead of
+barriering a round on the full cohort, the server folds client updates into
+a buffer **as they arrive** and takes a server step after ``K`` accepted
+updates. Each dispatched model is version-tagged (the round index IS the
+server version), so an update's staleness ``s = server_version -
+client_version`` is exact, and its aggregation weight is scaled by a
+polynomial decay ``(1 + s) ** -alpha`` (alpha = 0 keeps weight 1.0 — the
+setting under which buffer_size == cohort size reproduces synchronous
+FedAvg bitwise, pinned by tests/test_traffic.py).
+
+This module is deliberately passive — no threads, no transport: the server
+manager owns the worker thread and the attack → defend → DP aggregation
+hook chain (shared with the sync path via ``_aggregate_models``), while the
+buffer owns fold bookkeeping, staleness weighting, and the ``traffic.*``
+telemetry (occupancy gauge, staleness histogram, stale-drop counter).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+from ..core.mlops import telemetry
+
+
+def staleness_weight(staleness: int, alpha: float) -> float:
+    """Polynomial staleness decay ``(1 + s) ** -alpha``.
+
+    ``alpha = 0`` → exactly 1.0 for every staleness (the sync-parity
+    setting); larger alpha discounts stale updates harder. Negative
+    staleness (a client answering a version the server has not dispatched —
+    only possible through a corrupt header) clamps to 0.
+    """
+    s = max(int(staleness), 0)
+    if alpha == 0.0:
+        return 1.0
+    return float((1.0 + s) ** (-float(alpha)))
+
+
+@dataclass
+class BufferedUpdate:
+    """One accepted client update awaiting the next server step."""
+
+    sender: int
+    num_samples: float
+    params: Any                 # model pytree (decoded, device-ready)
+    client_version: int
+    staleness: int
+    weight: float               # num_samples * staleness_weight(staleness)
+
+    def meta(self) -> dict:
+        return {
+            "sender": int(self.sender),
+            "client_version": int(self.client_version),
+            "staleness": int(self.staleness),
+        }
+
+
+@dataclass
+class AsyncConfig:
+    """The traffic-plane knobs, resolved once from args."""
+
+    buffer_size: int
+    staleness_alpha: float = 0.0
+    max_staleness: int = 0      # 0 = unlimited
+    flush_s: float = 0.0        # 0 = never flush a partial buffer
+
+    @classmethod
+    def from_args(cls, args, client_num: int) -> "AsyncConfig":
+        k = int(getattr(args, "async_buffer_size", 0) or 0)
+        if k <= 0:
+            # FedBuff's paper default is K=10; never ask for more updates
+            # than the world has clients or the first step never triggers
+            k = min(10, max(int(client_num), 1))
+        return cls(
+            buffer_size=k,
+            staleness_alpha=float(
+                getattr(args, "async_staleness_alpha", 0.0) or 0.0),
+            max_staleness=int(getattr(args, "async_max_staleness", 0) or 0),
+            flush_s=float(getattr(args, "async_flush_s", 0.0) or 0.0),
+        )
+
+
+class AsyncUpdateBuffer:
+    """The K-update fold buffer. Thread-safe; drained by the server step.
+
+    ``fold`` returns the verdict: ``"buffered"`` (counts toward the next
+    step), or ``"stale"`` (staleness beyond ``max_staleness`` — dropped,
+    but the sender deserves a fresh model so it rejoins at version head).
+    """
+
+    def __init__(self, cfg: AsyncConfig):
+        self.cfg = cfg
+        self._lock = threading.Lock()
+        self._entries: List[BufferedUpdate] = []
+
+    def fold(self, sender: int, num_samples: float, params: Any,
+             client_version: int, server_version: int) -> str:
+        staleness = max(int(server_version) - int(client_version), 0)
+        telemetry.observe("traffic.staleness", float(staleness))
+        if 0 < self.cfg.max_staleness < staleness:
+            telemetry.counter_inc("traffic.stale_dropped_updates")
+            return "stale"
+        entry = BufferedUpdate(
+            sender=int(sender), num_samples=float(num_samples),
+            params=params, client_version=int(client_version),
+            staleness=staleness,
+            weight=float(num_samples) * staleness_weight(
+                staleness, self.cfg.staleness_alpha),
+        )
+        with self._lock:
+            self._entries.append(entry)
+            depth = len(self._entries)
+        telemetry.gauge_set("traffic.buffer_occupancy", float(depth))
+        return "buffered"
+
+    def occupancy(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def ready(self) -> bool:
+        return self.occupancy() >= self.cfg.buffer_size
+
+    def drain(self) -> List[BufferedUpdate]:
+        """Take every buffered update, sorted by (sender, client_version)
+        so aggregation order — and therefore the float reduction — is
+        arrival-order independent."""
+        with self._lock:
+            entries, self._entries = self._entries, []
+        telemetry.gauge_set("traffic.buffer_occupancy", 0.0)
+        return sorted(entries, key=lambda e: (e.sender, e.client_version))
+
+    def snapshot_meta(self) -> List[dict]:
+        """Buffer state for the run ledger's ``run_meta``/round extras."""
+        with self._lock:
+            return [e.meta() for e in self._entries]

@@ -1,0 +1,583 @@
+"""Client-swarm traffic generator: soak the cross-silo server at scale.
+
+reference: none — the reference framework was never load-tested (one server,
+a handful of loopback clients; SURVEY §5). ``fedml_tpu swarm`` drives the
+REAL server FSM (``FedMLServerManager`` in ``aggregation_mode=async``)
+with thousands of concurrent simulated devices:
+
+- each device runs the genuine client-side wire protocol (ONLINE status →
+  version-tagged INIT/SYNC → C2S model upload → shed/NACK backoff →
+  FINISH) through the real transport (loopback broker or multiprocess
+  gRPC), with **seeded processes** for think time (exponential — the
+  Poisson-arrival analog per device) and dropout, so a soak is
+  reproducible;
+- devices are *event-driven*, not thread-per-device: over loopback a
+  single pump thread drains every device mailbox and one timer wheel
+  schedules the delayed sends, so 2000 devices cost 2 threads, not 2000;
+- the report's headline is the **p99 dispatch→ready latency** from the PR 2
+  telemetry plane (``traffic.dispatch_ready_s``: server-side admission →
+  update folded), next to the backpressure counters (accepted / shed /
+  stale-dropped), staleness distribution, achieved server steps, and peak
+  RSS — the "bounded memory under overload" evidence.
+
+The :class:`ProcSpawner` here is the one process-launch surface shared with
+the chaos harness's multiprocess-gRPC legs (ISSUE 7 satellite).
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .. import constants
+from ..core.distributed import FedMLCommManager, Message
+from ..core.mlops import telemetry
+from ..cross_silo.message_define import MyMessage
+
+logger = logging.getLogger(__name__)
+
+
+def rss_peak_mb() -> float:
+    """Peak resident set of THIS process (ru_maxrss is KiB on Linux)."""
+    try:
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    except Exception:  # pragma: no cover - non-posix
+        return 0.0
+
+
+# ---------------------------------------------------------------------------
+# seeded device processes
+# ---------------------------------------------------------------------------
+
+
+class SwarmSchedule:
+    """Per-device seeded think-time + dropout process.
+
+    Think times are exponential with mean ``think_s`` — superposed over N
+    devices that is a Poisson arrival process at the server. The stream
+    depends only on (seed, rank), never on wall-clock or delivery order, so
+    a swarm's *schedule* is deterministic (pinned by tests/test_traffic.py).
+    """
+
+    def __init__(self, seed: int, rank: int, think_s: float,
+                 dropout_p: float):
+        self.rank = int(rank)
+        self.think_s = float(think_s)
+        self.dropout_p = float(dropout_p)
+        self._rng = np.random.RandomState(
+            (int(seed) * 1_000_003 + int(rank)) % (2**31 - 1))
+
+    def next_think_s(self) -> float:
+        if self.think_s <= 0:
+            return 0.0
+        return float(self._rng.exponential(self.think_s))
+
+    def drops_out(self) -> bool:
+        return bool(self._rng.rand() < self.dropout_p)
+
+
+class TimerWheel:
+    """One thread, many delayed callbacks (heapq): the thread-per-Timer
+    alternative melts at swarm scale (every backoff would be an OS
+    thread)."""
+
+    def __init__(self):
+        self._heap: List = []
+        self._seq = 0
+        self._cv = threading.Condition()
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="swarm-timers")
+        self._thread.start()
+
+    def call_later(self, delay_s: float, fn: Callable[[], None]) -> None:
+        with self._cv:
+            self._seq += 1
+            heapq.heappush(
+                self._heap, (time.monotonic() + max(delay_s, 0.0),
+                             self._seq, fn))
+            self._cv.notify()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                if self._stop:
+                    return
+                if not self._heap:
+                    self._cv.wait(timeout=0.5)
+                    continue
+                when, _seq, fn = self._heap[0]
+                now = time.monotonic()
+                if when > now:
+                    self._cv.wait(timeout=min(when - now, 0.5))
+                    continue
+                heapq.heappop(self._heap)
+            try:
+                fn()
+            except Exception:  # a dead server mid-shutdown: keep ticking
+                logger.debug("swarm timer callback failed", exc_info=True)
+
+
+# ---------------------------------------------------------------------------
+# the simulated device
+# ---------------------------------------------------------------------------
+
+
+class SwarmClientManager(FedMLCommManager):
+    """A lightweight simulated device speaking the full cross-silo client
+    protocol. It does not train: after a seeded think time it echoes the
+    dispatched model back as its update (num_samples=1), which exercises
+    every server-side path (admission, staleness, folding, aggregation)
+    with realistic payload bytes at a per-device cost that scales to
+    thousands."""
+
+    def __init__(self, args, schedule: SwarmSchedule, timers: TimerWheel,
+                 comm=None, rank: int = 0, size: int = 0,
+                 backend: str = constants.COMM_BACKEND_LOOPBACK):
+        super().__init__(args, comm, rank, size, backend)
+        self.schedule = schedule
+        self.timers = timers
+        self.done = threading.Event()
+        # (_version, _arrays) is a PAIR: the receive thread updates it on
+        # dispatch while the timer wheel snapshots it at send time — the
+        # lock keeps a delayed send from tagging version v on version
+        # v+1's payload, which would corrupt the server's staleness
+        # accounting (the orchestrator itself only reads the done Event
+        # and the process-wide telemetry counters)
+        self._state_lock = threading.Lock()
+        self._version = -1
+        self._arrays: List[np.ndarray] = []
+        self._dropped = False
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_CONNECTION_IS_READY, self._on_ready
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self._on_dispatch
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self._on_dispatch
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_SHED_NOTICE, self._on_shed
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_FINISH, self._on_finish
+        )
+
+    def _on_ready(self, msg: Message) -> None:
+        status = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
+        status.add(MyMessage.MSG_ARG_KEY_CLIENT_STATUS,
+                   MyMessage.CLIENT_STATUS_ONLINE)
+        self._send_quiet(status)
+
+    def _on_dispatch(self, msg: Message) -> None:
+        version = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0))
+        with self._state_lock:
+            if version <= self._version:
+                return  # replayed/stale dispatch
+            self._version = version
+            self._arrays = msg.get_arrays()
+        if self._dropped:
+            return  # silent device: receives, never answers
+        if self.schedule.drops_out():
+            self._dropped = True
+            telemetry.counter_inc("swarm.dropouts")
+            return
+        self.timers.call_later(
+            self.schedule.next_think_s(),
+            lambda v=version: self._send_update(v),
+        )
+
+    def _send_update(self, version: int) -> None:
+        if self.done.is_set():
+            return
+        with self._state_lock:
+            if version != self._version:
+                return  # a fresher dispatch superseded this one
+            arrays = self._arrays
+        out = Message(
+            MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
+        out.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, version)
+        out.add(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0)
+        out.set_arrays(arrays)
+        telemetry.counter_inc("swarm.updates_sent")
+        self._send_quiet(out)
+
+    def _on_shed(self, msg: Message) -> None:
+        shed_version = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, -1))
+        with self._state_lock:
+            current = self._version
+        if shed_version != current or self._dropped:
+            return
+        retry_s = max(
+            float(msg.get(MyMessage.MSG_ARG_KEY_RETRY_AFTER_S, 0.1)), 0.01)
+        telemetry.counter_inc("swarm.retries")
+        self.timers.call_later(
+            retry_s, lambda v=shed_version: self._send_update(v))
+
+    def _on_finish(self, msg: Message) -> None:
+        self.done.set()
+        self.finish()
+
+    def _send_quiet(self, msg: Message) -> None:
+        try:
+            self.send_message(msg)
+        except Exception:
+            # the server is gone (soak teardown, chaos kill): a traffic
+            # generator must absorb that, not crash the swarm
+            telemetry.counter_inc("swarm.send_failures")
+
+
+# ---------------------------------------------------------------------------
+# loopback pump: 2000 devices on one thread
+# ---------------------------------------------------------------------------
+
+
+class LoopbackPump:
+    """Drains every device's loopback mailbox on ONE thread and dispatches
+    through the managers' normal ``receive_message`` path (dedup window,
+    payload fetch, handlers) — the event-driven replacement for a
+    receive-loop thread per device."""
+
+    def __init__(self, world: str):
+        from ..core.distributed.loopback import _Broker
+
+        self.broker = _Broker.get(world)
+        self.devices: Dict[int, SwarmClientManager] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="swarm-pump")
+
+    def add(self, device: SwarmClientManager) -> None:
+        # setup-phase only: every add() happens before start(), whose
+        # Thread.start() publishes the finished dict to the pump thread
+        # (the same discipline as FedMLCommManager.register_comm_manager)
+        device.register_message_receive_handlers()
+        self.devices[device.rank] = device  # graftlint: disable=G005
+
+    def start(self) -> None:
+        # synthetic connection-ready per device, exactly like the backend's
+        # own receive loop would emit
+        for rank, dev in self.devices.items():
+            dev.receive_message(
+                MyMessage.MSG_TYPE_CONNECTION_IS_READY,
+                Message(MyMessage.MSG_TYPE_CONNECTION_IS_READY, rank, rank),
+            )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        from ..core.distributed.delivery import safe_deserialize
+
+        while not self._stop.is_set():
+            drained = 0
+            for rank, dev in self.devices.items():
+                q = self.broker.queue_for(rank)
+                for _ in range(32):  # bounded burst per device per sweep
+                    try:
+                        data = q.get_nowait()
+                    except Exception:
+                        break
+                    msg = safe_deserialize(data, "swarm-pump")
+                    if msg is not None:
+                        dev.receive_message(msg.get_type(), msg)
+                    drained += 1
+            if drained == 0:
+                time.sleep(0.002)
+
+
+# ---------------------------------------------------------------------------
+# process spawner (shared with the chaos harness's gRPC legs)
+# ---------------------------------------------------------------------------
+
+
+class ProcSpawner:
+    """Launch + supervise worker OS processes. One definition serves the
+    swarm's multiprocess-gRPC device hosts AND the chaos harness's real
+    multiprocess client legs."""
+
+    def __init__(self, cwd: Optional[str] = None):
+        self.cwd = cwd or os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        self.procs: List[subprocess.Popen] = []
+
+    def spawn(self, cmd: List[str]) -> subprocess.Popen:
+        env = dict(os.environ,
+                   JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+        proc = subprocess.Popen(cmd, cwd=self.cwd, env=env)
+        self.procs.append(proc)
+        return proc
+
+    def wait_all(self, timeout_s: float) -> List[Optional[int]]:
+        deadline = time.monotonic() + timeout_s
+        codes: List[Optional[int]] = []
+        for p in self.procs:
+            left = max(deadline - time.monotonic(), 0.1)
+            try:
+                codes.append(p.wait(timeout=left))
+            except subprocess.TimeoutExpired:
+                codes.append(None)
+        return codes
+
+    def kill_all(self) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in self.procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=5)
+        self.procs.clear()
+
+
+def python_module_cmd(module: str, *args: str) -> List[str]:
+    return [sys.executable, "-m", module, *args]
+
+
+# ---------------------------------------------------------------------------
+# orchestrator
+# ---------------------------------------------------------------------------
+
+
+def _server_overrides(a) -> Dict:
+    return dict(
+        training_type="cross_silo", dataset="synthetic", model="lr",
+        client_num_in_total=int(a.clients),
+        client_num_per_round=int(a.clients),
+        comm_round=int(a.steps), epochs=1, batch_size=8, learning_rate=0.2,
+        random_seed=int(a.seed), role="server", rank=0,
+        run_id=str(a.run_id),
+        aggregation_mode="async",
+        async_buffer_size=int(a.buffer),
+        async_staleness_alpha=float(a.staleness_alpha),
+        async_max_staleness=int(a.max_staleness),
+        async_flush_s=float(a.flush_s),
+        async_admit_rate=float(a.admit_rate),
+        async_admit_burst=int(a.admit_burst),
+        async_queue_limit=int(a.queue_limit),
+        # eval only the final step: the soak measures the traffic plane,
+        # not the model
+        frequency_of_the_test=10**9,
+    )
+
+
+def _device_args(a, rank: int, backend: str):
+    import fedml_tpu as fedml
+    from ..arguments import Arguments
+
+    overrides = dict(
+        training_type="cross_silo", dataset="synthetic", model="lr",
+        client_num_in_total=int(a.clients),
+        client_num_per_round=int(a.clients),
+        comm_round=int(a.steps), role="client", rank=int(rank),
+        run_id=str(a.run_id), backend=backend,
+        random_seed=int(a.seed),
+    )
+    if backend == constants.COMM_BACKEND_GRPC:
+        overrides.update(comm_port=int(a.port), comm_host="127.0.0.1")
+    return fedml.init(Arguments(overrides=overrides), should_init_logs=False)
+
+
+def _percentiles(hist_summary: Optional[dict]) -> Dict:
+    if not hist_summary:
+        return {"count": 0, "p50": None, "p95": None, "p99": None}
+    return {k: hist_summary.get(k) for k in ("count", "p50", "p95", "p99")}
+
+
+def run_swarm(a) -> int:
+    """The ``fedml_tpu swarm`` CLI entry: run the soak, print the JSON
+    report, return a process exit code."""
+    backend = str(a.backend).upper()
+    if backend not in (constants.COMM_BACKEND_LOOPBACK,
+                       constants.COMM_BACKEND_GRPC):
+        print(json.dumps({"ok": False,
+                          "error": f"unsupported swarm backend {backend}"}))
+        return 2
+    report = swarm_soak(a)
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 0 if report["ok"] else 1
+
+
+def swarm_soak(a) -> Dict:
+    """The orchestrator: async server + N-device swarm; returns the soak
+    report (tests call this directly; the CLI prints it)."""
+    import fedml_tpu as fedml
+    from .. import data as data_mod
+    from .. import models as model_mod
+    from ..arguments import Arguments
+    from ..cross_silo import FedMLCrossSiloServer
+
+    backend = str(a.backend).upper()
+    telemetry.registry().reset()
+    t0 = time.monotonic()
+
+    server_over = dict(_server_overrides(a), backend=backend)
+    if backend == constants.COMM_BACKEND_GRPC:
+        server_over.update(comm_port=int(a.port), comm_host="127.0.0.1")
+    args_s = fedml.init(Arguments(overrides=server_over),
+                        should_init_logs=False)
+    ds, od = data_mod.load(args_s)
+    bundle = model_mod.create(args_s, od)
+    server = FedMLCrossSiloServer(args_s, None, ds, bundle)
+
+    timers = TimerWheel()
+    pump: Optional[LoopbackPump] = None
+    spawner: Optional[ProcSpawner] = None
+    devices: List[SwarmClientManager] = []
+    try:
+        if backend == constants.COMM_BACKEND_LOOPBACK:
+            from ..core.distributed.loopback import LoopbackCommManager
+
+            pump = LoopbackPump(str(a.run_id))
+            n = int(a.clients)
+            world_size = n + 1
+            for rank in range(1, n + 1):
+                dev = SwarmClientManager(
+                    _device_args(a, rank, backend),
+                    SwarmSchedule(int(a.seed), rank, float(a.think_s),
+                                  float(a.dropout)),
+                    timers,
+                    comm=LoopbackCommManager(rank, world_size,
+                                             str(a.run_id)),
+                    rank=rank, size=world_size,
+                )
+                devices.append(dev)
+                pump.add(dev)
+        else:
+            spawner = ProcSpawner()
+            procs = max(int(a.procs), 1)
+            base = 1
+            per = (int(a.clients) + procs - 1) // procs
+            for _ in range(procs):
+                count = min(per, int(a.clients) - base + 1)
+                if count <= 0:
+                    break
+                spawner.spawn(python_module_cmd(
+                    "fedml_tpu.cli", "swarm", "--worker",
+                    "--rank_base", str(base), "--count", str(count),
+                    "--clients", str(a.clients), "--steps", str(a.steps),
+                    "--port", str(a.port), "--seed", str(a.seed),
+                    "--think_s", str(a.think_s), "--dropout",
+                    str(a.dropout), "--run_id", str(a.run_id),
+                    "--timeout", str(a.timeout),
+                ))
+                base += count
+
+        server_thread = threading.Thread(target=server.run, daemon=True)
+        if pump is not None:
+            pump.start()
+        server_thread.start()
+        completed = server.manager.done.wait(timeout=float(a.timeout))
+        # let FINISH drain to the devices
+        deadline = time.monotonic() + 10.0
+        for dev in devices:
+            dev.done.wait(timeout=max(deadline - time.monotonic(), 0.05))
+        worker_rcs: List[Optional[int]] = []
+        if spawner is not None:
+            worker_rcs = spawner.wait_all(timeout_s=15.0)
+    finally:
+        if pump is not None:
+            pump.stop()
+        timers.stop()
+        if spawner is not None:
+            spawner.kill_all()
+        server.manager.done.set()  # unblock the worker on a timed-out soak
+        server.manager.finish()
+
+    wall = time.monotonic() - t0
+    snap = telemetry.registry().snapshot()
+    counters = snap["counters"]
+    hists = snap["histograms"]
+    grpc_mode = backend == constants.COMM_BACKEND_GRPC
+    report = {
+        # grpc mode: every device-host process must ALSO have exited 0
+        # (all its devices reached FINISH)
+        "ok": bool(completed) and all(rc == 0 for rc in worker_rcs),
+        "backend": backend,
+        "clients": int(a.clients),
+        "steps_requested": int(a.steps),
+        "steps_completed": int(server.manager.round_idx),
+        "buffer_size": server.manager.async_cfg.buffer_size,
+        "wall_s": round(wall, 3),
+        "accepted_updates": counters.get("traffic.accepted_updates", 0.0),
+        "shed_updates": counters.get("traffic.shed_updates", 0.0),
+        "shed_rate_limited": counters.get("traffic.shed_rate_limited", 0.0),
+        "shed_queue_full": counters.get("traffic.shed_queue_full", 0.0),
+        "stale_dropped_updates": counters.get(
+            "traffic.stale_dropped_updates", 0.0),
+        "server_steps": counters.get("traffic.server_steps", 0.0),
+        # device-side stats live in the device processes under grpc, not
+        # this registry — report None there instead of a misleading 0
+        "swarm_dropouts": (None if grpc_mode
+                           else counters.get("swarm.dropouts", 0.0)),
+        "swarm_updates_sent": (None if grpc_mode else
+                               counters.get("swarm.updates_sent", 0.0)),
+        "swarm_retries": (None if grpc_mode
+                          else counters.get("swarm.retries", 0.0)),
+        "devices_finished": (
+            None if grpc_mode
+            else sum(1 for d in devices if d.done.is_set())),
+        "worker_exit_codes": worker_rcs,
+        # the headline: server-side dispatch→ready (admission → folded)
+        "dispatch_ready_s": _percentiles(
+            hists.get("traffic.dispatch_ready_s")),
+        "staleness": _percentiles(hists.get("traffic.staleness")),
+        "step_s": _percentiles(hists.get("traffic.step_s")),
+        "rss_peak_mb": round(rss_peak_mb(), 1),
+    }
+    return report
+
+
+def run_device_worker(a) -> int:
+    """One swarm device-host process (gRPC mode): ranks
+    [rank_base, rank_base+count) as real gRPC endpoints against the
+    orchestrator's server. Spawned via :class:`ProcSpawner`."""
+    n = int(a.clients)
+    world_size = n + 1
+    devices = []
+    timers = TimerWheel()
+    try:
+        for rank in range(int(a.rank_base),
+                          int(a.rank_base) + int(a.count)):
+            dev = SwarmClientManager(
+                _device_args(a, rank, constants.COMM_BACKEND_GRPC),
+                SwarmSchedule(int(a.seed), rank, float(a.think_s),
+                              float(a.dropout)),
+                timers,
+                rank=rank, size=world_size,
+                backend=constants.COMM_BACKEND_GRPC,
+            )
+            dev.run_async()
+            devices.append(dev)
+        deadline = time.monotonic() + float(a.timeout)
+        for dev in devices:
+            dev.done.wait(timeout=max(deadline - time.monotonic(), 0.1))
+    finally:
+        timers.stop()
+        for dev in devices:
+            dev.finish()
+    return 0 if all(d.done.is_set() for d in devices) else 1

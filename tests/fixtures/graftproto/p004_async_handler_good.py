@@ -1,0 +1,55 @@
+"""Async-traffic-plane handler shape (ISSUE 7): a buffered FedBuff-style
+server handler with a staleness guard (version compare, not a literal
+"round" compare) and a shed NACK through self.send_message. Must be clean
+under P004 (replay safety via the version dataflow) and P006 (no raw
+com_manager send)."""
+
+
+class Defines:
+    MSG_TYPE_C2S_SEND_MODEL = "c2s_send_model"
+    MSG_TYPE_S2C_SHED = "s2c_shed"
+    MSG_TYPE_S2C_SYNC = "s2c_sync"
+
+
+class AsyncServerManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_C2S_SEND_MODEL, self._on_model
+        )
+
+    def _on_model(self, msg):
+        sender = msg.get_sender_id()
+        client_version = int(msg.get("round_idx", 0))
+        staleness = self.model_version - client_version
+        if staleness > self.max_staleness:
+            return  # version guard: too stale to fold
+        if not self.admission.try_admit():
+            nack = Message(Defines.MSG_TYPE_S2C_SHED, 0, sender)
+            self.send_message(nack)
+            return
+        self._buffer[sender] = msg.get_arrays()
+        self.send_message(Message(Defines.MSG_TYPE_S2C_SYNC, 0, sender))
+
+    def _on_done(self):
+        self.finish()
+
+
+class AsyncClientManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_S2C_SHED, self._on_shed
+        )
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_S2C_SYNC, self._on_sync
+        )
+
+    def _on_shed(self, msg):
+        self._retry_pending = True
+
+    def _on_sync(self, msg):
+        version = int(msg.get("round_idx", 0))
+        if version <= self.model_version:
+            return  # replayed dispatch
+        self.model_version = version
+        self.send_message(Message(Defines.MSG_TYPE_C2S_SEND_MODEL, 1, 0))
+        self.finish()

@@ -1,0 +1,39 @@
+"""P004 dataflow good twin: the round guard compares a LOCAL whose value
+flows from the message's round key — no round token in the compare itself.
+The dataflow pass must recognize it; the textual match alone cannot."""
+
+
+class Defines:
+    MSG_TYPE_S2C_SYNC = "s2c_sync"
+    MSG_TYPE_C2S_RESULT = "c2s_result"
+
+
+class ClientManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_S2C_SYNC, self._on_sync
+        )
+
+    def _on_sync(self, msg):
+        # the guard variable carries no round-ish name of its own …
+        r = int(msg.get("round_idx", 0))
+        limit = r - self.window
+        if limit < self.floor:
+            return  # stale replay: identity checked via dataflow
+        self.round_idx = r
+        self._models[msg.get_sender_id()] = msg.get_arrays()
+        self.send_message(Message(Defines.MSG_TYPE_C2S_RESULT, 1, 0))
+        self.finish()
+
+
+class ServerManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Defines.MSG_TYPE_C2S_RESULT, self._on_result
+        )
+
+    def _on_result(self, msg):
+        self.finish()
+
+    def _sync(self):
+        self.send_message(Message(Defines.MSG_TYPE_S2C_SYNC, 0, 1))

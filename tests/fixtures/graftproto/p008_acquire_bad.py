@@ -1,0 +1,24 @@
+"""P008 via bare acquire(): the A->B / B->A inversion where one side
+takes its lock with acquire()/release() instead of `with`."""
+
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+
+
+def forward():
+    LOCK_A.acquire()
+    try:
+        # line 14: B acquired while A held (bare) -> P008
+        with LOCK_B:
+            pass
+    finally:
+        LOCK_A.release()
+
+
+def backward():
+    with LOCK_B:
+        # line 23: A acquired (bare) while B held -> P008
+        LOCK_A.acquire()
+        LOCK_A.release()

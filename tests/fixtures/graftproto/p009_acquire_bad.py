@@ -1,0 +1,24 @@
+"""P009 via bare acquire(): blocking calls inside the lexical
+acquire()/release() window, including the try/finally idiom."""
+
+import os
+import threading
+import time
+
+
+class Committer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fd = 3
+
+    def commit(self):
+        self._lock.acquire()
+        try:
+            os.fsync(self._fd)  # line 17 -> P009 (held via bare acquire)
+        finally:
+            self._lock.release()
+
+    def settle(self):
+        self._lock.acquire()
+        time.sleep(0.5)  # line 23 -> P009
+        self._lock.release()

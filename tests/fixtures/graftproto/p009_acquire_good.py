@@ -1,0 +1,27 @@
+"""Good twin of p009_acquire_bad: release() ends the held window, so the
+blocking calls after it are lock-free — no P008/P009."""
+
+import os
+import threading
+import time
+
+
+class Committer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fd = 3
+        self._count = 0
+
+    def commit(self):
+        self._lock.acquire()
+        try:
+            self._count += 1
+        finally:
+            self._lock.release()
+        os.fsync(self._fd)  # after release: clean
+
+    def settle(self):
+        self._lock.acquire()
+        self._count += 1
+        self._lock.release()
+        time.sleep(0.5)  # after release: clean

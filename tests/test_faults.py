@@ -168,6 +168,58 @@ class TestDeadlineRaces:
             assert {1, 2} <= set(per) <= {1, 2, 3}, (rnd, per)
         assert result is not None and result["test_acc"] > 0.4
 
+    def test_stale_deferred_timeout_aggregation_is_a_noop(self):
+        """ISSUE 7 satellite: _on_round_timeout verifies the round under
+        the lock, RELEASES it, then calls the aggregation. If the round
+        closes in that window (its last model arrived concurrently), the
+        deferred aggregation call arrives one round late — it must be a
+        clean no-op on the next round's early arrivals, never a premature
+        partial aggregation or a double count. Driven by direct method
+        calls, so the interleaving is exact, not probabilistic."""
+        import jax
+        import numpy as np
+
+        from fedml_tpu.cross_silo.message_define import MyMessage
+
+        def model_msg(manager, rank, round_idx):
+            msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+                          rank, 0)
+            msg.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, round_idx)
+            msg.add(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 5.0)
+            msg.set_arrays([np.asarray(l) for l in
+                            jax.tree.leaves(manager.global_params)])
+            return msg
+
+        args = make_args("race-guard", role="server",
+                         client_num_in_total=2, client_num_per_round=2,
+                         round_timeout=30.0)
+        ds, od = data_mod.load(args)
+        bundle = model_mod.create(args, od)
+        m = FedMLCrossSiloServer(args, None, ds, bundle).manager
+        try:
+            # round 0 completes normally
+            m._on_model_received(model_msg(m, 1, 0))
+            m._on_model_received(model_msg(m, 2, 0))
+            assert m.round_idx == 1
+            # ONE early round-1 model is pending when the stale deferred
+            # aggregation call from round 0's timeout thread finally runs
+            m._on_model_received(model_msg(m, 1, 1))
+            assert m.round_idx == 1 and 1 in m._models
+            m._finish_round(0)  # the raced, deferred call
+            # no premature partial aggregation of round 1:
+            assert m.round_idx == 1
+            assert 1 in m._models
+            assert 1 not in m.contrib_counts
+            # and round 1 still completes normally afterwards
+            m._on_model_received(model_msg(m, 2, 1))
+            assert m.round_idx == 2
+            assert sorted(m.contrib_counts[1]) == [1, 2]
+            assert all(v == 1 for per in m.contrib_counts.values()
+                       for v in per.values())
+        finally:
+            if m._round_timer is not None:
+                m._round_timer.cancel()
+
     def test_dropped_client_revival_is_exactly_once(self):
         """Client 3's round-0 model arrives long after the deadline: the
         round closes without it (dropped), the late round-0 model is

@@ -118,9 +118,40 @@ class TestRuleFixtures:
         assert {f.rule for f in fs} == {"P009"}
         assert _rule_lines(fs, "P009") == [17, 18, 22, 23, 31]
 
+    def test_p008_bare_acquire_inversion(self):
+        """Satellite (ISSUE 7): lock-order analysis tracks bare
+        lock.acquire()/release() windows, not only ``with`` blocks — the
+        acquire(); try: ... finally: release() idiom joins the graph."""
+        fs = _findings("p008_acquire_bad.py")
+        assert {f.rule for f in fs} == {"P008"}
+        assert _rule_lines(fs, "P008") == [14, 23]
+        by_line = {f.line: f.message for f in fs}
+        assert "p008_acquire_bad.py:23" in by_line[14]
+        assert "p008_acquire_bad.py:14" in by_line[23]
+
+    def test_p009_bare_acquire_blocking(self):
+        """Blocking calls inside a bare acquire()/release() window fire
+        P009 exactly like a ``with lock:`` block."""
+        fs = _findings("p009_acquire_bad.py")
+        assert {f.rule for f in fs} == {"P009"}
+        assert _rule_lines(fs, "P009") == [17, 23]
+
+    def test_p004_dataflow_round_guard(self):
+        """Satellite (ISSUE 7): a guard comparing a local whose value FLOWS
+        from the message's round key (no round token in the compare text)
+        counts as a round guard — no pragma needed."""
+        assert _findings("p004_dataflow_good.py") == []
+
+    def test_async_handler_shape_is_clean(self):
+        """The ISSUE 7 async traffic-plane handler shape — staleness/version
+        guard + shed NACK via self.send_message — passes P004 and P006."""
+        assert _findings("p004_async_handler_good.py") == []
+
     @pytest.mark.parametrize("name", [
         "p001_good.py", "p003_good.py", "p004_good.py", "p005_good.py",
         "p006_good.py", "p007_good.py", "p008_good.py", "p009_good.py",
+        "p009_acquire_good.py", "p004_dataflow_good.py",
+        "p004_async_handler_good.py",
     ])
     def test_good_twins_are_clean(self, name):
         assert _findings(name) == []

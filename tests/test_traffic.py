@@ -1,0 +1,415 @@
+"""Async traffic plane tests (fedml_tpu/traffic/ — ISSUE 7).
+
+Pins five guarantees:
+
+1. **Sync-parity**: async aggregation with staleness weight 1.0 (alpha=0)
+   and buffer size = cohort size reproduces the synchronous FedAvg
+   trajectory BITWISE — and the sync path itself is deterministic
+   (bitwise-reproducible run to run), which is what "sync stays
+   bitwise-identical" means going forward.
+2. **Admission control**: token-bucket rate limiting and the bounded fold
+   queue shed with explicit retry-after verdicts; shed clients re-offer
+   and the federation still completes.
+3. **Staleness machinery**: exact version-tagged staleness, polynomial
+   decay weighting, max-staleness drops.
+4. **Swarm determinism**: the seeded think-time/dropout processes depend
+   only on (seed, rank) — two swarms with one seed share a schedule.
+5. **Soak behavior** (the tools/swarm_smoke.sh contract, in-process): zero
+   shed at light load; nonzero shed + completion under overload.
+"""
+
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+import fedml_tpu as fedml
+from fedml_tpu import data as data_mod
+from fedml_tpu import models as model_mod
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.core.mlops import telemetry
+from fedml_tpu.cross_silo import FedMLCrossSiloClient, FedMLCrossSiloServer
+from fedml_tpu.traffic.admission import (
+    AdmissionController,
+    TokenBucket,
+    queue_limit_from_args,
+)
+from fedml_tpu.traffic.async_aggregator import (
+    AsyncConfig,
+    AsyncUpdateBuffer,
+    staleness_weight,
+)
+from fedml_tpu.traffic.swarm import SwarmSchedule, swarm_soak
+
+
+def make_args(run_id, **kw):
+    base = dict(
+        training_type="cross_silo", dataset="synthetic", model="lr",
+        client_num_in_total=3, client_num_per_round=3, comm_round=3,
+        epochs=2, batch_size=8, learning_rate=0.2, backend="LOOPBACK",
+        run_id=run_id, frequency_of_the_test=1,
+    )
+    base.update(kw)
+    return fedml.init(Arguments(overrides=base), should_init_logs=False)
+
+
+def run_world(run_id, n_clients=3, **kw):
+    args_s = make_args(run_id, role="server", client_num_in_total=n_clients,
+                       **kw)
+    ds, od = data_mod.load(args_s)
+    bundle = model_mod.create(args_s, od)
+    server = FedMLCrossSiloServer(args_s, None, ds, bundle)
+    clients = []
+    for rank in range(1, n_clients + 1):
+        args_c = make_args(run_id, role="client", rank=rank,
+                           client_num_in_total=n_clients, **kw)
+        clients.append(FedMLCrossSiloClient(args_c, None, ds, bundle))
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    result = server.run()
+    for t in threads:
+        t.join(timeout=60)
+    return result, server, clients
+
+
+def global_leaves(server):
+    import jax
+
+    return [np.asarray(l)
+            for l in jax.tree.leaves(server.manager.global_params)]
+
+
+def swarm_cfg(**kw):
+    base = dict(
+        clients=12, steps=4, buffer=4, staleness_alpha=0.5, max_staleness=0,
+        flush_s=5.0, admit_rate=0.0, admit_burst=0, queue_limit=0,
+        think_s=0.02, dropout=0.0, seed=7, backend="loopback", procs=1,
+        port=0, timeout=90.0, run_id=f"swarm-{kw.pop('run_id', 'test')}",
+    )
+    base.update(kw)
+    return types.SimpleNamespace(**base)
+
+
+# ---------------------------------------------------------------------------
+# units: staleness weighting, token bucket, buffer
+# ---------------------------------------------------------------------------
+
+
+class TestStalenessWeight:
+    def test_alpha_zero_is_exactly_flat(self):
+        for s in (0, 1, 7, 1000):
+            assert staleness_weight(s, 0.0) == 1.0
+
+    def test_polynomial_decay(self):
+        assert staleness_weight(0, 0.5) == 1.0
+        assert staleness_weight(3, 0.5) == pytest.approx(0.5)
+        assert staleness_weight(1, 1.0) == pytest.approx(0.5)
+        # monotone non-increasing in staleness
+        ws = [staleness_weight(s, 0.7) for s in range(10)]
+        assert all(a >= b for a, b in zip(ws, ws[1:]))
+
+    def test_negative_staleness_clamps(self):
+        assert staleness_weight(-3, 1.0) == 1.0
+
+
+class TestTokenBucket:
+    def test_burst_then_rate(self):
+        now = [0.0]
+        b = TokenBucket(rate=2.0, burst=3, clock=lambda: now[0])
+        assert [b.take() for _ in range(3)] == [0.0, 0.0, 0.0]
+        wait = b.take()
+        assert wait == pytest.approx(0.5)  # 1 token at 2/s
+        now[0] += 0.5
+        assert b.take() == 0.0
+        # refill caps at burst
+        now[0] += 100.0
+        assert [b.take() for _ in range(3)] == [0.0, 0.0, 0.0]
+        assert b.take() > 0
+
+    def test_rate_zero_never_sheds(self):
+        b = TokenBucket(rate=0.0, burst=1)
+        assert all(b.take() == 0.0 for _ in range(1000))
+
+
+class TestAdmissionController:
+    def test_rate_shed_carries_retry_after(self):
+        now = [0.0]
+        c = AdmissionController(rate=1.0, burst=1, clock=lambda: now[0])
+        assert c.offer().admitted
+        v = c.offer()
+        assert not v.admitted and v.reason == "rate"
+        assert v.retry_after_s == pytest.approx(1.0)
+
+    def test_queue_full_shed(self):
+        c = AdmissionController(rate=0.0, burst=1)
+        v = c.offer(queue_put=lambda: False)
+        assert not v.admitted and v.reason == "queue_full"
+        assert v.retry_after_s > 0
+        assert c.offer(queue_put=lambda: True).admitted
+
+    def test_queue_full_refunds_the_token(self):
+        """A queue-full shed must not ALSO drain the rate budget — the
+        client's retry would be double-penalized (rate-shed right after a
+        queue_full-shed for one overload event)."""
+        now = [0.0]
+        c = AdmissionController(rate=1.0, burst=1, clock=lambda: now[0])
+        v = c.offer(queue_put=lambda: False)
+        assert not v.admitted and v.reason == "queue_full"
+        # the refunded token is immediately available once the queue drains
+        assert c.offer(queue_put=lambda: True).admitted
+
+    def test_queue_limit_resolution(self):
+        a = types.SimpleNamespace(async_queue_limit=0)
+        assert queue_limit_from_args(a, 10) == 40
+        a = types.SimpleNamespace(async_queue_limit=3)
+        assert queue_limit_from_args(a, 10) == 10  # never below one step
+
+
+class TestAsyncBuffer:
+    def cfg(self, **kw):
+        base = dict(buffer_size=3, staleness_alpha=1.0, max_staleness=2,
+                    flush_s=0.0)
+        base.update(kw)
+        return AsyncConfig(**base)
+
+    def test_fold_ready_drain_sorted(self):
+        buf = AsyncUpdateBuffer(self.cfg())
+        p = {"w": np.ones(2)}
+        assert buf.fold(3, 4.0, p, client_version=5, server_version=6) \
+            == "buffered"
+        assert buf.fold(1, 2.0, p, client_version=6, server_version=6) \
+            == "buffered"
+        assert not buf.ready()
+        assert buf.fold(2, 1.0, p, client_version=4, server_version=6) \
+            == "buffered"
+        assert buf.ready()
+        entries = buf.drain()
+        assert [e.sender for e in entries] == [1, 2, 3]
+        assert [e.staleness for e in entries] == [0, 2, 1]
+        # weight = n * (1+s)^-alpha
+        assert entries[0].weight == pytest.approx(2.0)
+        assert entries[1].weight == pytest.approx(1.0 / 3.0)
+        assert entries[2].weight == pytest.approx(2.0)
+        assert buf.occupancy() == 0 and not buf.ready()
+
+    def test_max_staleness_drops(self):
+        buf = AsyncUpdateBuffer(self.cfg(max_staleness=2))
+        p = {"w": np.ones(2)}
+        assert buf.fold(1, 1.0, p, client_version=0, server_version=3) \
+            == "stale"
+        assert buf.occupancy() == 0
+        # max_staleness=0 disables the drop
+        buf2 = AsyncUpdateBuffer(self.cfg(max_staleness=0))
+        assert buf2.fold(1, 1.0, p, client_version=0, server_version=99) \
+            == "buffered"
+
+
+# ---------------------------------------------------------------------------
+# the parity pins
+# ---------------------------------------------------------------------------
+
+
+class TestAsyncSyncParity:
+    def test_sync_mode_is_bitwise_deterministic(self):
+        """The --aggregation_mode sync default must keep producing the same
+        trajectory run over run — the executable form of "sync stays
+        bitwise-identical to the pre-traffic-plane server"."""
+        _, s1, _ = run_world("par-det-a")
+        _, s2, _ = run_world("par-det-b")
+        for i, (a, b) in enumerate(zip(global_leaves(s1),
+                                       global_leaves(s2))):
+            assert a.dtype == b.dtype and np.array_equal(a, b), f"leaf {i}"
+
+    def test_async_k_equals_cohort_reproduces_sync_bitwise(self):
+        """ISSUE 7 acceptance: staleness weight 1.0 (alpha=0) + buffer size
+        = cohort size → the async trajectory IS the sync FedAvg
+        trajectory, bitwise, including eval metrics."""
+        r_sync, s_sync, _ = run_world("par-sync")
+        r_async, s_async, _ = run_world(
+            "par-async", aggregation_mode="async", async_buffer_size=3,
+            async_staleness_alpha=0.0,
+        )
+        assert s_async.manager.round_idx == s_sync.manager.round_idx == 3
+        for i, (a, b) in enumerate(zip(global_leaves(s_sync),
+                                       global_leaves(s_async))):
+            assert a.dtype == b.dtype and np.array_equal(a, b), \
+                f"leaf {i} diverged async vs sync"
+        assert r_async["test_acc"] == r_sync["test_acc"]
+
+    def test_async_with_defense_matches_sync(self):
+        """The hook chain (attack→defend→DP) rides the SAME aggregation
+        core in both modes."""
+        r_sync, s_sync, _ = run_world(
+            "par-def-sync", enable_defense=True,
+            defense_type="geometric_median",
+        )
+        r_async, s_async, _ = run_world(
+            "par-def-async", enable_defense=True,
+            defense_type="geometric_median", aggregation_mode="async",
+            async_buffer_size=3, async_staleness_alpha=0.0,
+        )
+        for a, b in zip(global_leaves(s_sync), global_leaves(s_async)):
+            assert np.array_equal(a, b)
+
+    def test_async_rejects_compression(self):
+        with pytest.raises(ValueError, match="compression"):
+            run_world("par-comp", aggregation_mode="async",
+                      compression="eftopk", compression_ratio=0.1)
+
+
+class TestAsyncShedAndRetry:
+    def test_shed_clients_reoffer_and_federation_completes(self):
+        """A starved token bucket sheds real ClientMasterManager uploads;
+        the S2C_SHED_NOTICE → backoff → freshly-stamped re-offer path must
+        still finish every round with every client contributing."""
+        reg = telemetry.registry()
+        shed0 = reg.counter("traffic.shed_updates")
+        retry0 = reg.counter("traffic.client_retries")
+        result, server, clients = run_world(
+            "shed-retry", aggregation_mode="async", async_buffer_size=3,
+            async_staleness_alpha=0.0, async_admit_rate=2.0,
+            async_admit_burst=1, comm_round=2,
+        )
+        assert server.manager.round_idx == 2
+        assert result is not None
+        assert reg.counter("traffic.shed_updates") > shed0
+        assert reg.counter("traffic.client_retries") > retry0
+        for c in clients:
+            assert c.manager.done.wait(timeout=30)
+
+    def test_async_partial_buffer_flush_unwedges(self):
+        """Buffer size larger than the world (K=5 > 3 clients with one
+        answer each per version) must flush via async_flush_s instead of
+        wedging the federation."""
+        result, server, _ = run_world(
+            "flush", aggregation_mode="async", async_buffer_size=5,
+            async_flush_s=0.3, comm_round=2,
+        )
+        assert server.manager.round_idx == 2
+        assert result is not None
+
+
+class TestAsyncLedger:
+    def test_async_steps_are_ledgered_and_identity_pinned(self, tmp_path):
+        """Async server steps commit to the PR 4 run ledger with their
+        staleness vector; the buffer config is run identity — reopening
+        the ledger under a different aggregation mode is refused."""
+        from fedml_tpu.core.runstate import RunLedger
+
+        ckpt = str(tmp_path / "ckpt")
+        result, server, _ = run_world(
+            "async-ledger", aggregation_mode="async", async_buffer_size=3,
+            async_staleness_alpha=0.0, checkpoint_dir=ckpt,
+            checkpoint_rounds=1,
+        )
+        assert server.manager.round_idx == 3
+        ledger = RunLedger.for_checkpoint_dir(ckpt)
+        rounds = ledger.rounds()
+        assert [e["round"] for e in rounds] == [0, 1, 2]
+        for e in rounds:
+            assert e["mode"] == "async"
+            assert e["staleness"] == [0, 0, 0]  # K=N lockstep
+            assert sorted(e["cohort"]) == [1, 2, 3]
+        meta = ledger.meta()
+        assert meta["world"]["aggregation_mode"] == "async"
+        assert meta["world"]["buffer_size"] == 3
+        # resuming under sync (different world identity) must refuse
+        args_s = make_args("async-ledger-2", role="server",
+                           checkpoint_dir=ckpt)
+        ds, od = data_mod.load(args_s)
+        bundle = model_mod.create(args_s, od)
+        with pytest.raises(RuntimeError, match="different federation"):
+            FedMLCrossSiloServer(args_s, None, ds, bundle)
+
+
+# ---------------------------------------------------------------------------
+# swarm harness
+# ---------------------------------------------------------------------------
+
+
+class TestSwarmDeterminism:
+    def test_schedule_depends_only_on_seed_and_rank(self):
+        a = SwarmSchedule(7, 3, think_s=0.5, dropout_p=0.1)
+        b = SwarmSchedule(7, 3, think_s=0.5, dropout_p=0.1)
+        assert [a.next_think_s() for _ in range(50)] \
+            == [b.next_think_s() for _ in range(50)]
+        assert [a.drops_out() for _ in range(50)] \
+            == [b.drops_out() for _ in range(50)]
+
+    def test_ranks_are_decorrelated(self):
+        a = SwarmSchedule(7, 1, think_s=0.5, dropout_p=0.0)
+        b = SwarmSchedule(7, 2, think_s=0.5, dropout_p=0.0)
+        assert [a.next_think_s() for _ in range(10)] \
+            != [b.next_think_s() for _ in range(10)]
+
+
+class TestSwarmSoak:
+    """The tools/swarm_smoke.sh contract, in-process and fast."""
+
+    def test_light_load_zero_shed(self):
+        report = swarm_soak(swarm_cfg(run_id="light"))
+        assert report["ok"], report
+        assert report["steps_completed"] == 4
+        assert report["shed_updates"] == 0
+        assert report["accepted_updates"] >= 4 * 4  # steps x buffer
+        assert report["devices_finished"] == 12
+        assert report["dispatch_ready_s"]["count"] > 0
+        assert report["dispatch_ready_s"]["p99"] is not None
+
+    def test_overload_sheds_and_still_completes(self):
+        from fedml_tpu.traffic.swarm import rss_peak_mb
+
+        # ru_maxrss is PROCESS-lifetime peak: inside the shared pytest
+        # process earlier jax suites dominate it, so bound the soak's
+        # GROWTH, not an absolute cap (the absolute cap lives in
+        # tools/swarm_smoke.sh, which runs in a dedicated process)
+        rss_before = rss_peak_mb()
+        report = swarm_soak(swarm_cfg(
+            run_id="overload", clients=20, admit_rate=10.0, admit_burst=2,
+            think_s=0.01,
+        ))
+        assert report["ok"], report
+        assert report["shed_updates"] > 0
+        assert report["steps_completed"] == 4
+        assert report["rss_peak_mb"] - rss_before < 2048
+
+    def test_dropout_soak_flushes_partial_buffers(self):
+        report = swarm_soak(swarm_cfg(
+            run_id="dropout", clients=10, buffer=5, dropout=0.25,
+            flush_s=0.3, steps=3,
+        ))
+        assert report["ok"], report
+        assert report["steps_completed"] == 3
+
+    def test_staleness_histogram_populates_with_small_buffer(self):
+        report = swarm_soak(swarm_cfg(
+            run_id="stale", clients=12, buffer=3, think_s=0.05,
+            staleness_alpha=0.5,
+        ))
+        assert report["ok"], report
+        assert report["staleness"]["count"] > 0
+
+
+class TestArgumentsSurface:
+    def test_mode_validation(self):
+        with pytest.raises(ValueError, match="aggregation_mode"):
+            Arguments(overrides=dict(aggregation_mode="bonkers"))
+
+    def test_async_knobs_schema(self):
+        a = Arguments(overrides=dict(
+            aggregation_mode="async", async_buffer_size="7",
+            async_staleness_alpha="0.25", async_admit_rate="100",
+        ))
+        assert a.async_buffer_size == 7
+        assert a.async_staleness_alpha == 0.25
+        assert a.async_admit_rate == 100.0
+
+    def test_swarm_cli_registered(self):
+        from fedml_tpu.cli import main as cli_main
+
+        with pytest.raises(SystemExit):
+            cli_main(["swarm", "--no-such-flag"])

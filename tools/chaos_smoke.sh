@@ -50,4 +50,50 @@ if [ "$rc" -ne 0 ]; then
     echo "chaos_smoke: FAIL — verdict did not validate" >&2
     exit 1
 fi
+
+# ---- multiprocess gRPC leg (ISSUE 7 satellite) -----------------------------
+# the SAME fault matrix + kill/resume, but the clients are real OS processes
+# over gRPC (spawned via the swarm harness's ProcSpawner); parity is checked
+# against the fault-free LOOPBACK reference, so bitwise equality must hold
+# ACROSS transports
+workdir2=$(mktemp -d /tmp/fedml_chaos_smoke_grpc.XXXXXX)
+trap 'rm -rf "$workdir" "$workdir2"' EXIT
+
+# rounds 6 x epochs 2 keeps the federation alive long enough past the
+# round-1 ledger commit for the self-SIGTERM to land (a faster world can
+# outrun the watcher; the verdict stays valid either way and reports
+# preemption_exercised)
+out=$(timeout -k 10 420 env JAX_PLATFORMS=cpu python -m fedml_tpu.cli chaos \
+    --clients 2 --rounds 6 --epochs 2 --seed 7 \
+    --loss 0.05 --duplicate 0.1 --corrupt 0.1 \
+    --kill-round 1 --transport grpc --timeout 300 \
+    --workdir "$workdir2" 2>/dev/null)
+rc=$?
+
+if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    echo "chaos_smoke: FAIL — gRPC leg hit the hard timeout (rc=$rc)" >&2
+    exit 1
+fi
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL — gRPC chaos leg exited rc=$rc" >&2
+    printf '%s\n' "$out" >&2
+    exit 1
+fi
+
+python - "$out" <<'EOF'
+import json
+import sys
+
+verdict = json.loads(sys.argv[1])
+assert verdict["ok"], verdict["problems"]
+assert verdict["parity"], verdict["problems"]
+print("chaos_smoke: gRPC multiprocess OK —",
+      f"{verdict['rounds']} rounds x {verdict['clients']} client procs,",
+      f"preemption_exercised={verdict['preemption_exercised']}")
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL — gRPC verdict did not validate" >&2
+    exit 1
+fi
 echo "chaos_smoke: PASS"

@@ -18,7 +18,17 @@ Extends graftlint's G005 thread analysis from *data* races to *lock* races:
 Resolution is deliberately conservative: intra-class ``self.m()`` calls,
 module-level functions, module-qualified ``alias.fn()`` calls, and a
 class-hierarchy match on distinctive method names (graftlint's CHA with its
-stoplist). ``lock.acquire()`` without ``with`` is out of scope.
+stoplist).
+
+Bare ``lock.acquire()`` / ``lock.release()`` pairs are tracked too, in
+document order within one function: the lock counts as held from the
+``acquire()`` statement to the matching ``release()`` (or the end of the
+function — an acquire that escapes is treated as still held, which is what
+makes it visible to callers through ``own_locks``). This catches the
+``acquire(); try: ... finally: release()`` idiom the ``with``-only model
+was blind to. Only the zero-argument form counts: a conditional
+``acquire(blocking=False)`` / ``acquire(timeout=...)`` may fail to take
+the lock, so treating it as held would fabricate edges.
 """
 
 from __future__ import annotations
@@ -182,6 +192,12 @@ def _blocking_desc(call: ast.Call) -> Optional[str]:
 def _scan_function(f: _FnFacts, modules: Dict[str, ModuleInfo],
                    all_methods: Dict[str, List[FuncInfo]]) -> None:
     mod, fi = f.mod, f.fi
+    # bare lock.acquire() acquisitions currently open, in document order;
+    # the matching release() pops them. Statements are walked in source
+    # order, so the window [acquire() .. release()] is lexical — the
+    # ``acquire(); try: ... finally: release()`` idiom resolves correctly
+    # (finalbody follows the try body in document order).
+    acquired: List[LockId] = []
 
     def walk(node: ast.AST, held: Tuple[LockId, ...]) -> None:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
@@ -195,20 +211,42 @@ def _scan_function(f: _FnFacts, modules: Dict[str, ModuleInfo],
                 if lock is None:
                     continue
                 f.own_locks.add(lock)
-                for h in new_held:
+                for h in new_held + tuple(acquired):
                     f.direct_edges.append((h, lock, node.lineno))
                 new_held = new_held + (lock,)
             for stmt in node.body:
                 walk(stmt, new_held)
             return
         if isinstance(node, ast.Call):
-            if held:
+            if (isinstance(node.func, ast.Attribute) and not node.args
+                    and not node.keywords):
+                # plain lock.acquire()/release() only: a conditional
+                # acquire(blocking=False)/acquire(timeout=...) may FAIL to
+                # take the lock, so treating it as held would fabricate
+                # edges — out of scope, like the docstring says
+                lock = _lock_id(node.func.value, mod, fi)
+                if lock is not None and node.func.attr == "acquire":
+                    f.own_locks.add(lock)
+                    for h in held + tuple(acquired):
+                        f.direct_edges.append((h, lock, node.lineno))
+                    acquired.append(lock)
+                    walk_children(node, held)
+                    return
+                if lock is not None and node.func.attr == "release":
+                    for i in range(len(acquired) - 1, -1, -1):
+                        if acquired[i] == lock:
+                            del acquired[i]
+                            break
+                    walk_children(node, held)
+                    return
+            held_now = held + tuple(acquired)
+            if held_now:
                 desc = _blocking_desc(node)
                 if desc is not None:
-                    f.direct_blocks.append((desc, node.lineno, held[-1]))
+                    f.direct_blocks.append((desc, node.lineno, held_now[-1]))
             for callee in _resolve_callees(node, mod, fi, modules,
                                            all_methods):
-                f.calls.append((id(callee.node), node.lineno, held))
+                f.calls.append((id(callee.node), node.lineno, held_now))
         walk_children(node, held)
 
     def walk_children(node: ast.AST, held: Tuple[LockId, ...]) -> None:

@@ -369,6 +369,8 @@ def _collect_module_facts(mod: ModuleInfo, model: ProtoModel) -> None:
                 if vds and vds.endswith("register_message_receive_handler"):
                     reg_aliases.add(node.targets[0].id)
 
+        mf.has_round_compare = (mf.has_round_compare
+                                or _has_round_guard(fi.node))
         for node in _own_nodes(fi.node):
             if isinstance(node, ast.Call):
                 _collect_call(node, mod, cls, method, fi, mf, cf, model,
@@ -391,13 +393,63 @@ def _collect_module_facts(mod: ModuleInfo, model: ProtoModel) -> None:
                             and base.value.id == "self"
                             and base.attr == "round_idx"):
                         mf.round_writes.append(t.lineno)
-            elif isinstance(node, ast.Compare):
+
+
+# tokens that mark an expression as carrying round/version identity — the
+# staleness-era protocol tags models with versions, not just round indices
+_ROUND_TOKENS = ("round", "rnd", "version", "staleness")
+
+
+def _has_round_guard(fn_node: ast.AST) -> bool:
+    """True when the function compares round/version identity somewhere.
+
+    Two recognizers:
+
+    1. *textual* — any ``ast.Compare`` whose source mentions a round token
+       (``if round_idx < self.round_idx``), the original P004 heuristic;
+    2. *dataflow* — a compare over a local name assigned (possibly through
+       other locals) from a round-ish expression, e.g.
+       ``r = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)); if r < cur:``
+       — guard variants the textual match is blind to, which previously
+       forced pragmas on perfectly replay-safe handlers.
+    """
+    compares: List[ast.Compare] = []
+    assigns: List[Tuple[List[str], ast.expr, str]] = []
+    for node in _own_nodes(fn_node):
+        if isinstance(node, ast.Compare):
+            compares.append(node)
+        elif isinstance(node, ast.Assign):
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if names:
                 try:
-                    text = ast.unparse(node).lower()
+                    rhs = ast.unparse(node.value).lower()
                 except Exception:  # pragma: no cover — unparse is total
-                    text = ""
-                if "round" in text or "rnd" in text:
-                    mf.has_round_compare = True
+                    rhs = ""
+                assigns.append((names, node.value, rhs))
+    tainted: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for names, value, rhs in assigns:
+            if all(n in tainted for n in names):
+                continue
+            src_names = {x.id for x in ast.walk(value)
+                         if isinstance(x, ast.Name)}
+            if any(tok in rhs for tok in _ROUND_TOKENS) or (
+                    src_names & tainted):
+                tainted.update(names)
+                changed = True
+    for cmp_node in compares:
+        try:
+            text = ast.unparse(cmp_node).lower()
+        except Exception:  # pragma: no cover — unparse is total
+            text = ""
+        if any(tok in text for tok in _ROUND_TOKENS):
+            return True
+        if any(isinstance(x, ast.Name) and x.id in tainted
+               for x in ast.walk(cmp_node)):
+            return True
+    return False
 
 
 def _collect_call(node: ast.Call, mod: ModuleInfo, cls: Optional[str],

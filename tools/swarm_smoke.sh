@@ -1,0 +1,80 @@
+#!/usr/bin/env bash
+# CI smoke for the async traffic plane (fedml_tpu/traffic/, docs/traffic.md):
+# two short client-swarm soaks against the FedBuff-style async server.
+#
+#  leg 1 (light load):  admission wide open — the soak must complete every
+#     server step with ZERO shed updates and report a p99 dispatch→ready
+#     latency from the telemetry histogram.
+#  leg 2 (overload):    a starved token bucket — the soak must SHED
+#     (nonzero traffic.shed_updates), still complete every step through
+#     the clients' NACK-retry-after re-offers, and hold peak RSS bounded
+#     (overload degrades to load-shedding, not memory growth).
+#
+# This is the executable form of the traffic-plane contract;
+# tests/test_traffic.py is the fine-grained half.
+#
+# Usage: tools/swarm_smoke.sh          (CI: exits non-zero on any regression)
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+run_leg() {
+    timeout -k 10 240 env JAX_PLATFORMS=cpu \
+        python -m fedml_tpu.cli swarm "$@" 2>/dev/null
+}
+
+light=$(run_leg --clients 40 --steps 5 --buffer 8 --think_s 0.02 \
+    --seed 7 --timeout 180 --run_id swarm-smoke-light)
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "swarm_smoke: FAIL — light-load leg exited rc=$rc" >&2
+    printf '%s\n' "$light" >&2
+    exit 1
+fi
+
+python - "$light" <<'EOF'
+import json
+import sys
+
+r = json.loads(sys.argv[1])
+assert r["ok"], r
+assert r["steps_completed"] == r["steps_requested"], r
+assert r["shed_updates"] == 0, f"light load shed: {r['shed_updates']}"
+assert r["devices_finished"] == r["clients"], r
+assert r["dispatch_ready_s"]["count"] > 0, r
+assert r["dispatch_ready_s"]["p99"] is not None, r
+print("swarm_smoke: light OK —",
+      f"{r['clients']} devices, {r['steps_completed']} steps,",
+      f"p99 dispatch→ready {1e3 * r['dispatch_ready_s']['p99']:.1f}ms,",
+      f"0 shed, rss {r['rss_peak_mb']:.0f} MB")
+EOF
+[ $? -ne 0 ] && { echo "swarm_smoke: FAIL — light verdict" >&2; exit 1; }
+
+over=$(run_leg --clients 40 --steps 5 --buffer 8 --think_s 0.01 \
+    --admit_rate 15 --admit_burst 4 --seed 7 --timeout 180 \
+    --run_id swarm-smoke-over)
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "swarm_smoke: FAIL — overload leg exited rc=$rc" >&2
+    printf '%s\n' "$over" >&2
+    exit 1
+fi
+
+python - "$over" <<'EOF'
+import json
+import sys
+
+r = json.loads(sys.argv[1])
+assert r["ok"], r
+assert r["steps_completed"] == r["steps_requested"], r
+assert r["shed_updates"] > 0, "overload leg shed nothing"
+# bounded memory: a 40-device lr soak fits comfortably under this cap —
+# unbounded queue growth (the failure mode admission control exists to
+# prevent) blows straight past it
+assert r["rss_peak_mb"] < 4096, f"rss {r['rss_peak_mb']} MB"
+print("swarm_smoke: overload OK —",
+      f"{r['shed_updates']:.0f} shed / {r['accepted_updates']:.0f} accepted,",
+      f"{r['steps_completed']} steps, rss {r['rss_peak_mb']:.0f} MB")
+EOF
+[ $? -ne 0 ] && { echo "swarm_smoke: FAIL — overload verdict" >&2; exit 1; }
+
+echo "swarm_smoke: PASS"
