@@ -28,7 +28,14 @@ class CheckpointManager:
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True
+                max_to_keep=max_to_keep, create=True,
+                # save() below blocks on wait_until_finished() anyway (the
+                # donated round state forces it), so async buys nothing —
+                # and orbax's background serialize thread intermittently
+                # segfaults against concurrent jax tracing on CPU hosts
+                # (observed: deepcopy in type_handlers.serialize vs
+                # pjit_staging_rule, killing the tier-1 run mid-suite)
+                enable_async_checkpointing=False,
             ),
         )
 

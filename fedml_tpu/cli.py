@@ -176,13 +176,50 @@ def cmd_top(args) -> int:
     summary = next((e for e in reversed(events)
                     if e.get("kind") == "telemetry_summary"), None)
     if summary:
-        counters = (summary.get("metrics") or {}).get("counters", {})
+        metrics = summary.get("metrics") or {}
+        counters = metrics.get("counters", {})
         hits = counters.get("jax.compilation_cache.hits", 0)
         misses = counters.get("jax.compilation_cache.misses", 0)
         if hits or misses:
             print(f"\ncompilation cache: {hits:.0f} hits / "
                   f"{misses:.0f} misses")
+        _print_traffic_summary(metrics)
     return 0
+
+
+def _print_traffic_summary(metrics: dict) -> None:
+    """The async plane's backpressure story (traffic.* family, PR 7) next
+    to the phase table: accepted vs shed, staleness actually folded, and
+    how close the dispatch buffer ran to its limit."""
+    counters = metrics.get("counters", {})
+    gauges = metrics.get("gauges", {})
+    hists = metrics.get("histograms", {})
+    accepted = counters.get("traffic.accepted_updates", 0)
+    shed_rate = counters.get("traffic.shed_rate_limited", 0)
+    shed_queue = counters.get("traffic.shed_queue_full", 0)
+    stale = counters.get("traffic.stale_dropped_updates", 0)
+    steps = counters.get("traffic.server_steps", 0)
+    if not (accepted or shed_rate or shed_queue or stale or steps):
+        return  # sync run: the async plane never engaged
+    print("\ntraffic plane (async aggregation):")
+    print(f"  accepted: {accepted:.0f}   shed: "
+          f"{shed_rate + shed_queue:.0f} "
+          f"(rate-limited {shed_rate:.0f}, queue-full {shed_queue:.0f})   "
+          f"stale-dropped: {stale:.0f}")
+    line = f"  server steps: {steps:.0f}"
+    occupancy = gauges.get("traffic.buffer_occupancy")
+    if occupancy is not None:
+        line += f"   buffer occupancy: {occupancy:.0f}"
+    print(line)
+    for name, label in (("traffic.staleness", "staleness"),
+                        ("traffic.dispatch_ready_s", "dispatch→ready")):
+        h = hists.get(name)
+        if not h or not h.get("count"):
+            continue
+        unit = "" if name == "traffic.staleness" else "s"
+        print(f"  {label}: p50 {h['p50']:.3f}{unit}   "
+              f"p95 {h['p95']:.3f}{unit}   p99 {h['p99']:.3f}{unit} "
+              f"(n={h['count']:.0f})")
 
 
 def cmd_build(args) -> int:
@@ -331,14 +368,23 @@ def cmd_lint(args) -> int:
     (G003), purity (G004) and thread-safety (G005). ``--proto``: graftproto
     (tools/graftproto) — message-flow graph (P001–P003), FSM replay/
     termination (P004/P005), delivery invariants (P006/P007) and lock-order
-    analysis (P008/P009). Shells into the same entry points CI uses,
+    analysis (P008/P009). ``--shard``: graftshard (tools/graftshard) —
+    partition-rule coverage (S001), spec validity (S002), implicit-reshard
+    (S003), host-transfer (S004) and static HBM budgets (S005, via
+    ``--model``/``--mesh``). Shells into the same entry points CI uses,
     anchored at the repo root so results are identical from any cwd.
 
-    Exit codes (both suites): 0 clean, 1 findings, 2 the analyzer itself
+    Exit codes (all suites): 0 clean, 1 findings, 2 the analyzer itself
     crashed (or usage error) — CI failures are diagnosable at a glance."""
     import subprocess
 
-    suite = "graftproto" if getattr(args, "proto", False) else "graftlint"
+    if getattr(args, "proto", False) and getattr(args, "shard", False):
+        print("fedml_tpu lint: --proto and --shard are different suites — "
+              "pick one (or run both like tools/lint_smoke.sh does)")
+        return 2
+    suite = ("graftproto" if getattr(args, "proto", False)
+             else "graftshard" if getattr(args, "shard", False)
+             else "graftlint")
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if not os.path.isdir(os.path.join(repo_root, "tools", suite)):
         print(f"fedml_tpu lint: tools/{suite} not found next to the "
@@ -352,10 +398,30 @@ def cmd_lint(args) -> int:
         cmd += ["--format", args.format]
     if args.runtime:
         if suite == "graftproto":
-            print("fedml_tpu lint: --runtime is a graftlint pass (jaxpr "
-                  "purity); it does not combine with --proto")
+            print("fedml_tpu lint: --runtime is a graftlint/graftshard "
+                  "pass; it does not combine with --proto")
             return 2
         cmd.append("--runtime")
+    if getattr(args, "model", ""):
+        if suite != "graftshard":
+            print("fedml_tpu lint: --model is the graftshard HBM "
+                  "estimator — add --shard")
+            return 2
+        cmd += ["--model", args.model]
+        if getattr(args, "mesh", ""):
+            cmd += ["--mesh", args.mesh]
+    elif getattr(args, "mesh", ""):
+        print("fedml_tpu lint: --mesh needs --shard --model")
+        return 2
+    for flag, value in (("--check-rules", getattr(args, "check_rules", "")),
+                        ("--check-state-rules",
+                         getattr(args, "check_state_rules", ""))):
+        if value:
+            if suite != "graftshard":
+                print(f"fedml_tpu lint: {flag} is a graftshard rule-set "
+                      "check — add --shard")
+                return 2
+            cmd += [flag, value]
     return subprocess.call(cmd, cwd=repo_root)
 
 
@@ -487,7 +553,8 @@ def main(argv=None) -> int:
     p_lint = sub.add_parser(
         "lint",
         help="run static analysis over the tree (graftlint; --proto for "
-        "the comm-plane protocol suite)",
+        "the comm-plane protocol suite, --shard for the TPU execution "
+        "plane's sharding/HBM suite)",
     )
     p_lint.add_argument("paths", nargs="*", default=[],
                         help="files/dirs to lint (default: fedml_tpu)")
@@ -496,8 +563,29 @@ def main(argv=None) -> int:
                         help="run graftproto (message-flow graph, FSM "
                         "replay/termination, delivery invariants, lock "
                         "order) instead of graftlint")
+    p_lint.add_argument("--shard", action="store_true",
+                        help="run graftshard (partition-rule coverage, "
+                        "spec validity, implicit-reshard/host-transfer "
+                        "detection, static HBM budgets) instead of "
+                        "graftlint")
     p_lint.add_argument("--runtime", action="store_true",
-                        help="also trace the round engine under jax.make_jaxpr")
+                        help="also run the suite's runtime pass: graftlint "
+                        "traces the round engine under jax.make_jaxpr, "
+                        "graftshard diffs declared vs inferred shardings "
+                        "over a forced multi-device CPU mesh")
+    p_lint.add_argument("--model", default="",
+                        help="(--shard) run the S005 HBM-budget estimator "
+                        "for this model registry entry (e.g. 7b)")
+    p_lint.add_argument("--mesh", default="",
+                        help="(--shard) mesh rows for --model, e.g. "
+                        "'4x4' or 'v5e:2x4,v5p:2x2x2'")
+    p_lint.add_argument("--check-rules", default="", dest="check_rules",
+                        help="(--shard) validate a --mesh_partition_rules "
+                        "string (S001 catch-all + S002 axis validity)")
+    p_lint.add_argument("--check-state-rules", default="",
+                        dest="check_state_rules",
+                        help="(--shard) validate a --mesh_state_rules "
+                        "string the same way")
 
     p_chaos = sub.add_parser(
         "chaos",

@@ -183,8 +183,11 @@ class MeshFedAvgAPI(FedAvgAPI):
 
     def _place(self, arr):
         # per-client auxiliaries (per-round rngs, the padding weight mask)
-        # ride the cohort rules under "cohort/aux" — leading axis = clients
-        named = {"cohort/aux": jax.device_get(arr)}
+        # ride the cohort rules under "cohort/aux" — leading axis = clients.
+        # device_put reshards device-to-device: staging through the host
+        # (device_get) here was a per-round gather of the whole aux array
+        # over ICI (graftshard S004)
+        named = {"cohort/aux": arr}
         sh = self._cohort_shardings(named)
         return jax.device_put(named["cohort/aux"], sh["cohort/aux"])
 

@@ -1,0 +1,55 @@
+"""Flow-DSL known-good: handlers registered ONLY through add_flow.
+
+The PR 5 blind spot: sends of the flow dispatch type were visible
+(Message(MSG_TYPE_FLOW, ...)) but add_flow callback registrations were
+not, so a flow-driven manager looked like it dispatched 'flow_step' into
+the void (false P001) and its callbacks escaped P004/P005 entirely. This
+fixture must be CLEAN."""
+
+
+class MyMessage:
+    MSG_TYPE_FLOW = "flow_step"
+    MSG_ARG_KEY_ROUND_IDX = "round_idx"
+
+
+class Message:
+    def __init__(self, msg_type, sender=0, receiver=0):
+        self.type = msg_type
+
+    def get(self, key):
+        return 0
+
+
+class TrainingFlowManager:
+    """Registers its steps through the DSL, never touches
+    register_message_receive_handler directly."""
+
+    def __init__(self, flow):
+        self.round_idx = 0
+        self.progress = {}
+        self.done = None
+        flow.add_flow("init", self._init_step, "server", "ONCE")
+        flow.add_flow("train", self._train_step, "client")
+        flow.add_flow("finish", self._finish_step, "server", "FINISH")
+
+    def _init_step(self, executor):
+        return executor.get_params()
+
+    def _train_step(self, executor):
+        msg_round = int(executor.get_params().get("round_idx"))
+        if msg_round < self.round_idx:  # replay guard: stale pass dropped
+            return None
+        self.round_idx = msg_round + 1
+        self.progress[msg_round] = "trained"
+        return executor.get_params()
+
+    def _finish_step(self, executor):
+        self.finish()
+        return None
+
+    def finish(self):
+        pass
+
+    def _dispatch(self, step_idx):
+        # the flow plane's own dispatch: the send side of 'flow_step'
+        return Message(MyMessage.MSG_TYPE_FLOW, 0, step_idx)

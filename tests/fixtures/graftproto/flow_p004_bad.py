@@ -1,0 +1,34 @@
+"""Flow-DSL known-bad: an add_flow callback mutates round state with no
+round comparison anywhere in its closure — P004 must fire on the callback
+even though no register_message_receive_handler site exists."""
+
+
+class MyMessage:
+    MSG_TYPE_FLOW = "flow_step"
+
+
+class Message:
+    def __init__(self, msg_type, sender=0, receiver=0):
+        self.type = msg_type
+
+
+class ReplayableFlowManager:
+    def __init__(self, flow):
+        self.round_idx = 0
+        self.history = {}
+        flow.add_flow("train", self._train_step, "client")
+        flow.add_flow("finish", self._finish_step, "server", "FINISH")
+
+    def _train_step(self, executor):
+        self.round_idx = self.round_idx + 1   # line 23: unguarded mutation
+        self.history[self.round_idx] = "x"
+        return executor.get_params()
+
+    def _finish_step(self, executor):
+        self.finish()
+
+    def finish(self):
+        pass
+
+    def _dispatch(self):
+        return Message(MyMessage.MSG_TYPE_FLOW, 0, 1)

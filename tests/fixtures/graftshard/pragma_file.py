@@ -1,0 +1,7 @@
+"""graftshard file-level pragma fixture: whole file exempt from S002."""
+# graftshard: disable=S002
+
+from jax.sharding import PartitionSpec as P
+
+A = P("fsdp", "fsdp")
+B = P("bogus_axis")

@@ -1,0 +1,6 @@
+"""graftshard pragma fixture: one suppressed S002, one live."""
+
+from jax.sharding import PartitionSpec as P
+
+SUPPRESSED = P("fsdp", "fsdp")  # graftshard: disable=S002
+LIVE = P("fsdp", "fsdp")        # line 6: NOT suppressed -> S002

@@ -1,0 +1,10 @@
+"""S001 known-bad: a partition-rule set with no catch-all (never imported,
+only parsed by the analyzer — line numbers are asserted by the tests)."""
+
+from jax.sharding import PartitionSpec as P
+
+MODEL_RULES = (  # line 6: only specific patterns — unexpected leaves
+    # silently replicate via the fallback
+    (r"embedding", P("tensor", "fsdp")),
+    (r"attention/.*", P("fsdp", "tensor")),
+)

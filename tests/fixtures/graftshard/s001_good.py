@@ -1,0 +1,9 @@
+"""S001 known-good: the rule set ends in an explicit catch-all."""
+
+from jax.sharding import PartitionSpec as P
+
+MODEL_RULES = (
+    (r"embedding", P("tensor", "fsdp")),
+    (r"attention/.*", P("fsdp", "tensor")),
+    (r".*", P()),  # every remaining leaf replicates ON PURPOSE
+)

@@ -1,0 +1,10 @@
+"""S002 known-bad: unknown mesh axis + repeated axis in one spec."""
+
+from jax.sharding import PartitionSpec as P
+
+MESH_AXIS_STAGE = "stage"  # a legitimate extra axis, used below
+
+BAD_AXIS = P("fsdp", "shards")        # line 7: 'shards' is not a mesh axis
+DUP_AXIS = P("fsdp", "fsdp")          # line 8: fsdp repeated
+DUP_IN_TUPLE = P(("data", "fsdp"), "data")  # line 9: data repeated
+OK_EXTRA = P(MESH_AXIS_STAGE, None)   # fine: declared axis constant

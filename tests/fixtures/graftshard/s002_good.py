@@ -1,0 +1,11 @@
+"""S002 known-good: canonical axes, no repeats, dynamic dims skipped."""
+
+from jax.sharding import PartitionSpec as P
+
+SPEC_A = P("fsdp", "tensor")
+SPEC_B = P(("data", "fsdp"), None, "sequence")
+SPEC_C = P(None)
+
+
+def dynamic(axis):
+    return P(axis, None)  # unresolvable dim: exempt, never guessed

@@ -1,0 +1,17 @@
+"""S003 known-bad: device_put inside traced code; cross-spec binop."""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+@jax.jit
+def step(state, batch, sh):
+    moved = jax.device_put(batch, sh)  # line 9: cross-device copy in jit
+    return state + moved.sum()
+
+
+@jax.jit
+def combine(a, b):
+    x = jax.lax.with_sharding_constraint(a, P("fsdp", None))
+    y = jax.lax.with_sharding_constraint(b, P("tensor", None))
+    return x + y  # line 17: cross-spec binop -> hidden all-gather

@@ -1,0 +1,16 @@
+"""S003 known-good: placement outside jit, one layout per combination."""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def place_then_step(step_fn, state, batch, sh):
+    batch = jax.device_put(batch, sh)  # host side: placement is fine here
+    return step_fn(state, batch)
+
+
+@jax.jit
+def combine(a, b):
+    x = jax.lax.with_sharding_constraint(a, P("fsdp", None))
+    y = jax.lax.with_sharding_constraint(b, P("fsdp", None))
+    return x + y  # same layout on both operands

@@ -1,0 +1,19 @@
+"""S004 known-bad: host pulls of sharded arrays inside the round loop,
+and a device_get -> device_put host round-trip."""
+
+import jax
+import numpy as np
+
+
+def round_loop(ds, shardings, metrics_fn):
+    cohort = jax.device_put(ds.cohort, shardings)
+    losses = []
+    for r in range(100):
+        host = np.asarray(cohort)       # line 12: full gather, every round
+        losses.append(float(metrics_fn(host).mean()))
+    return losses
+
+
+def replace_aux(arr, sharding):
+    pulled = jax.device_get(arr)
+    return jax.device_put(pulled, sharding)  # line 19: host round-trip

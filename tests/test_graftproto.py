@@ -378,3 +378,58 @@ class TestRealInvariantsStayFixed:
         fs = analyze_paths([os.path.join(TREE, "cross_silo")],
                            repo_root=REPO_ROOT)
         assert [f for f in fs if f.rule == "P004"] == []
+
+
+class TestFlowDSLDispatch:
+    """The PR 5 residual: callbacks registered through the flow DSL
+    (``add_flow``) must be first-class in the message-flow graph."""
+
+    def test_flow_only_manager_is_clean(self):
+        # sends Message(MSG_TYPE_FLOW) but registers handlers ONLY via
+        # add_flow — without flow-DSL resolution this was a false P001
+        assert _findings("flow_dispatch_good.py") == []
+
+    def test_add_flow_registrations_enter_flow_graph(self):
+        paths = [os.path.join(FIXTURES, "flow_dispatch_good.py")]
+        _fs, model = analyze_paths_with_model(paths, repo_root=REPO_ROOT)
+        regs = model.handlers.get("flow_step", [])
+        assert {r.handler for r in regs} == {
+            "_init_step", "_train_step", "_finish_step"}
+        assert model.classify_value("flow_step") == "sent+handled"
+
+    def test_flow_callback_round_mutation_is_p004(self):
+        fs = _findings("flow_p004_bad.py")
+        assert {f.rule for f in fs} == {"P004"}
+        assert _rule_lines(fs, "P004") == [23]
+
+    def test_keyword_form_add_flow_still_resolves(self, tmp_path):
+        # add_flow("train", executor_task=self._fn, role=...) is legal per
+        # the shipped signature — the callback must still enter the graph
+        p = tmp_path / "kwflow.py"
+        p.write_text(
+            "class MyMessage:\n"
+            "    MSG_TYPE_FLOW = \"flow_step\"\n\n\n"
+            "class Message:\n"
+            "    def __init__(self, t, a=0, b=0):\n"
+            "        self.t = t\n\n\n"
+            "class KwFlowManager:\n"
+            "    def __init__(self, flow):\n"
+            "        self.round_idx = 0\n"
+            "        flow.add_flow(\"t\", executor_task=self._train,\n"
+            "                      role=\"client\")\n\n"
+            "    def _train(self, ex):\n"
+            "        self.round_idx = self.round_idx + 1\n"
+            "        self.finish()\n\n"
+            "    def finish(self):\n"
+            "        pass\n\n"
+            "    def _dispatch(self):\n"
+            "        return Message(MyMessage.MSG_TYPE_FLOW)\n")
+        fs = analyze_paths([str(p)], repo_root=REPO_ROOT)
+        assert any(f.rule == "P004" for f in fs), \
+            "\n".join(f.render() for f in fs)
+
+    def test_shipped_flow_plane_still_clean(self):
+        fs = analyze_paths(
+            [os.path.join(TREE, "core", "distributed", "flow.py")],
+            repo_root=REPO_ROOT)
+        assert fs == [], "\n".join(f.render() for f in fs)

@@ -413,3 +413,58 @@ class TestArgumentsSurface:
 
         with pytest.raises(SystemExit):
             cli_main(["swarm", "--no-such-flag"])
+
+
+class TestTopTrafficCounters:
+    """`fedml_tpu top` surfaces the traffic.* backpressure family (the PR 7
+    residual named in ROADMAP) from the run's telemetry summary."""
+
+    @staticmethod
+    def _run_file(tmp_path, metrics):
+        import json as _json
+
+        p = tmp_path / "run_traffic_edge_0.jsonl"
+        events = [
+            {"kind": "round_record", "round": 0, "wall_s": 1.0,
+             "phases": {"dispatch": 0.5}},
+            {"kind": "telemetry_summary", "metrics": metrics},
+        ]
+        p.write_text("".join(_json.dumps(e) + "\n" for e in events))
+        return str(p)
+
+    def test_traffic_block_rendered(self, tmp_path, capsys):
+        from fedml_tpu.cli import main
+
+        path = self._run_file(tmp_path, {
+            "counters": {
+                "traffic.accepted_updates": 120,
+                "traffic.shed_rate_limited": 7,
+                "traffic.shed_queue_full": 3,
+                "traffic.stale_dropped_updates": 2,
+                "traffic.server_steps": 40,
+            },
+            "gauges": {"traffic.buffer_occupancy": 5},
+            "histograms": {
+                "traffic.staleness": {"count": 120, "sum": 60.0,
+                                      "p50": 0.4, "p95": 2.0, "p99": 3.0},
+                "traffic.dispatch_ready_s": {"count": 120, "sum": 2.0,
+                                             "p50": 0.01, "p95": 0.05,
+                                             "p99": 0.08},
+            },
+        })
+        assert main(["top", path]) == 0
+        out = capsys.readouterr().out
+        assert "traffic plane" in out
+        assert "accepted: 120" in out
+        assert "shed: 10 (rate-limited 7, queue-full 3)" in out
+        assert "stale-dropped: 2" in out
+        assert "buffer occupancy: 5" in out
+        assert "staleness: p50 0.400" in out
+        assert "dispatch→ready: p50 0.010s" in out
+
+    def test_sync_runs_stay_silent(self, tmp_path, capsys):
+        from fedml_tpu.cli import main
+
+        path = self._run_file(tmp_path, {"counters": {"rounds": 4}})
+        assert main(["top", path]) == 0
+        assert "traffic plane" not in capsys.readouterr().out

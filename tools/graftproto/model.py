@@ -34,6 +34,15 @@ MSG_TYPE_PREFIX = "MSG_TYPE"
 FINISH_CALLS = ("finish",)
 FINISH_EVENT_CALLS = ("done.set",)
 
+# the flow DSL's dispatch wire value (FedMLAlgorithmFlow.MSG_TYPE_FLOW):
+# ``add_flow(name, callback, role)`` registers ``callback`` as a handler
+# the flow plane invokes from its MSG_TYPE_FLOW handler — without modeling
+# that, callbacks routed through the DSL are invisible to P001/P002 (a
+# flow-only manager looks like it sends 'flow_step' into the void) and
+# their round-state mutations escape P004/P005 entirely.
+FLOW_REG_METHOD = "add_flow"
+FLOW_WIRE_FALLBACK = "flow_step"
+
 
 class MsgConstant:
     __slots__ = ("owner", "attr", "value", "rel", "line")
@@ -452,6 +461,35 @@ def _has_round_guard(fn_node: ast.AST) -> bool:
     return False
 
 
+_FLOW_MODULE_NAMES = ("flow", "fedml_flow")
+
+
+def _touches_flow_plane(mod: ModuleInfo, model: ProtoModel) -> bool:
+    """True when ``mod`` plausibly uses the algorithm-flow DSL: it imports
+    the flow module (any form) / FedMLAlgorithmFlow, or defines a
+    MSG_TYPE_FLOW constant itself (standalone fixtures)."""
+    for base, orig in mod.from_imports.values():
+        if (orig in ("FedMLAlgorithmFlow", "FedMLExecutor")
+                or orig in _FLOW_MODULE_NAMES          # from pkg import flow
+                or base.rsplit(".", 1)[-1] in _FLOW_MODULE_NAMES):
+            return True
+    for target in mod.imports.values():                # import pkg.flow
+        if target.rsplit(".", 1)[-1] in _FLOW_MODULE_NAMES:
+            return True
+    return any(c.attr == "MSG_TYPE_FLOW" and c.rel == mod.rel
+               for c in model.constants)
+
+
+def _flow_wire_value(model: ProtoModel) -> str:
+    """The wire value add_flow callbacks ride on: the scanned tree's
+    MSG_TYPE_FLOW constant when present (the shipped flow.py), else the
+    canonical literal (standalone fixtures)."""
+    for c in model.constants:
+        if c.attr == "MSG_TYPE_FLOW":
+            return c.value
+    return FLOW_WIRE_FALLBACK
+
+
 def _collect_call(node: ast.Call, mod: ModuleInfo, cls: Optional[str],
                   method: str, fi: FuncInfo, mf: MethodFacts,
                   cf: Optional[ClassFacts], model: ProtoModel,
@@ -466,6 +504,32 @@ def _collect_call(node: ast.Call, mod: ModuleInfo, cls: Optional[str],
         ref = _resolve_type_expr(node.args[0], mod, cls, fi, model)
         mf.sends.append(ref)
         _index_type_site(model, mod, cls, method, ref, is_send=True)
+
+    # flow-DSL callback registration: add_flow(name, callback, role, ...)
+    # == a handler registration for the flow dispatch wire value, with the
+    # callback entering the registering class's P004/P005 closure. Gated
+    # on the module actually touching the flow plane (imports it, or
+    # defines a MSG_TYPE_FLOW constant) — "add_flow" alone is too
+    # collision-prone a name to claim for the DSL.
+    flow_cb = None
+    if ds is not None and ds.split(".")[-1] == FLOW_REG_METHOD:
+        if len(node.args) >= 2:
+            flow_cb = node.args[1]
+        else:  # keyword form: add_flow("train", executor_task=self._fn)
+            flow_cb = next((kw.value for kw in node.keywords
+                            if kw.arg == "executor_task"), None)
+    if flow_cb is not None and _touches_flow_plane(mod, model):
+        wire = _flow_wire_value(model)
+        handler = None
+        cb_ds = dotted(flow_cb)
+        if cb_ds is not None:
+            handler = (cb_ds.split(".", 1)[1] if cb_ds.startswith("self.")
+                       else cb_ds.split(".")[-1])
+        reg = HandlerReg(mod.rel, cls, method, node.lineno, wire,
+                         TypeRef("flow", node.lineno, value=wire), handler)
+        if cf is not None:
+            cf.registrations.append(reg)
+        model.handlers.setdefault(wire, []).append(reg)
 
     # handler registration (direct or via a local alias)
     is_reg = (ds is not None

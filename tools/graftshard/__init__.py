@@ -1,0 +1,27 @@
+"""graftshard — static sharding, HBM-budget & transfer verification of the
+TPU execution plane (ISSUE 8).
+
+Third analyzer suite on the shared :mod:`tools.graftlint.clikit` driver
+(findings/pragma/baseline/exit-code contract reused):
+
+- **S001** partition-rule coverage — rule sets must end in an explicit
+  catch-all, so no named-pytree leaf is silently replicated by fallback;
+- **S002** spec validity — PartitionSpec axes must exist on the mesh,
+  never repeat, and (when shapes are known via the model registry) divide
+  their dimensions;
+- **S003** implicit resharding on hot paths — ``device_put`` inside traced
+  code, cross-spec binops that force hidden all-gathers;
+- **S004** host transfer of sharded arrays — ``np.asarray``/``device_get``
+  /``.item()`` on sharded values inside round loops, host round-trips;
+- **S005** static HBM budget — model config × partition rules × optimizer
+  state through ``jax.eval_shape``, per-device byte totals against a
+  v5e/v5p/CPU HBM table, no hardware required.
+
+Run: ``python -m tools.graftshard [paths...]`` or ``fedml_tpu lint
+--shard``; ``--model 7b --mesh 4x4`` adds the HBM budget report;
+``--runtime`` traces the real mesh/cheetah factories and diffs declared vs
+inferred shardings.
+"""
+
+from .analyzer import analyze_paths, analyze_paths_with_model  # noqa: F401
+from .findings import SHARD_RULES, Finding  # noqa: F401

@@ -1,0 +1,68 @@
+"""graftshard entry: scan → graftlint facts → shard model → rules → pragmas.
+
+Mirrors :func:`tools.graftproto.analyzer.analyze_paths_with_model`, with
+graftshard's own pragma marker (``# graftshard: disable=S003``) and baseline
+file (``tools/graftshard/baseline.json``). The default pass is pure AST —
+no jax import — so the tree gate stays sub-second; the HBM estimator
+(:mod:`hbm`) and ``--runtime`` (:mod:`runtime_check`) opt into jax.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..graftlint.analyzer import Analyzer, collect_files, load_modules
+from ..graftlint.baseline import find_repo_root
+from ..graftlint.pragmas import is_suppressed, parse_pragmas
+from .findings import Finding
+from .model import ShardModel, build_model
+from .rules import check_shard
+
+PRAGMA_TOOL = "graftshard"
+DEFAULT_BASELINE_RELPATH = os.path.join("tools", "graftshard",
+                                        "baseline.json")
+
+
+def default_baseline_path(repo_root: str) -> str:
+    return os.path.join(repo_root, DEFAULT_BASELINE_RELPATH)
+
+
+def analyze_paths_with_model(
+    paths: Sequence[str], repo_root: Optional[str] = None
+) -> Tuple[List[Finding], ShardModel]:
+    """Analyze files/dirs → (pragma-filtered findings, shard model).
+
+    The baseline is NOT applied here — that's the CLI/caller's job, like
+    the sibling suites.
+    """
+    if repo_root is None:
+        repo_root = find_repo_root(paths[0] if paths else os.getcwd())
+    files = collect_files(paths)
+    modules = load_modules(files, repo_root)
+    # graftlint's jit call graph marks the traced set — "hot path" means
+    # the same thing to the S-rules as it does to the G-rules
+    lint = Analyzer(modules)
+    lint.compute_facts()
+    lint.propagate()
+    model = build_model(modules)
+    findings = check_shard(model, modules, lint)
+
+    out: List[Finding] = []
+    pragma_cache: Dict[str, Dict] = {}
+    mods_by_rel = {m.rel: m for m in modules.values()}
+    for f in findings:
+        mod = mods_by_rel.get(f.path)
+        if mod is not None:
+            pragmas = pragma_cache.setdefault(
+                f.path, parse_pragmas(mod.source, tool=PRAGMA_TOOL))
+            if is_suppressed(pragmas, f.rule, f.line):
+                continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out, model
+
+
+def analyze_paths(paths: Sequence[str],
+                  repo_root: Optional[str] = None) -> List[Finding]:
+    return analyze_paths_with_model(paths, repo_root)[0]

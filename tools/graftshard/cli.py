@@ -1,0 +1,173 @@
+"""graftshard CLI: ``python -m tools.graftshard [paths...]``.
+
+Thin suite definition over the shared driver
+(:mod:`tools.graftlint.clikit` — flags, baseline handling, rendering, and
+the exit-code contract live there, shared with graftlint/graftproto).
+Exit codes: 0 clean (after baseline + pragmas), 1 findings, 2 usage error
+OR analyzer crash — that includes crashes inside the HBM estimator and the
+``--runtime`` trace pass.
+
+Extras over the sibling suites:
+
+- ``--model NAME [--mesh SPEC]`` — run the S005 static HBM-budget
+  estimator (per-device byte totals against the v5e/v5p/CPU table, no
+  hardware; the report rides the JSON payload under ``"hbm"`` and renders
+  after the findings in text mode);
+- ``--check-rules`` / ``--check-state-rules`` — validate an operator rule
+  set (the ``--mesh_partition_rules`` syntax) for catch-all coverage and
+  axis validity before a run ever ships it;
+- ``--runtime`` — trace the real mesh_api/cheetah factories over a forced
+  multi-device CPU mesh and diff declared vs inferred shardings.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Dict, List, Optional, Tuple
+
+from ..graftlint import clikit
+from ..graftlint.findings import Finding
+from .analyzer import DEFAULT_BASELINE_RELPATH, analyze_paths_with_model
+from .findings import SHARD_RULES
+
+
+def _add_arguments(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--runtime", action="store_true",
+                   help="also trace the real mesh/cheetah factories over "
+                        "a forced multi-device CPU mesh and diff declared "
+                        "vs inferred shardings (imports jax)")
+    p.add_argument("--model", default="",
+                   help="run the S005 HBM-budget estimator for this model "
+                        "registry entry (e.g. 7b, tiny); imports jax")
+    p.add_argument("--mesh", default="4x4",
+                   help="mesh rows for --model: comma list of "
+                        "[chip:]shape — '4x4' (16 chips on fsdp), "
+                        "'v5e:2x4', 'fsdp=8+tensor=2'; chipless rows are "
+                        "priced against every chip (default: 4x4)")
+    p.add_argument("--seq-len", type=int, default=0,
+                   help="sequence length for the HBM batch term "
+                        "(default: the model config's max_seq_len)")
+    p.add_argument("--batch-per-device", type=int, default=1)
+    p.add_argument("--mu-dtype", default="bfloat16",
+                   choices=("float32", "bfloat16"),
+                   help="adam first-moment dtype for the HBM optimizer "
+                        "term (default bfloat16, matching the 7B rows)")
+    p.add_argument("--check-rules", default="",
+                   help="validate a --mesh_partition_rules string (S001 "
+                        "catch-all + S002 axis validity), e.g. "
+                        "'cohort/.*=clients;.*='")
+    p.add_argument("--check-state-rules", default="",
+                   help="validate a --mesh_state_rules string the same way")
+
+
+def _check_rule_string(text: str, which: str,
+                       vocabulary: frozenset) -> List[Finding]:
+    """Operator rule-set validation (the CLI/YAML surface of S001/S002).
+
+    Axis names validate against the SAME vocabulary the AST pass built
+    from the scanned tree (MESH_AXIS_* constants + Mesh construction
+    sites), so a legitimately declared private axis like ``silo_dp`` is
+    not falsely rejected here."""
+    from fedml_tpu.scale.partition_rules import parse_partition_rules
+
+    from .model import is_catch_all
+
+    try:
+        rules = parse_partition_rules(text)
+    except ValueError as e:
+        raise clikit.SuiteUsageError(f"--{which}: {e}") from e
+    findings: List[Finding] = []
+    catch_idx = next((i for i, (pat, _spec) in enumerate(rules)
+                      if is_catch_all(pat)), None)
+    if catch_idx is None:
+        findings.append(Finding(
+            rule="S001", path=f"<--{which}>", line=1, col=0,
+            message=f"rule set {text!r} has no catch-all — leaves no "
+                    "pattern matches silently take the fallback "
+                    "(replicate); end it with an explicit '.*=' rule",
+            line_text=f"rules::{which}::{text}"))
+    elif catch_idx != len(rules) - 1:
+        findings.append(Finding(
+            rule="S001", path=f"<--{which}>", line=1, col=0,
+            message=f"rule set {text!r}: catch-all "
+                    f"{rules[catch_idx][0]!r} at position {catch_idx} "
+                    "shadows every later rule (first match wins) — move "
+                    "it last",
+            line_text=f"rules::{which}::shadow::{text}"))
+    for pat, spec in rules:
+        for dim in spec:
+            for ax in (dim if isinstance(dim, tuple) else (dim,)):
+                if ax is not None and ax not in vocabulary:
+                    findings.append(Finding(
+                        rule="S002", path=f"<--{which}>", line=1, col=0,
+                        message=f"rule {pat!r} names axis {ax!r}, which "
+                                "is not a known mesh axis "
+                                f"({', '.join(sorted(vocabulary))})",
+                        line_text=f"rules::{which}::{pat}::{ax}"))
+    return findings
+
+
+def _analyze(args: argparse.Namespace,
+             repo_root: str) -> Tuple[List[Finding], Dict]:
+    if args.runtime:
+        # BEFORE anything imports jax (the HBM estimator and --check-rules
+        # both do): the runtime pass needs its forced CPU device count set
+        # while jax is still unimported, or it sees 1 real device
+        from .runtime_check import _ensure_devices
+
+        _ensure_devices()
+    findings, model = analyze_paths_with_model(args.paths,
+                                               repo_root=repo_root)
+    extra: Dict = {}
+    for which, text in (("check-rules", args.check_rules),
+                        ("check-state-rules", args.check_state_rules)):
+        if text:
+            import sys
+
+            sys.path.insert(0, repo_root)
+            findings = findings + _check_rule_string(text, which,
+                                                     model.vocabulary)
+    if args.model:
+        import sys
+
+        sys.path.insert(0, repo_root)
+        from .hbm import estimate_budget, render_report
+
+        try:
+            hbm_findings, report = estimate_budget(
+                args.model, args.mesh, seq_len=args.seq_len,
+                batch_per_device=args.batch_per_device,
+                mu_dtype=args.mu_dtype)
+        except ValueError as e:
+            raise clikit.SuiteUsageError(str(e)) from e
+        findings = findings + hbm_findings
+        extra["hbm"] = report
+        if args.format != "json":
+            print(render_report(report))
+    if args.runtime:
+        from .runtime_check import check_shard_runtime
+
+        try:
+            findings = findings + check_shard_runtime(repo_root)
+        except RuntimeError as e:
+            raise clikit.SuiteUsageError(str(e)) from e
+    return findings, extra
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    return clikit.run_suite(
+        argv,
+        tool="graftshard",
+        description="static sharding, HBM-budget & transfer verification "
+                    "of the TPU execution plane: partition-rule coverage, "
+                    "spec validity, implicit-reshard and host-transfer "
+                    "detection, per-device HBM budgets without hardware",
+        rules=SHARD_RULES,
+        analyze=_analyze,
+        baseline_relpath=DEFAULT_BASELINE_RELPATH,
+        add_arguments=_add_arguments,
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,50 @@
+"""graftshard rule registry (S001–S005), merged into the shared graftlint
+Finding infrastructure so all three suites render/baseline/JSON identically."""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from ..graftlint.findings import Finding, register_rules
+
+# rule id -> (title, autofix hint)
+SHARD_RULES: Dict[str, Tuple[str, str]] = {
+    "S001": (
+        "partition-rule-coverage-gap",
+        "end the rule set with an explicit `.*=` catch-all (replicate or "
+        "shard — but say which); a leaf no rule matches silently takes the "
+        "fallback, and a silently replicated 7B embedding is an OOM on "
+        "every chip at once",
+    ),
+    "S002": (
+        "invalid-partition-spec",
+        "name only axes the mesh actually has (constants.MESH_AXIS_*), "
+        "never repeat an axis inside one PartitionSpec, and keep every "
+        "sharded dimension divisible by the product of its axis extents — "
+        "XLA pads indivisible shards per-device and the HBM math lies",
+    ),
+    "S003": (
+        "implicit-reshard-on-hot-path",
+        "keep one sharding per value across the traced region: hoist "
+        "device_put out of jit'd code, and constrain both operands of a "
+        "cross-spec op to ONE layout before combining them — a spec "
+        "mismatch lowers to a hidden all-gather every step",
+    ),
+    "S004": (
+        "host-transfer-of-sharded-array",
+        "keep sharded values on device: reduce on-device and pull one "
+        "scalar after the loop, or use per-shard views — np.asarray/"
+        "device_get/.item() on a sharded array gathers every shard over "
+        "ICI to one host, once per iteration",
+    ),
+    "S005": (
+        "hbm-budget-exceeded",
+        "shard the state further (grow the fsdp/tensor axes), shrink the "
+        "per-device batch, or drop mu_dtype to bfloat16 — the static "
+        "budget already exceeds the chip before activations are counted",
+    ),
+}
+
+register_rules(SHARD_RULES)
+
+__all__ = ["Finding", "SHARD_RULES"]

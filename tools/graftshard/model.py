@@ -1,0 +1,347 @@
+"""Sharding model: AST extraction of the TPU execution plane's GSPMD surface.
+
+Everything is syntactic (no import of analyzed code), built on graftlint's
+module index. The model captures, per scanned tree:
+
+- **mesh-axis vocabulary** — every ``MESH_AXIS_* = "name"`` constant, plus
+  the canonical axis set, so ``PartitionSpec`` axis names can be validated
+  without instantiating a mesh;
+- **PartitionSpec sites** — every ``P(...)`` / ``PartitionSpec(...)``
+  construction, each positional dim resolved to an axis string, ``None``,
+  a multi-axis tuple, or *unresolved* (dynamic expressions are skipped,
+  never guessed);
+- **partition-rule-set literals** — tuple/list literals of
+  ``(regex, PartitionSpec)`` pairs (the ``match_partition_rules`` shape:
+  ``DEFAULT_COHORT_RULES``-style in-code defaults), with their patterns, so
+  S001 can prove an explicit catch-all exists.
+
+Name resolution is deliberately shallow: a dim expression resolves through
+module-level ``NAME = "literal"`` / ``NAME = constants.MESH_AXIS_X``
+assignments and cross-module from-imports of those, and stops there —
+anything dynamic is recorded unresolved and exempt from S002.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from ..graftlint.analyzer import FuncInfo, ModuleInfo, dotted
+
+MESH_AXIS_PREFIX = "MESH_AXIS_"
+
+# the canonical axis set (fedml_tpu/constants.py) — always part of the
+# vocabulary so single-file scans (fixtures, editor integration) validate
+# against the same axes the tree uses
+CANONICAL_AXES = frozenset(
+    {"clients", "data", "fsdp", "tensor", "sequence", "expert", "pipeline"}
+)
+
+# sentinel for a dim expression the resolver could not reduce to a string
+UNRESOLVED = "<unresolved>"
+
+Dim = Union[str, None, Tuple[str, ...]]
+
+
+class PSpecSite:
+    """One ``P(...)`` construction with resolved dims."""
+
+    __slots__ = ("rel", "line", "dims", "func")
+
+    def __init__(self, rel: str, line: int, dims: List[Dim],
+                 func: Optional[FuncInfo]):
+        self.rel = rel
+        self.line = line
+        self.dims = dims
+        self.func = func  # enclosing function (None at module level)
+
+    def axes(self) -> List[str]:
+        """Every resolved axis string in the spec, in order (dups kept)."""
+        out: List[str] = []
+        for d in self.dims:
+            for ax in (d if isinstance(d, tuple) else (d,)):
+                if isinstance(ax, str) and ax != UNRESOLVED:
+                    out.append(ax)
+        return out
+
+    def signature(self) -> Optional[Tuple]:
+        """Canonical hashable layout, or None when any dim is unresolved —
+        S003's cross-spec comparison only fires on fully-known layouts."""
+        sig: List = []
+        for d in self.dims:
+            if d == UNRESOLVED or (
+                    isinstance(d, tuple) and UNRESOLVED in d):
+                return None
+            sig.append(d)
+        return tuple(sig)
+
+
+class RuleSetSite:
+    """A ``(regex, PartitionSpec)`` rule-set literal (in-code defaults)."""
+
+    __slots__ = ("rel", "line", "name", "patterns")
+
+    def __init__(self, rel: str, line: int, name: str,
+                 patterns: List[Tuple[str, int]]):
+        self.rel = rel
+        self.line = line
+        self.name = name
+        self.patterns = patterns  # (pattern, line) in declaration order
+
+    def has_catch_all(self) -> bool:
+        return any(is_catch_all(p) for p, _line in self.patterns)
+
+    def catch_all_index(self) -> Optional[int]:
+        """Index of the first catch-all pattern (None if absent) — rules
+        after it are dead under first-match-wins resolution."""
+        for i, (p, _line) in enumerate(self.patterns):
+            if is_catch_all(p):
+                return i
+        return None
+
+
+# names a catch-all pattern must match: plain, nested, digits-only — if a
+# regex search-matches all of these it matches any leaf name in practice
+_CATCH_ALL_PROBES = ("w", "a/b/c", "0", "layer_7/kernel")
+
+
+def is_catch_all(pattern: str) -> bool:
+    try:
+        pat = re.compile(pattern)
+    except re.error:
+        return False
+    return all(pat.search(probe) is not None for probe in _CATCH_ALL_PROBES)
+
+
+class ShardModel:
+    def __init__(self) -> None:
+        # MESH_AXIS_* attr name -> axis string (from any scanned module)
+        self.axis_constants: Dict[str, str] = {}
+        # axis names declared at Mesh(...) construction sites (e.g. the
+        # cross-silo plane's private "silo_dp" axis)
+        self.mesh_axes: set = set()
+        self.pspec_sites: List[PSpecSite] = []
+        self.rule_sets: List[RuleSetSite] = []
+
+    @property
+    def vocabulary(self) -> frozenset:
+        return (CANONICAL_AXES
+                | frozenset(self.axis_constants.values())
+                | frozenset(self.mesh_axes))
+
+
+# ---------------------------------------------------------------------------
+# extraction
+# ---------------------------------------------------------------------------
+
+
+def build_model(modules: Dict[str, ModuleInfo]) -> ShardModel:
+    model = ShardModel()
+    _collect_axis_constants(modules, model)
+    envs = {name: _module_env(mod, modules, model)
+            for name, mod in modules.items()}
+    for name, mod in modules.items():
+        _collect_module_sites(mod, modules, model, envs[name], envs)
+    return model
+
+
+def _assign_parts(node: ast.AST):
+    """(target, value) for simple ``x = v`` / ``x: T = v`` assignments."""
+    if isinstance(node, ast.Assign) and len(node.targets) == 1:
+        return node.targets[0], node.value
+    if isinstance(node, ast.AnnAssign) and node.value is not None:
+        return node.target, node.value
+    return None, None
+
+
+def _collect_axis_constants(modules: Dict[str, ModuleInfo],
+                            model: ShardModel) -> None:
+    for mod in modules.values():
+        for node in ast.walk(mod.tree):
+            target, value = _assign_parts(node)
+            if not isinstance(target, ast.Name):
+                continue
+            if not target.id.startswith(MESH_AXIS_PREFIX):
+                continue
+            if (isinstance(value, ast.Constant)
+                    and isinstance(value.value, str)):
+                model.axis_constants[target.id] = value.value
+
+
+def _module_env(mod: ModuleInfo, modules: Dict[str, ModuleInfo],
+                model: ShardModel) -> Dict[str, str]:
+    """Module-level NAME -> axis string, for names assigned from string
+    literals or ``*.MESH_AXIS_X`` attribute reads."""
+    env: Dict[str, str] = {}
+    for node in mod.tree.body:
+        target, value = _assign_parts(node)
+        if not isinstance(target, ast.Name):
+            continue
+        name = target.id
+        if isinstance(value, ast.Constant) and isinstance(value.value, str):
+            env[name] = value.value
+        elif isinstance(value, ast.Attribute):
+            attr = value.attr
+            if attr.startswith(MESH_AXIS_PREFIX):
+                resolved = model.axis_constants.get(attr)
+                if resolved is None:
+                    # constants module outside the scan roots: derive from
+                    # the canonical naming convention (MESH_AXIS_DATA ->
+                    # "data"), which the repo's constants.py follows
+                    resolved = attr[len(MESH_AXIS_PREFIX):].lower()
+                env[name] = resolved
+    return env
+
+
+def _resolve_dim_atom(expr: ast.expr, mod: ModuleInfo,
+                      env: Dict[str, str],
+                      envs: Dict[str, Dict[str, str]]) -> Dim:
+    if isinstance(expr, ast.Constant):
+        if expr.value is None:
+            return None
+        if isinstance(expr.value, str):
+            return expr.value
+        return UNRESOLVED
+    if isinstance(expr, ast.Name):
+        if expr.id in env:
+            return env[expr.id]
+        imp = mod.from_imports.get(expr.id)
+        if imp is not None:
+            target_env = envs.get(imp[0])
+            if target_env is not None and imp[1] in target_env:
+                return target_env[imp[1]]
+        return UNRESOLVED
+    ds = dotted(expr)
+    if ds is not None:
+        attr = ds.split(".")[-1]
+        if attr.startswith(MESH_AXIS_PREFIX):
+            # constants.MESH_AXIS_X read directly at the P() site
+            resolved = _axis_constant_anywhere(attr, envs)
+            return (resolved if resolved is not None
+                    else attr[len(MESH_AXIS_PREFIX):].lower())
+    return UNRESOLVED
+
+
+def _axis_constant_anywhere(attr: str,
+                            envs: Dict[str, Dict[str, str]]
+                            ) -> Optional[str]:
+    for e in envs.values():
+        if attr in e:
+            return e[attr]
+    return None
+
+
+def _resolve_dim(expr: ast.expr, mod: ModuleInfo, env: Dict[str, str],
+                 envs: Dict[str, Dict[str, str]]) -> Dim:
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        parts = []
+        for e in expr.elts:
+            atom = _resolve_dim_atom(e, mod, env, envs)
+            if isinstance(atom, tuple):
+                return UNRESOLVED
+            parts.append(atom if atom is not None else UNRESOLVED)
+        return tuple(parts)
+    if isinstance(expr, ast.Starred):
+        return UNRESOLVED
+    return _resolve_dim_atom(expr, mod, env, envs)
+
+
+def is_pspec_call(mod: ModuleInfo, call: ast.Call) -> bool:
+    ds = dotted(call.func)
+    if ds is None:
+        return False
+    last = ds.split(".")[-1]
+    if last == "PartitionSpec":
+        return True
+    if last == "P":
+        imp = mod.from_imports.get("P")
+        return bool(imp and imp[1] == "PartitionSpec")
+    return False
+
+
+def _collect_module_sites(mod: ModuleInfo, modules: Dict[str, ModuleInfo],
+                          model: ShardModel, env: Dict[str, str],
+                          envs: Dict[str, Dict[str, str]]) -> None:
+    # map every AST node id to its enclosing FuncInfo for attribution
+    owner: Dict[int, Optional[FuncInfo]] = {}
+
+    def assign_owner(root: ast.AST, fi: Optional[FuncInfo]) -> None:
+        for child in ast.iter_child_nodes(root):
+            sub = mod.funcs_by_node.get(id(child))
+            here = sub if sub is not None else fi
+            owner[id(child)] = here
+            assign_owner(child, here)
+
+    assign_owner(mod.tree, None)
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and is_pspec_call(mod, node):
+            if any(k.arg is None for k in node.keywords):  # P(*dims) style
+                dims: List[Dim] = [UNRESOLVED]
+            else:
+                dims = [_resolve_dim(a, mod, env, envs) for a in node.args]
+            model.pspec_sites.append(
+                PSpecSite(mod.rel, node.lineno, dims, owner.get(id(node))))
+        elif isinstance(node, ast.Call):
+            _collect_mesh_axes(mod, node, model, env, envs)
+        else:
+            target, value = _assign_parts(node)
+            if target is not None:
+                name = target.id if isinstance(target, ast.Name) else (
+                    dotted(target) or "<rules>")
+                rs = _rule_set_literal(mod, value, name)
+                if rs is not None:
+                    model.rule_sets.append(rs)
+
+
+def _collect_mesh_axes(mod: ModuleInfo, node: ast.Call, model: ShardModel,
+                       env: Dict[str, str],
+                       envs: Dict[str, Dict[str, str]]) -> None:
+    """Axis names declared at ``Mesh(devs, (axes...))`` construction sites
+    extend the vocabulary — planes may carry private axes (``silo_dp``)."""
+    ds = dotted(node.func)
+    if ds is None or ds.split(".")[-1] != "Mesh":
+        return
+    axis_expr: Optional[ast.expr] = None
+    if len(node.args) >= 2:
+        axis_expr = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "axis_names":
+            axis_expr = kw.value
+    if not isinstance(axis_expr, (ast.Tuple, ast.List)):
+        return
+    for elt in axis_expr.elts:
+        atom = _resolve_dim_atom(elt, mod, env, envs)
+        if isinstance(atom, str) and atom != UNRESOLVED:
+            model.mesh_axes.add(atom)
+
+
+def _rule_set_literal(mod: ModuleInfo, value: ast.expr,
+                      name: str) -> Optional[RuleSetSite]:
+    """Recognize ``((pattern, P(...)), ...)`` literals — at least one entry,
+    every entry a 2-tuple of a string literal and a PartitionSpec call."""
+    if not isinstance(value, (ast.Tuple, ast.List)) or not value.elts:
+        return None
+    patterns: List[Tuple[str, int]] = []
+    for elt in value.elts:
+        if not (isinstance(elt, (ast.Tuple, ast.List))
+                and len(elt.elts) == 2):
+            return None
+        pat, spec = elt.elts
+        if not (isinstance(pat, ast.Constant) and isinstance(pat.value, str)):
+            return None
+        if not (isinstance(spec, ast.Call) and is_pspec_call(mod, spec)):
+            return None
+        patterns.append((pat.value, pat.lineno))
+    return RuleSetSite(mod.rel, value.lineno, name, patterns)
+
+
+def enumerate_rule_sets(paths: Sequence[str],
+                        repo_root: str) -> List[RuleSetSite]:
+    """Standalone enumeration of in-code rule-set literals under ``paths``
+    (used by tests to prove the model sees the shipped defaults)."""
+    from ..graftlint.analyzer import collect_files, load_modules
+
+    modules = load_modules(collect_files(paths), repo_root)
+    return build_model(modules).rule_sets

@@ -1,0 +1,335 @@
+"""Sharding rules S001–S004 over the extracted :class:`ShardModel`.
+
+S001 partition-rule coverage (rule-set literals with no ``.*`` catch-all —
+     unmatched leaves silently take the fallback)
+S002 spec validity (axes not in the mesh vocabulary, repeated axes inside
+     one PartitionSpec; dimension divisibility lives in :mod:`hbm` where
+     shapes are known)
+S003 implicit resharding on hot paths (``device_put`` inside traced code;
+     binops over operands constrained to different specs in one function)
+S004 host transfer of sharded arrays (np.asarray/device_get/.item()/float
+     on sharded-placed values inside host-side round loops, and
+     device_get→device_put host round-trips)
+
+Traced-function marking is borrowed from graftlint's analyzer (same jit
+call graph the G-rules use), so "hot path" means the same thing in both
+suites.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..graftlint.analyzer import (
+    Analyzer,
+    FuncInfo,
+    ModuleInfo,
+    _is_jaxish,
+    _is_numpy,
+    _walk_shallow,
+    dotted,
+)
+from .findings import Finding
+from .model import PSpecSite, ShardModel
+
+# host-transfer call names on the HOST side (G001 owns the in-jit variant)
+HOST_PULL_NUMPY = {"asarray", "array"}
+HOST_CASTS = {"float", "int"}
+
+# call-name prefixes whose result is a sharded device placement — the
+# mesh/cheetah planes' placement helpers follow this naming
+PLACE_PREFIXES = ("_place", "shard_batch")
+
+
+def _mk(rule: str, mod: ModuleInfo, line: int, message: str) -> Finding:
+    return Finding(rule=rule, path=mod.rel, line=line, col=0,
+                   message=message, line_text=mod.line_text(line))
+
+
+def check_shard(model: ShardModel, modules: Dict[str, ModuleInfo],
+                lint: Analyzer) -> List[Finding]:
+    by_rel = {m.rel: m for m in modules.values()}
+    findings: List[Finding] = []
+    findings += _check_rule_coverage(model, by_rel)
+    findings += _check_spec_validity(model, by_rel)
+    for mod in modules.values():
+        for fi in mod.funcs_by_node.values():
+            if fi.traced:
+                findings += _check_hot_path(mod, fi, model)
+            else:
+                findings += _check_host_transfers(mod, fi)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# S001 — partition-rule coverage
+# ---------------------------------------------------------------------------
+
+
+def _check_rule_coverage(model: ShardModel, by_rel) -> List[Finding]:
+    findings: List[Finding] = []
+    for rs in model.rule_sets:
+        mod = by_rel.get(rs.rel)
+        if mod is None:
+            continue
+        idx = rs.catch_all_index()
+        if idx is None:
+            pats = ", ".join(repr(p) for p, _l in rs.patterns)
+            findings.append(_mk(
+                "S001", mod, rs.line,
+                f"partition rule set {rs.name} ({pats}) has no catch-all "
+                "— a leaf no pattern matches silently takes the fallback "
+                "(match_partition_rules defaults to replicate); add an "
+                "explicit '.*' terminal rule so every leaf's placement is "
+                "a decision, not an accident"))
+        elif idx != len(rs.patterns) - 1:
+            # first-match-wins: everything after the catch-all is dead
+            dead = [repr(p) for p, _l in rs.patterns[idx + 1:]]
+            findings.append(_mk(
+                "S001", mod, rs.patterns[idx][1],
+                f"partition rule set {rs.name}: catch-all pattern "
+                f"{rs.patterns[idx][0]!r} at position {idx} shadows the "
+                f"{len(dead)} later rule(s) ({', '.join(dead)}) — "
+                "first match wins, so they can never apply; move the "
+                "catch-all last"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# S002 — spec validity
+# ---------------------------------------------------------------------------
+
+
+def _check_spec_validity(model: ShardModel, by_rel) -> List[Finding]:
+    findings: List[Finding] = []
+    vocab = model.vocabulary
+    for site in model.pspec_sites:
+        mod = by_rel.get(site.rel)
+        if mod is None:
+            continue
+        axes = site.axes()
+        for ax in axes:
+            if ax not in vocab:
+                findings.append(_mk(
+                    "S002", mod, site.line,
+                    f"PartitionSpec names axis {ax!r}, which is not a mesh "
+                    f"axis (known: {', '.join(sorted(vocab))}) — "
+                    "make_shardings raises on this spec the first time a "
+                    "leaf matches it"))
+        seen: Set[str] = set()
+        for ax in axes:
+            if ax in seen:
+                findings.append(_mk(
+                    "S002", mod, site.line,
+                    f"PartitionSpec repeats axis {ax!r} — a mesh axis may "
+                    "shard at most one dimension of a value; XLA rejects "
+                    "the duplicate at lowering time"))
+                break
+            seen.add(ax)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# S003 — implicit resharding on hot (traced) paths
+# ---------------------------------------------------------------------------
+
+
+def _is_named_call(mod: ModuleInfo, node: ast.Call, name: str) -> bool:
+    ds = dotted(node.func)
+    if ds is None:
+        return False
+    parts = ds.split(".")
+    if parts[-1] != name:
+        return False
+    if len(parts) == 1:
+        imp = mod.from_imports.get(name)
+        return bool(imp and imp[0].startswith("jax"))
+    return _is_jaxish(mod, parts[0])
+
+
+def _check_hot_path(mod: ModuleInfo, fi: FuncInfo,
+                    model: ShardModel) -> List[Finding]:
+    findings: List[Finding] = []
+    # specs constrained onto locals: x = with_sharding_constraint(y, spec)
+    constrained: Dict[str, Optional[tuple]] = {}
+    specs_by_line: Dict[int, PSpecSite] = {
+        s.line: s for s in model.pspec_sites if s.rel == mod.rel}
+
+    def spec_signature(expr: ast.expr) -> Optional[tuple]:
+        """P(...) or NamedSharding(mesh, P(...)) -> canonical layout."""
+        if isinstance(expr, ast.Call):
+            ds = dotted(expr.func)
+            last = ds.split(".")[-1] if ds else ""
+            if last == "NamedSharding" and len(expr.args) == 2:
+                return spec_signature(expr.args[1])
+            site = specs_by_line.get(expr.lineno)
+            if site is not None:
+                return site.signature()
+        return None
+
+    for node in _walk_shallow(fi.node):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_named_call(mod, node, "device_put"):
+            findings.append(_mk(
+                "S003", mod, node.lineno,
+                f"device_put inside traced code ({fi.qualname}) — a "
+                "cross-device copy compiled into the hot path; place "
+                "inputs before the jit boundary (or use "
+                "with_sharding_constraint, which lets XLA fuse the "
+                "layout change)"))
+
+    for node in _walk_shallow(fi.node):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            call = node.value
+            ds = dotted(call.func)
+            if (ds and ds.split(".")[-1] == "with_sharding_constraint"
+                    and len(call.args) >= 2):
+                constrained[node.targets[0].id] = spec_signature(
+                    call.args[1])
+
+    for node in _walk_shallow(fi.node):
+        if not isinstance(node, ast.BinOp):
+            continue
+        left, right = node.left, node.right
+        if not (isinstance(left, ast.Name) and isinstance(right, ast.Name)):
+            continue
+        ls = constrained.get(left.id)
+        rs = constrained.get(right.id)
+        if ls is not None and rs is not None and ls != rs:
+            findings.append(_mk(
+                "S003", mod, node.lineno,
+                f"binop combines {left.id!r} (constrained to {ls}) with "
+                f"{right.id!r} (constrained to {rs}) — XLA inserts a "
+                "hidden all-gather/reshard to reconcile the layouts on "
+                "every step; constrain both operands to one spec first"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# S004 — host transfer of sharded arrays
+# ---------------------------------------------------------------------------
+
+
+def _taints_sharded(mod: ModuleInfo, call: ast.Call) -> bool:
+    """Calls whose result is a sharded device placement."""
+    ds = dotted(call.func)
+    if ds is None:
+        return False
+    last = ds.split(".")[-1]
+    if last == "device_put" and len(call.args) >= 2 and (
+            _is_named_call(mod, call, "device_put")):
+        return True
+    return any(last.startswith(p) or last == p for p in PLACE_PREFIXES)
+
+
+def _contains_device_get(mod: ModuleInfo, expr: ast.expr) -> Optional[int]:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and _is_named_call(mod, node,
+                                                         "device_get"):
+            return node.lineno
+    return None
+
+
+def _check_host_transfers(mod: ModuleInfo, fi: FuncInfo) -> List[Finding]:
+    findings: List[Finding] = []
+    sharded: Set[str] = set()
+    host_pulled: Dict[str, int] = {}  # name -> device_get line
+
+    for node in _walk_shallow(fi.node):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        targets: List[str] = []
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                targets.append(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                targets += [e.id for e in t.elts if isinstance(e, ast.Name)]
+        if not targets:
+            continue
+        if isinstance(value, ast.Call) and _taints_sharded(mod, value):
+            sharded.update(targets)
+        get_line = _contains_device_get(mod, value)
+        if get_line is not None:
+            for t in targets:
+                host_pulled[t] = get_line
+
+    # (a) device_get -> device_put round-trip: the host hop is pure waste —
+    # device_put reshards device-to-device without staging through host
+    for node in _walk_shallow(fi.node):
+        if not (isinstance(node, ast.Call)
+                and _is_named_call(mod, node, "device_put")
+                and node.args):
+            continue
+        arg = node.args[0]
+        pulled = _contains_device_get(mod, arg)
+        if pulled is None:
+            base = arg
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            if isinstance(base, ast.Name) and base.id in host_pulled:
+                pulled = host_pulled[base.id]
+        if pulled is not None:
+            findings.append(_mk(
+                "S004", mod, node.lineno,
+                "device_put of a device_get result — a host round-trip "
+                f"(gather to host at line {pulled}, re-upload here); "
+                "device_put accepts device arrays directly and reshards "
+                "device-to-device"))
+
+    # (b) host pulls of sharded values inside loops (nested loops reach the
+    # same call through every enclosing level — report each site once)
+    loops = [n for n in _walk_shallow(fi.node)
+             if isinstance(n, (ast.For, ast.While))]
+    seen: Set[tuple] = set()
+    for loop in loops:
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            tainted = _transfer_target(mod, node, sharded)
+            if tainted is not None and (node.lineno, node.col_offset,
+                                        tainted) not in seen:
+                seen.add((node.lineno, node.col_offset, tainted))
+                findings.append(_mk(
+                    "S004", mod, node.lineno,
+                    f"host transfer of sharded array {tainted!r} inside a "
+                    "round loop — every iteration gathers all shards over "
+                    "ICI to one host; keep the value on device and pull "
+                    "one reduced scalar after the loop"))
+    return findings
+
+
+def _transfer_target(mod: ModuleInfo, node: ast.Call,
+                     sharded: Set[str]) -> Optional[str]:
+    """The sharded local this call pulls to host, if any."""
+
+    def first_arg_name() -> Optional[str]:
+        if node.args and isinstance(node.args[0], ast.Name):
+            return node.args[0].id
+        return None
+
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        if func.attr == "item" and isinstance(func.value, ast.Name):
+            return func.value.id if func.value.id in sharded else None
+        ds = dotted(func)
+        if ds is not None:
+            head, last = ds.split(".")[0], ds.split(".")[-1]
+            name = first_arg_name()
+            if name in sharded and (
+                    (last in HOST_PULL_NUMPY and _is_numpy(mod, head))
+                    or (last == "device_get" and _is_jaxish(mod, head))):
+                return name
+    elif isinstance(func, ast.Name):
+        name = first_arg_name()
+        if name in sharded:
+            if func.id in HOST_CASTS:
+                return name
+            imp = mod.from_imports.get(func.id)
+            if func.id == "device_get" and imp and imp[0].startswith("jax"):
+                return name
+    return None

@@ -1,17 +1,20 @@
 #!/usr/bin/env bash
-# CI smoke for BOTH static-analysis gates:
+# CI smoke for ALL THREE static-analysis gates:
 #  - graftlint  (G001–G005, JAX trace/donation/recompile/thread safety)
 #  - graftproto (P001–P009, comm-plane protocol + lock-order verification)
+#  - graftshard (S001–S005, sharding/HBM verification of the TPU
+#                execution plane)
 # The shipped tree must have ZERO non-baselined findings in each suite
-# (tools/<suite>/baseline.json holds the suppressed-but-visible debt), the
-# JSON reports must parse, and each gate must bite on a known-bad fixture.
+# (tools/<suite>/baseline.json holds the suppressed-but-visible debt —
+# graftshard's ships EMPTY), the JSON reports must parse, and each gate
+# must bite on a known-bad fixture.
 #
-# Exit-code contract (both suites): 0 clean, 1 findings, 2 analyzer crash —
+# Exit-code contract (all suites): 0 clean, 1 findings, 2 analyzer crash —
 # a CI failure here is diagnosable at a glance.
 #
 # This is the cheap half of the tier-1 lint gate (tests/test_graftlint.py +
-# tests/test_graftproto.py are the full ones): pure-AST, no jax import,
-# sub-second.
+# tests/test_graftproto.py + tests/test_graftshard.py are the full ones):
+# pure-AST, no jax import, sub-second.
 #
 # Usage: tools/lint_smoke.sh          (CI: exits non-zero on any regression)
 set -uo pipefail
@@ -86,6 +89,40 @@ fi
 if python -m tools.graftproto tests/fixtures/graftproto/p008_bad.py \
         --no-baseline >/dev/null 2>&1; then
     echo "lint_smoke: FAIL — graftproto passed a known-bad fixture" >&2
+    exit 1
+fi
+
+# ---- graftshard: the sharding pass, machine-readable -----------------------
+shard_out=$(timeout -k 10 120 python -m tools.graftshard fedml_tpu/ --json)
+rc=$?
+
+if [ "$rc" -ne 0 ]; then
+    echo "lint_smoke: FAIL — graftshard exited rc=$rc" >&2
+    printf '%s\n' "$shard_out" >&2
+    exit 1
+fi
+
+python - "$shard_out" <<'EOF'
+import json
+import sys
+
+payload = json.loads(sys.argv[1])
+assert payload["exit_code"] == 0, payload
+assert payload["findings"] == [], payload["findings"]
+# graftshard is the one suite whose baseline must stay EMPTY: the
+# execution plane ships fully clean, debt is fixed not suppressed
+assert payload["baselined"] == 0, payload
+print(f"lint_smoke: graftshard OK — 0 findings (baseline empty)")
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "lint_smoke: FAIL — graftshard JSON output did not validate" >&2
+    exit 1
+fi
+
+if python -m tools.graftshard tests/fixtures/graftshard/s002_bad.py \
+        --no-baseline >/dev/null 2>&1; then
+    echo "lint_smoke: FAIL — graftshard passed a known-bad fixture" >&2
     exit 1
 fi
 
