@@ -96,6 +96,21 @@ MILLION_OVERRIDES = dict(
 MILLION_REGISTRY_N = 1_000_000
 MILLION_COHORT_K = 10_000
 
+# Delta-delivery leg (fedml_tpu/delivery/ — ISSUE 9, docs/delivery.md):
+# the SAME cross-silo federation twice — full pytrees vs the delta plane
+# (EF-top-k C2S deltas decoded against the version store + lossless sparse
+# S2C delta frames) — and reports steady-state comm bytes per round for
+# both, the reduction factor, and accuracy at parity. mnist-lr is the
+# deliberate shape: big enough (7,850 params, ~31 KB/frame) that frame
+# headers don't dominate, small enough to run in seconds on a CPU host.
+COMPRESSED_OVERRIDES = dict(
+    training_type="cross_silo", dataset="mnist", model="lr",
+    client_num_in_total=4, client_num_per_round=4, epochs=1, batch_size=32,
+    learning_rate=0.05, backend="LOOPBACK", frequency_of_the_test=1,
+    random_seed=0,
+)
+COMPRESSED_SCHEME = dict(compression="eftopk", compression_ratio=0.01)
+
 # The flagship is the PRODUCT shape: Llama-standard head_dim 128 with GQA
 # 16q/4kv on a wide-shallow d2048 x 8L body — chosen product-shape-first,
 # not max-MFU-first. Two levers got it to 75.7% MFU on the v5e
@@ -165,6 +180,12 @@ _MILLION_SOURCES = [
     "fedml_tpu/scale/registry.py", "fedml_tpu/scale/cohort_engine.py",
     "fedml_tpu/scale/prefetch.py", "fedml_tpu/simulation/sp_api.py",
     "fedml_tpu/simulation/round_engine.py", "bench.py",
+]
+_COMPRESSED_SOURCES = [
+    "fedml_tpu/delivery/model_store.py", "fedml_tpu/delivery/delta_codec.py",
+    "fedml_tpu/core/compression.py", "fedml_tpu/cross_silo/server_manager.py",
+    "fedml_tpu/cross_silo/client_manager.py",
+    "fedml_tpu/core/distributed/message.py", "bench.py",
 ]
 
 
@@ -476,6 +497,96 @@ def bench_million_client() -> dict:
     }
 
 
+def bench_compressed_round() -> dict:
+    """Delta-delivery leg (ISSUE 9): steady-state ``comm.bytes`` per round,
+    full pytrees vs the delta plane, at parity accuracy.
+
+    Per-round bytes are measured MARGINALLY — each config runs a short and
+    a long federation and reports ``(bytes_long − bytes_short) / Δrounds``
+    — so the INIT/FINISH full-model frames (identical in both configs)
+    cancel instead of diluting the reduction factor. The acceptance gate
+    (``tools/bench_smoke.sh``): the delta path engages (S2C delta frames +
+    C2S delta decodes both nonzero) and bytes drop ≥10x with final
+    accuracy within 0.05 of the uncompressed run.
+    """
+    _maybe_force_platform()
+    import threading
+
+    import jax
+
+    import fedml_tpu as fedml
+    from fedml_tpu import data as data_mod
+    from fedml_tpu import models as model_mod
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core.mlops import telemetry
+
+    def run_world(run_id, rounds, extra):
+        from fedml_tpu.cross_silo import (
+            FedMLCrossSiloClient,
+            FedMLCrossSiloServer,
+        )
+
+        def mk(role, rank=0):
+            over = dict(COMPRESSED_OVERRIDES, comm_round=rounds, role=role,
+                        rank=rank, run_id=run_id, **extra)
+            return fedml.init(Arguments(overrides=over),
+                              should_init_logs=False)
+
+        args_s = mk("server")
+        ds, od = data_mod.load(args_s)
+        bundle = model_mod.create(args_s, od)
+        server = FedMLCrossSiloServer(args_s, None, ds, bundle)
+        n = int(COMPRESSED_OVERRIDES["client_num_in_total"])
+        clients = [FedMLCrossSiloClient(mk("client", r), None, ds, bundle)
+                   for r in range(1, n + 1)]
+        threads = [threading.Thread(target=c.run, daemon=True)
+                   for c in clients]
+        for t in threads:
+            t.start()
+        result = server.run()
+        for t in threads:
+            t.join(timeout=60)
+        return result
+
+    reg = telemetry.registry()
+    short_r, long_r = 2, 10
+    per_round, accs = {}, {}
+    for tag, extra in (("uncompressed", dict(compression="", s2c_delta="off")),
+                       ("compressed", dict(COMPRESSED_SCHEME))):
+        b0 = reg.counter("comm.bytes_sent")
+        run_world(f"bench-delta-{tag}-short-{os.getpid()}", short_r, extra)
+        b_short = reg.counter("comm.bytes_sent") - b0
+        b1 = reg.counter("comm.bytes_sent")
+        res = run_world(f"bench-delta-{tag}-long-{os.getpid()}", long_r,
+                        extra)
+        b_long = reg.counter("comm.bytes_sent") - b1
+        per_round[tag] = (b_long - b_short) / float(long_r - short_r)
+        accs[tag] = float(res["test_acc"]) if res else 0.0
+
+    counters = reg.snapshot()["counters"]
+    reduction = (per_round["uncompressed"] / per_round["compressed"]
+                 if per_round["compressed"] else 0.0)
+    return {
+        "compressed_bytes_per_round": round(per_round["compressed"], 1),
+        "uncompressed_bytes_per_round": round(per_round["uncompressed"], 1),
+        "compressed_reduction_x": round(reduction, 2),
+        "compressed_acc": round(accs["compressed"], 4),
+        "uncompressed_acc": round(accs["uncompressed"], 4),
+        "compressed_scheme": "{compression}@{compression_ratio}".format(
+            **COMPRESSED_SCHEME),
+        "compressed_s2c_delta_frames": int(
+            counters.get("comm.delta.s2c_delta_frames", 0)),
+        "compressed_c2s_delta_decodes": int(
+            counters.get("comm.delta.c2s_delta_decodes", 0)),
+        "compressed_s2c_bytes_saved": int(
+            counters.get("comm.delta.s2c_bytes_saved", 0)),
+        "compressed_c2s_bytes_saved": int(
+            counters.get("comm.delta.c2s_bytes_saved", 0)),
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+    }
+
+
 def bench_cheetah() -> dict:
     """Single-chip flagship-transformer pretrain throughput + MFU."""
     import gc
@@ -702,6 +813,13 @@ def _translate_million(parsed: dict):
     return out, platform
 
 
+def _translate_compressed(parsed: dict):
+    platform = parsed.pop("platform", None)
+    out = {"compressed_device_kind": parsed.pop("device_kind", None),
+           **parsed}
+    return out, platform
+
+
 def leg_specs() -> list:
     """(name, argv, digest, translate) per leg, priority order: the headline
     FedAvg metric first, then the flagship, then the secondary shapes."""
@@ -716,6 +834,9 @@ def leg_specs() -> list:
         ("fedavg_million_client", [py, me, "--leg", "million"],
          _digest({"cfg": MILLION_OVERRIDES, "n": million_n, "k": million_k},
                  _MILLION_SOURCES), _translate_million),
+        ("fedavg_compressed_round", [py, me, "--leg", "compressed"],
+         _digest({"cfg": COMPRESSED_OVERRIDES, "scheme": COMPRESSED_SCHEME},
+                 _COMPRESSED_SOURCES), _translate_compressed),
         ("cheetah", [py, me, "--leg", "cheetah"],
          _digest({"base": CHEETAH_BASE, "ladder": CHEETAH_LADDER,
                   "run": CHEETAH_RUN}, _CHEETAH_SOURCES), _translate_cheetah),
@@ -905,7 +1026,8 @@ def run_legs(budget_s: float, ttl_s: float, min_leg_s: float = 240.0,
 def main() -> None:
     if len(sys.argv) > 2 and sys.argv[1] == "--leg":
         fn = {"fedavg": bench_fedavg, "cheetah": bench_cheetah,
-              "million": bench_million_client}[sys.argv[2]]
+              "million": bench_million_client,
+              "compressed": bench_compressed_round}[sys.argv[2]]
         print(json.dumps(fn()), flush=True)
         return
     budget = float(os.environ.get("BENCH_BUDGET_S", "2400"))

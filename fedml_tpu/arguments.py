@@ -188,7 +188,44 @@ _SCHEMA: Dict[str, tuple] = {
     "async_admit_rate": (float, 0.0),
     "async_admit_burst": (int, 0),
     "async_queue_limit": (int, 0),
+    # delta delivery plane (fedml_tpu/delivery/ — docs/delivery.md).
+    # C2S update compression (core/compression.UpdateCodec): "" = off;
+    # topk | eftopk | qsgd | quantize, with the scheme knobs below. Deltas
+    # decode against the version-indexed model store, so compression now
+    # composes with aggregation_mode=async.
+    "compression": (str, ""),
+    "compression_ratio": (float, 0.1),
+    "quantize_bits": (int, 8),
+    "qsgd_levels": (int, 256),
+    # S2C delta shipping: auto (default — codec-encoded LOSSLESS delta
+    # against the client's last-ACKed version whenever that base is still
+    # in the store, loud full-frame fallback otherwise) | off
+    "s2c_delta": (str, "auto"),
+    # bounded ring of committed global versions both wire ends keep
+    # (VersionedModelStore capacity); also bounds how stale a compressed
+    # C2S delta can be and still decode
+    "delta_store_versions": (int, 8),
+    # adapter-only payloads: regex over named pytree leaves (the
+    # scale/partition_rules naming); matching leaves ride the C2S wire,
+    # the rest stay frozen at the server's global. "" = full payloads.
+    "payload_filter": (str, ""),
+    # FedBuff dispatch policy (aggregation_mode=async): sync_on_consume
+    # (dispatch to a step's contributors — the FedBuff default) |
+    # server_push (push every version bump to all live clients) |
+    # client_pull (clients request via c2s_pull_request; the server
+    # answers when the version advances)
+    "async_dispatch": (str, "sync_on_consume"),
+    # gRPC wire format: raw (zero-copy tensor frames, the default) | npz
+    # (the self-describing fallback; mixed worlds interoperate — decode
+    # sniffs the body magic)
+    "grpc_wire_format": (str, "raw"),
+    # gRPC rank→port multiplexing: N ranks share one port/server process
+    # (port = comm_port + ceil(rank / N)); 1 = legacy port-per-rank
+    "grpc_ranks_per_port": (int, 1),
 }
+
+COMPRESSION_SCHEMES = ("", "topk", "eftopk", "qsgd", "quantize")
+ASYNC_DISPATCH_POLICIES = ("sync_on_consume", "server_push", "client_pull")
 
 
 class Arguments:
@@ -312,6 +349,48 @@ class Arguments:
                              "async_admit_burst"):
             if float(getattr(self, non_negative, 0) or 0) < 0:
                 raise ValueError(f"{non_negative} must be >= 0")
+        # delta delivery plane (docs/delivery.md)
+        scheme = str(getattr(self, "compression", "") or "").lower()
+        if scheme not in COMPRESSION_SCHEMES:
+            raise ValueError(
+                f"compression must be one of {COMPRESSION_SCHEMES}, "
+                f"got {scheme!r}"
+            )
+        s2c = str(getattr(self, "s2c_delta", "auto") or "auto").lower()
+        if s2c not in ("auto", "off"):
+            raise ValueError(f"s2c_delta must be auto|off, got {s2c!r}")
+        if int(getattr(self, "delta_store_versions", 8) or 0) < 1:
+            raise ValueError("delta_store_versions must be >= 1")
+        dispatch = str(
+            getattr(self, "async_dispatch", "sync_on_consume")
+            or "sync_on_consume").lower()
+        if dispatch not in ASYNC_DISPATCH_POLICIES:
+            raise ValueError(
+                f"async_dispatch must be one of {ASYNC_DISPATCH_POLICIES}, "
+                f"got {dispatch!r}"
+            )
+        if dispatch != "sync_on_consume" and mode.lower() != "async":
+            raise ValueError(
+                f"async_dispatch={dispatch} is a FedBuff dispatch policy — "
+                "it requires aggregation_mode=async"
+            )
+        pattern = str(getattr(self, "payload_filter", "") or "")
+        if pattern:
+            import re as _re
+
+            try:
+                _re.compile(pattern)
+            except _re.error as e:
+                raise ValueError(
+                    f"bad payload_filter regex {pattern!r}: {e}") from None
+        if str(getattr(self, "grpc_wire_format", "raw")).lower() not in (
+                "raw", "npz"):
+            raise ValueError(
+                f"grpc_wire_format must be raw|npz, got "
+                f"{getattr(self, 'grpc_wire_format')!r}"
+            )
+        if int(getattr(self, "grpc_ranks_per_port", 1) or 1) < 1:
+            raise ValueError("grpc_ranks_per_port must be >= 1")
         for positive in ("batch_size", "comm_round", "epochs"):
             if getattr(self, positive) <= 0:
                 raise ValueError(f"{positive} must be positive")
@@ -450,6 +529,56 @@ def add_args() -> argparse.Namespace:
         "--async_queue_limit", type=int, default=None,
         help="bounded fold-queue depth; overflow is shed with retry-after "
         "(0 = 4x buffer size)",
+    )
+    # delta delivery plane (fedml_tpu/delivery/ — docs/delivery.md)
+    parser.add_argument(
+        "--compression", type=str, default=None,
+        choices=("", "topk", "eftopk", "qsgd", "quantize"),
+        help="C2S update compression scheme; deltas decode against the "
+        "version-indexed model store (composes with async aggregation)",
+    )
+    parser.add_argument(
+        "--compression_ratio", type=float, default=None,
+        help="top-k fraction kept by topk/eftopk",
+    )
+    parser.add_argument(
+        "--quantize_bits", type=int, default=None,
+        help="bit width for --compression quantize",
+    )
+    parser.add_argument(
+        "--qsgd_levels", type=int, default=None,
+        help="quantization levels for --compression qsgd",
+    )
+    parser.add_argument(
+        "--s2c_delta", type=str, default=None, choices=("auto", "off"),
+        help="S2C sync frames: auto ships a lossless delta against the "
+        "client's last-ACKed version (full-frame fallback on store "
+        "eviction); off always broadcasts full models",
+    )
+    parser.add_argument(
+        "--delta_store_versions", type=int, default=None, metavar="V",
+        help="committed global versions each wire end keeps for delta "
+        "encode/decode (the VersionedModelStore ring size)",
+    )
+    parser.add_argument(
+        "--payload_filter", type=str, default=None, metavar="REGEX",
+        help="adapter-only payloads: leaves whose a/b/c path matches ride "
+        "the C2S wire, the rest stay frozen at the server's global",
+    )
+    parser.add_argument(
+        "--async_dispatch", type=str, default=None,
+        choices=("sync_on_consume", "server_push", "client_pull"),
+        help="FedBuff dispatch policy for aggregation_mode=async",
+    )
+    parser.add_argument(
+        "--grpc_wire_format", type=str, default=None, choices=("raw", "npz"),
+        help="gRPC frame format: raw zero-copy tensor frames (default) or "
+        "the npz fallback",
+    )
+    parser.add_argument(
+        "--grpc_ranks_per_port", type=int, default=None, metavar="N",
+        help="gRPC rank multiplexing: N ranks share one port/server "
+        "(1 = legacy port-per-rank)",
     )
     # telemetry plane (defaults None so YAML keys win when the flag is absent)
     parser.add_argument(

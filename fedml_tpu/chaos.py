@@ -46,13 +46,30 @@ REPORT_FILE = "chaos_report.json"
 
 
 def _world_overrides(a) -> Dict:
-    return dict(
+    over = dict(
         training_type="cross_silo", dataset="synthetic", model="lr",
         client_num_in_total=int(a.clients), client_num_per_round=int(a.clients),
         comm_round=int(a.rounds), epochs=int(a.epochs), batch_size=8,
         learning_rate=0.2, backend="LOOPBACK", frequency_of_the_test=1000,
         random_seed=int(a.seed),
     )
+    scheme = str(getattr(a, "compression", "") or "")
+    if scheme:
+        # BOTH legs (reference and chaos) run compressed + delta-shipped:
+        # the bitwise verdict then proves dedup, payload digests and the
+        # version store survive delta frames under faults. Stateless
+        # schemes only — eftopk's client-side residual dies with the
+        # killed process and would legitimately diverge the resumed leg.
+        if scheme == "eftopk":
+            raise ValueError(
+                "chaos --compression eftopk cannot hold bitwise parity "
+                "across a kill/restart (client residual state is lost); "
+                "use topk/quantize/qsgd"
+            )
+        over.update(compression=scheme,
+                    compression_ratio=float(
+                        getattr(a, "compression_ratio", 0.1)))
+    return over
 
 
 def build_fault_plan(rank: int, seed: int, loss: float, duplicate: float,
@@ -129,6 +146,9 @@ def run_world(a, run_id: str, checkpoint_dir: str, faulty: bool,
                 "--epochs", str(a.epochs), "--seed", str(a.seed),
                 "--loss", str(a.loss), "--duplicate", str(a.duplicate),
                 "--corrupt", str(a.corrupt),
+                "--compression", str(getattr(a, "compression", "") or ""),
+                "--compression_ratio",
+                str(getattr(a, "compression_ratio", 0.1)),
             ))
     else:
         for rank in range(1, int(a.clients) + 1):
@@ -232,6 +252,8 @@ def _worker_cmd(a, out: str, ckpt_dir: str, kill_round: int) -> List[str]:
         "--checkpoint_rounds", str(a.checkpoint_rounds),
         "--kill-round", str(kill_round),
         "--transport", str(getattr(a, "transport", "loopback")),
+        "--compression", str(getattr(a, "compression", "") or ""),
+        "--compression_ratio", str(getattr(a, "compression_ratio", 0.1)),
     ]
 
 

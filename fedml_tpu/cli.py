@@ -184,7 +184,38 @@ def cmd_top(args) -> int:
             print(f"\ncompilation cache: {hits:.0f} hits / "
                   f"{misses:.0f} misses")
         _print_traffic_summary(metrics)
+        _print_delta_summary(metrics)
     return 0
+
+
+def _print_delta_summary(metrics: dict) -> None:
+    """The delta delivery plane's wire story (comm.delta.* family,
+    docs/delivery.md): delta hit rate and bytes saved per direction, plus
+    the version store's occupancy/eviction health. Silent when the plane
+    never engaged (no delta frame, no compressed decode)."""
+    counters = metrics.get("counters", {})
+    gauges = metrics.get("gauges", {})
+    s2c_delta = counters.get("comm.delta.s2c_delta_frames", 0)
+    s2c_full = counters.get("comm.delta.s2c_full_frames", 0)
+    c2s_decodes = counters.get("comm.delta.c2s_delta_decodes", 0)
+    if not (s2c_delta or c2s_decodes):
+        return
+    print("\ndelivery plane (delta shipping):")
+    total = s2c_delta + s2c_full
+    rate = s2c_delta / total if total else 0.0
+    print(f"  s2c: {s2c_delta:.0f} delta / {s2c_full:.0f} full frames   "
+          f"delta hit rate {rate:.2f}   "
+          f"saved {counters.get('comm.delta.s2c_bytes_saved', 0) / 1e6:.2f} "
+          "MB")
+    print(f"  c2s: {c2s_decodes:.0f} delta decodes   saved "
+          f"{counters.get('comm.delta.c2s_bytes_saved', 0) / 1e6:.2f} MB   "
+          f"base-missing drops "
+          f"{counters.get('comm.delta.c2s_base_missing', 0):.0f}")
+    occ = gauges.get("comm.delta.server_store.occupancy")
+    ev = counters.get("comm.delta.server_store.evictions", 0)
+    if occ is not None or ev:
+        print(f"  store: occupancy {occ if occ is not None else 0:.0f}   "
+              f"evictions {ev:.0f}")
 
 
 def _print_traffic_summary(metrics: dict) -> None:
@@ -605,6 +636,14 @@ def main(argv=None) -> int:
     p_chaos.add_argument("--kill-round", type=int, default=1, metavar="R",
                          help="self-SIGTERM once the ledger commits round R "
                          "(-1 disables the kill)")
+    p_chaos.add_argument("--compression", default="",
+                         choices=("", "topk", "quantize", "qsgd"),
+                         help="run BOTH legs with this C2S update "
+                         "compression: dedup + digests must survive delta "
+                         "frames bitwise (stateless schemes only — eftopk's "
+                         "client residual does not survive a restart)")
+    p_chaos.add_argument("--compression_ratio", type=float, default=0.1,
+                         help="top-k fraction for --compression topk")
     p_chaos.add_argument("--checkpoint_rounds", type=int, default=1)
     p_chaos.add_argument("--workdir", default="",
                          help="scratch dir (default: a fresh temp dir)")
@@ -666,6 +705,10 @@ def main(argv=None) -> int:
                          default="loopback")
     p_swarm.add_argument("--procs", type=int, default=2,
                          help="device-host processes (grpc backend)")
+    p_swarm.add_argument("--ranks_per_port", type=int, default=0,
+                         help="gRPC rank→port multiplexing: N device ranks "
+                         "share one port/server (0 = auto: one port per "
+                         "device-host process; 1 = legacy port-per-rank)")
     p_swarm.add_argument("--port", type=int, default=18950,
                          help="gRPC base port")
     p_swarm.add_argument("--timeout", type=float, default=300.0)

@@ -244,25 +244,31 @@ class FedMLCommManager(Observer):
             self.com_manager = LoopbackCommManager(self.rank, self.size, world)
         elif self.backend == constants.COMM_BACKEND_GRPC:
             from .base_com_manager import CommunicationConstants
-            from .grpc_backend import GRPCCommManager
+            from .grpc_backend import GRPCCommManager, port_for_rank
 
             base_port = int(
                 getattr(self.args, "comm_port", CommunicationConstants.GRPC_BASE_PORT)
             )
+            ranks_per_port = int(
+                getattr(self.args, "grpc_ranks_per_port", 1) or 1)
             self.com_manager = GRPCCommManager(
                 host=str(getattr(self.args, "comm_host", "0.0.0.0")),
-                port=base_port + self.rank,
+                port=port_for_rank(base_port, self.rank, ranks_per_port),
                 rank=self.rank,
                 world_size=self.size,
                 ip_config_path=str(getattr(self.args, "grpc_ipconfig_path", "")),
                 base_port=base_port,
                 # TRPC-role fast path (tensor_transport.py): raw zero-copy
-                # frames + chunked streaming for bulk tensors
-                wire_format=str(getattr(self.args, "grpc_wire_format", "npz")),
+                # frames + chunked streaming for bulk tensors is the
+                # DEFAULT since ISSUE 9; "npz" stays as the explicit
+                # self-describing fallback (mixed worlds interoperate —
+                # decode sniffs the body magic)
+                wire_format=str(getattr(self.args, "grpc_wire_format", "raw")),
                 stream_threshold_bytes=int(getattr(
                     self.args, "grpc_stream_threshold_bytes", 8 * 1024 * 1024
                 )),
                 retry_policy=self._retry_policy,
+                ranks_per_port=ranks_per_port,
             )
         elif self.backend == constants.COMM_BACKEND_MQTT:
             from .mqtt_backend import MqttCommManager

@@ -4,13 +4,19 @@ reference: ``core/distributed/communication/grpc/grpc_comm_manager.py:30-177``
 — one gRPC server per node at base_port+rank, static CSV ip table, 1 GB max
 message, pickled Message in a proto bytes field. Differences here:
 - no protoc/codegen: a generic bytes-in/bytes-out unary handler (the wire
-  format is ``Message.serialize`` — JSON header + npz arrays, no pickle)
+  format is ``Message.serialize`` — raw zero-copy tensor frames by default,
+  npz as the self-describing fallback; no pickle either way)
 - a persistent channel per peer (the reference dials a fresh channel per send)
+- rank→port multiplexing (``grpc_ranks_per_port``): N ranks share ONE
+  port / gRPC server per process (:class:`_SharedGrpcServer` routes frames
+  by the header's receiver id), lifting the port-per-rank cap that bounded
+  how many device processes one machine could host in the swarm harness
 """
 
 from __future__ import annotations
 
 import csv
+import json
 import logging
 import queue
 import threading
@@ -24,6 +30,20 @@ from .base_com_manager import BaseCommunicationManager, CommunicationConstants, 
 from .message import Message
 
 logger = logging.getLogger(__name__)
+
+
+def port_for_rank(base_port: int, rank: int, ranks_per_port: int = 1) -> int:
+    """The one rank→port mapping both bind and dial use.
+
+    ``ranks_per_port=1`` is the legacy port-per-rank layout
+    (``base_port + rank``). With N > 1, blocks of N consecutive client
+    ranks share a port: rank 0 (the server) keeps ``base_port``, ranks
+    ``1..N`` map to ``base_port + 1``, ``N+1..2N`` to ``base_port + 2`` —
+    matching the swarm harness's contiguous rank-block process assignment,
+    so each device-host process binds exactly one port however many device
+    ranks it hosts."""
+    n = max(int(ranks_per_port), 1)
+    return int(base_port) + (int(rank) + n - 1) // n
 
 # transient status codes worth re-sending: a peer mid-restart (crash-drop
 # recovery, rolling deploy) costs backoff + a counter bump instead of a
@@ -54,51 +74,62 @@ def load_ip_config(path: str) -> Dict[int, str]:
     return table
 
 
-class GRPCCommManager(BaseCommunicationManager):
-    def __init__(
-        self,
-        host: str,
-        port: int,
-        rank: int,
-        world_size: int,
-        ip_config: Optional[Dict[int, str]] = None,
-        ip_config_path: str = "",
-        base_port: int = CommunicationConstants.GRPC_BASE_PORT,
-        wire_format: str = "npz",
-        stream_threshold_bytes: int = 8 * 1024 * 1024,
-        retry_policy=None,
-    ):
-        from .delivery import RetryPolicy
+def _peek_receiver(data: bytes) -> Optional[int]:
+    """The frame header's receiver id, parsed without touching the body
+    (the routing key for multiplexed ranks). None on any parse failure —
+    the frame still gets delivered somewhere so the receive loop's
+    corrupt-frame accounting sees it."""
+    try:
+        hlen = int.from_bytes(data[:4], "big")
+        header = json.loads(bytes(data[4:4 + hlen]).decode("utf-8"))
+        return int(header[Message.MSG_ARG_KEY_RECEIVER])
+    except Exception:  # noqa: BLE001 — any malformed header: no route
+        return None
 
-        self.retry_policy = retry_policy or RetryPolicy()
-        self.rank = int(rank)
-        self.world_size = int(world_size)
-        self.base_port = int(base_port)
-        # "raw" = the direct-tensor frame format (tensor_transport.py), the
-        # TRPC-role fast path: zero-copy decode + chunked streaming for
-        # payloads past stream_threshold_bytes (no monolithic gRPC buffer)
-        self.wire_format = str(wire_format)
-        self.stream_threshold = int(stream_threshold_bytes)
-        if ip_config is None and ip_config_path:
-            ip_config = load_ip_config(ip_config_path)
-        self.ip_config = ip_config or {i: "127.0.0.1" for i in range(world_size)}
-        # shared with the receive thread (graftlint G005): the observer list
-        # is snapshotted under its own lock, loop liveness is an Event — a
-        # plain bool write from stop_receive_message() has no happens-before
-        # edge with the loop's read
-        self._observers: List[Observer] = []
-        self._obs_lock = threading.Lock()
-        self._stop_evt = threading.Event()
-        self._queue: "queue.Queue[bytes]" = queue.Queue()
-        self._channels: Dict[int, grpc.Channel] = {}
-        self._stubs: Dict[int, grpc.UnaryUnaryMultiCallable] = {}
-        self._stream_stubs: Dict[int, grpc.StreamUnaryMultiCallable] = {}
-        self._lock = threading.Lock()
+
+class _SharedGrpcServer:
+    """ONE gRPC server per (host, port), shared by every local rank bound
+    there. Each rank registers its raw-bytes receive queue; inbound frames
+    route by the header's receiver id. With ``grpc_ranks_per_port=1``
+    exactly one rank registers per server and routing short-circuits, so
+    the legacy layout pays nothing for the capability."""
+
+    _registry: Dict[str, "_SharedGrpcServer"] = {}
+    _registry_lock = threading.Lock()
+
+    @classmethod
+    def acquire(cls, host: str, port: int, rank: int,
+                q: "queue.Queue[bytes]") -> "_SharedGrpcServer":
+        """Get-or-create the server for (host, port) AND register
+        ``rank``'s queue in one registry-lock critical section — a
+        concurrent last-rank release can never stop the server between
+        the lookup and the registration."""
+        key = f"{host}:{port}"
+        with cls._registry_lock:
+            srv = cls._registry.get(key)
+            if srv is None:
+                # the constructor raises OSError on bind failure; the
+                # entry is only inserted after it returns, so a failed
+                # bind leaves no registry garbage
+                srv = cls(host, port, key)
+                cls._registry[key] = srv
+            srv._register(rank, q)
+            return srv
+
+    @classmethod
+    def server_count(cls) -> int:
+        with cls._registry_lock:
+            return len(cls._registry)
+
+    def __init__(self, host: str, port: int, key: str):
+        self.key = key
+        self._routes_lock = threading.Lock()
+        self._routes: Dict[int, "queue.Queue[bytes]"] = {}
 
         def handle_send(request: bytes, context) -> bytes:
             telemetry.counter_inc("comm.grpc.messages_received")
             telemetry.counter_inc("comm.grpc.bytes_received", len(request))
-            self._queue.put(request)
+            self._route(request)
             return b"ok"
 
         def handle_send_stream(request_iter, context) -> bytes:
@@ -121,7 +152,7 @@ class GRPCCommManager(BaseCommunicationManager):
             data = b"".join(chunks)
             telemetry.counter_inc("comm.grpc.messages_received")
             telemetry.counter_inc("comm.grpc.bytes_received", len(data))
-            self._queue.put(data)
+            self._route(data)
             return b"ok"
 
         handlers = grpc.method_handlers_generic_handler(
@@ -145,16 +176,113 @@ class GRPCCommManager(BaseCommunicationManager):
         self._server.add_generic_rpc_handlers((handlers,))
         bind = f"{host}:{port}"
         # grpc returns 0 (not an exception) when the bind fails — an
-        # unchecked 0 means a server that silently never receives
+        # unchecked 0 means a server that silently never receives.
+        # (acquire() holds the registry lock and inserts the entry only
+        # after this constructor returns, so raising here is clean.)
         if self._server.add_insecure_port(bind) == 0:
             raise OSError(f"grpc backend: could not bind {bind}")
         self._server.start()
-        logger.info("grpc backend: rank %d serving at %s", rank, bind)
+        logger.info("grpc backend: serving at %s", bind)
+
+    def _register(self, rank: int, q: "queue.Queue[bytes]") -> None:
+        """Called by acquire() under the registry lock (lock order:
+        registry → routes, same as release)."""
+        with self._routes_lock:
+            if rank in self._routes:
+                raise ValueError(
+                    f"grpc backend: rank {rank} already registered on "
+                    f"{self.key} — two managers for one rank on one port"
+                )
+            self._routes[rank] = q
+
+    def release(self, rank: int) -> None:
+        """Unregister a rank; the LAST rank out stops the server."""
+        with self._registry_lock:
+            with self._routes_lock:
+                self._routes.pop(rank, None)
+                empty = not self._routes
+            if empty:
+                self._registry.pop(self.key, None)
+        if empty:
+            self._server.stop(grace=0.5)
+
+    def _route(self, data: bytes) -> None:
+        with self._routes_lock:
+            if len(self._routes) == 1:
+                q = next(iter(self._routes.values()))
+            else:
+                receiver = _peek_receiver(data)
+                q = self._routes.get(receiver)
+                if q is None:
+                    # unknown/garbled receiver: deliver to the lowest rank
+                    # so the frame is still counted (corrupt) or logged
+                    # (misrouted) by a real receive loop instead of
+                    # vanishing
+                    telemetry.counter_inc("comm.grpc.misrouted_frames")
+                    if not self._routes:
+                        return
+                    q = self._routes[min(self._routes)]
+        q.put(data)
+
+
+class GRPCCommManager(BaseCommunicationManager):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        rank: int,
+        world_size: int,
+        ip_config: Optional[Dict[int, str]] = None,
+        ip_config_path: str = "",
+        base_port: int = CommunicationConstants.GRPC_BASE_PORT,
+        wire_format: str = "raw",
+        stream_threshold_bytes: int = 8 * 1024 * 1024,
+        retry_policy=None,
+        ranks_per_port: int = 1,
+    ):
+        from .delivery import RetryPolicy
+
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.base_port = int(base_port)
+        # rank→port multiplexing: dial peers through the same mapping the
+        # bind side used (port_for_rank); 1 = legacy port-per-rank
+        self.ranks_per_port = max(int(ranks_per_port), 1)
+        # "raw" = the direct-tensor frame format (tensor_transport.py), the
+        # TRPC-role fast path: zero-copy decode + chunked streaming for
+        # payloads past stream_threshold_bytes (no monolithic gRPC buffer)
+        self.wire_format = str(wire_format)
+        self.stream_threshold = int(stream_threshold_bytes)
+        if ip_config is None and ip_config_path:
+            ip_config = load_ip_config(ip_config_path)
+        self.ip_config = ip_config or {i: "127.0.0.1" for i in range(world_size)}
+        # shared with the receive thread (graftlint G005): the observer list
+        # is snapshotted under its own lock, loop liveness is an Event — a
+        # plain bool write from stop_receive_message() has no happens-before
+        # edge with the loop's read
+        self._observers: List[Observer] = []
+        self._obs_lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._queue: "queue.Queue[bytes]" = queue.Queue()
+        self._channels: Dict[int, grpc.Channel] = {}
+        self._stubs: Dict[int, grpc.UnaryUnaryMultiCallable] = {}
+        self._stream_stubs: Dict[int, grpc.StreamUnaryMultiCallable] = {}
+        self._lock = threading.Lock()
+        # bind through the shared-server registry: ranks mapped to the same
+        # (host, port) share ONE gRPC server, frames route by receiver id
+        # (acquire registers atomically; ValueError on a duplicate rank)
+        self._shared = _SharedGrpcServer.acquire(
+            host, port, self.rank, self._queue)
+        logger.info("grpc backend: rank %d receiving at %s:%d "
+                    "(ranks_per_port=%d)", rank, host, port,
+                    self.ranks_per_port)
 
     def _ensure_channel(self, receiver_id: int) -> None:
         if receiver_id not in self._stubs:
             target = (
-                f"{self.ip_config[receiver_id]}:{self.base_port + receiver_id}"
+                f"{self.ip_config[receiver_id]}:"
+                f"{port_for_rank(self.base_port, receiver_id, self.ranks_per_port)}"
             )
             ch = grpc.insecure_channel(target, options=_GRPC_OPTIONS)
             self._channels[receiver_id] = ch
@@ -240,7 +368,8 @@ class GRPCCommManager(BaseCommunicationManager):
 
     def stop_receive_message(self) -> None:
         self._stop_evt.set()
-        self._server.stop(grace=0.5)
+        # unregister from the shared server; the last rank out stops it
+        self._shared.release(self.rank)
         with self._lock:
             for ch in self._channels.values():
                 ch.close()

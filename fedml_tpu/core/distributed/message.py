@@ -138,6 +138,13 @@ class Message:
                    if self.arrays else 4)
             frame[idx] ^= 0xFF
             frame = bytes(frame)
+        # transport-agnostic wire accounting (counters are always-on):
+        # every backend serializes exactly once per send, so this is THE
+        # per-direction comm.bytes number the delta-delivery bench pins
+        from ..mlops import telemetry
+
+        telemetry.counter_inc("comm.bytes_sent", len(frame))
+        telemetry.counter_inc("comm.frames_sent")
         return frame
 
     @staticmethod

@@ -22,6 +22,10 @@ import numpy as np
 from .. import constants
 from ..core.distributed import FedMLCommManager, Message
 from ..core.dp import FedPrivacyMechanism
+from ..core.mlops import telemetry
+from ..delivery import VersionedModelStore, flatten_leaves
+from ..delivery.delta_codec import DELTA_KEY, DeltaCodec, payload_nbytes
+from ..delivery.payload_filter import filter_from_args
 from .message_define import MyMessage
 
 logger = logging.getLogger(__name__)
@@ -60,11 +64,34 @@ class ClientMasterManager(FedMLCommManager):
             else None
         )
         self._treedef: Optional[object] = None
+        self._shapes: Optional[list] = None
         # wire compression of the C2S update delta (core/compression.UpdateCodec)
         from ..core.compression import UpdateCodec
 
         self.codec = UpdateCodec(args)
         self._round_global_vec = None  # broadcast params, codec reference
+        # -- delta delivery plane (fedml_tpu/delivery/, docs/delivery.md) --
+        # the client end of the version-indexed store: every received
+        # global is kept (flat, host memory) so an S2C delta frame against
+        # any version we ACKed decodes losslessly. s2c_delta=off keeps the
+        # plane fully out of the path (full frames both ways).
+        self._s2c_delta_on = (
+            str(getattr(args, "s2c_delta", "auto") or "auto").lower()
+            != "off"
+        )
+        self._base_store = VersionedModelStore(
+            int(getattr(args, "delta_store_versions", 8) or 8),
+            metric_prefix="comm.delta.client_store",
+        ) if self._s2c_delta_on else None
+        # adapter-only C2S payloads — built with the treedef (needs the
+        # model skeleton for leaf names)
+        self._filter = None
+        self._client_pull = (
+            str(getattr(args, "aggregation_mode", "sync") or "sync").lower()
+            == "async"
+            and str(getattr(args, "async_dispatch", "sync_on_consume")
+                    or "sync_on_consume").lower() == "client_pull"
+        )
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -84,25 +111,77 @@ class ClientMasterManager(FedMLCommManager):
         )
 
     def _on_connection_ready(self, msg: Message) -> None:
+        self._announce_online()
+
+    def _announce_online(self) -> None:
+        """The ONE ONLINE announcement (connection-ready AND the delta
+        base-missing recovery both send it — the server resets this
+        client's liveness and ACK state on receipt)."""
         status = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
         status.add(MyMessage.MSG_ARG_KEY_CLIENT_STATUS,
                    MyMessage.CLIENT_STATUS_ONLINE)
         self.send_message(status)
 
-    def _install_params(self, msg: Message) -> None:
-        if self._treedef is None:
-            # initialize a skeleton to learn the treedef
-            skeleton = self.trainer.model.init(
-                jax.random.PRNGKey(int(getattr(self.args, "random_seed", 0)))
-            )
-            self._treedef = jax.tree.structure(skeleton)
-        leaves = [jnp.asarray(a) for a in msg.get_arrays()]
-        params = jax.tree.unflatten(self._treedef, leaves)
+    def _ensure_skeleton(self) -> None:
+        if self._treedef is not None:
+            return
+        # initialize a skeleton to learn the treedef (and leaf shapes, the
+        # delta-frame unflatten substrate)
+        skeleton = self.trainer.model.init(
+            jax.random.PRNGKey(int(getattr(self.args, "random_seed", 0)))
+        )
+        leaves, self._treedef = jax.tree.flatten(skeleton)
+        self._shapes = [l.shape for l in leaves]
+        self._filter = filter_from_args(self.args, skeleton)
+
+    def _install_params(self, msg: Message,
+                        version: Optional[int] = None) -> bool:
+        """Install a dispatched model — a full leaf list, or an S2C delta
+        frame decoded against the version we last held (docs/delivery.md).
+        Returns False when a delta's base version is gone (a restarted
+        client whose store died — the server falls back to full frames
+        once our next ONLINE clears its ACK)."""
+        self._ensure_skeleton()
+        dmeta = msg.get(DELTA_KEY)
+        new_vec = None
+        if dmeta is not None:
+            from ..utils.tree import tree_unflatten_from_vector
+
+            base = (self._base_store.get(int(dmeta["base_version"]))
+                    if self._base_store is not None else None)
+            if base is None:
+                telemetry.counter_inc("comm.delta.client_base_missing")
+                logger.error(
+                    "client %d: S2C delta references version %s which this "
+                    "client no longer holds — dropping the frame and "
+                    "re-announcing ONLINE so the server clears our ACK "
+                    "(its next dispatch falls back to a full frame)",
+                    self.rank, dmeta.get("base_version"),
+                )
+                self._announce_online()
+                return False
+            new_vec = DeltaCodec.decode(base, msg.get_arrays(), dmeta)
+            params = tree_unflatten_from_vector(
+                jnp.asarray(new_vec), self._treedef, self._shapes)
+        else:
+            leaves = [jnp.asarray(a) for a in msg.get_arrays()]
+            params = jax.tree.unflatten(self._treedef, leaves)
         self.trainer.set_model_params(params)
+        if self._base_store is not None and version is not None:
+            if new_vec is None:
+                new_vec = flatten_leaves(jax.tree.leaves(params))
+            self._base_store.put(int(version), new_vec)
         if self.codec.enabled():
             from ..utils.tree import tree_flatten_to_vector
 
-            self._round_global_vec, _, _ = tree_flatten_to_vector(params)
+            if self._filter is not None:
+                # filtered payloads: the codec's reference is the filtered
+                # sub-vector (what actually rides the wire)
+                self._round_global_vec = jnp.asarray(
+                    self._filter.select_vector(jax.tree.leaves(params)))
+            else:
+                self._round_global_vec, _, _ = tree_flatten_to_vector(params)
+        return True
 
     def _on_init(self, msg: Message) -> None:
         self.client_index = int(
@@ -111,16 +190,18 @@ class ClientMasterManager(FedMLCommManager):
         round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0))
         if self._replay_guard("INIT", round_idx):
             return
+        if not self._install_params(msg, version=round_idx):
+            return
         self.round_idx = round_idx
-        self._install_params(msg)
         self._train_and_send()
 
     def _on_sync(self, msg: Message) -> None:
         round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0))
         if self._replay_guard("SYNC", round_idx):
             return
+        if not self._install_params(msg, version=round_idx):
+            return
         self.round_idx = round_idx
-        self._install_params(msg)
         self._train_and_send()
 
     def _replay_guard(self, kind: str, round_idx: int) -> bool:
@@ -211,19 +292,48 @@ class ClientMasterManager(FedMLCommManager):
         msg.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
         msg.add(MyMessage.MSG_ARG_KEY_TRAIN_LOSS,
                 float(metrics.get("train_loss", 0.0)))
+        if self._s2c_delta_on:
+            # capability + ACK: this message's version tag becomes the S2C
+            # delta base the server encodes our next sync against
+            msg.add(MyMessage.MSG_ARG_KEY_DELTA_CAPABLE, 1)
+        leaves = jax.tree.leaves(params)
+        raw_nbytes = payload_nbytes(leaves)
+        if self._filter is not None:
+            from ..delivery.payload_filter import FILTER_KEY
+
+            msg.add(FILTER_KEY, self._filter.meta())
         if self.codec.enabled() and self._round_global_vec is not None:
             from ..utils.tree import tree_flatten_to_vector
 
-            vec, _, _ = tree_flatten_to_vector(params)
+            if self._filter is not None:
+                vec = jnp.asarray(self._filter.select_vector(leaves))
+            else:
+                vec, _, _ = tree_flatten_to_vector(params)
             arrays, meta = self.codec.encode(
                 self._round_global_vec, vec, self.round_idx
             )
             msg.add(self.codec.META_KEY, meta)
             msg.set_arrays(arrays)
+        elif self._filter is not None:
+            msg.set_arrays(
+                [np.asarray(l) for l in self._filter.select(leaves)])
         else:
-            msg.set_arrays([np.asarray(l) for l in jax.tree.leaves(params)])
+            msg.set_arrays([np.asarray(l) for l in leaves])
+        if self.codec.enabled() or self._filter is not None:
+            sent = payload_nbytes(msg.get_arrays())
+            telemetry.counter_inc("comm.delta.c2s_bytes_saved",
+                                  max(raw_nbytes - sent, 0))
         self._last_model_msg = msg
         self.send_message(msg)
+        if self._client_pull:
+            # client_pull dispatch (docs/delivery.md): ask for the next
+            # version now — the server answers as soon as it bumps past
+            # the round we just trained
+            pull = Message(MyMessage.MSG_TYPE_C2S_PULL_REQUEST, self.rank, 0)
+            pull.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
+            if self._s2c_delta_on:
+                pull.add(MyMessage.MSG_ARG_KEY_DELTA_CAPABLE, 1)
+            self.send_message(pull)
 
     def _train_hierarchical(self):
         """Silo-parallel round: broadcast to DCN slaves, train the master's

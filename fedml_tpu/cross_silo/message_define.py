@@ -11,6 +11,11 @@ class MyMessage:
 
     MSG_TYPE_C2S_CLIENT_STATUS = "c2s_client_status"
     MSG_TYPE_C2S_SEND_MODEL_TO_SERVER = "c2s_send_model_to_server"
+    # delta delivery plane (docs/delivery.md): the client-pull FedBuff
+    # dispatch policy (--async_dispatch client_pull) — a client asks for a
+    # model newer than the version it carries; the server answers
+    # immediately when the head is already newer, else on the next bump
+    MSG_TYPE_C2S_PULL_REQUEST = "c2s_pull_request"
 
     MSG_TYPE_S2C_INIT_CONFIG = "s2c_init_config"
     MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT = "s2c_sync_model_to_client"
@@ -36,6 +41,12 @@ class MyMessage:
     # these keys ride the shed NACK
     MSG_ARG_KEY_RETRY_AFTER_S = "retry_after_s"
     MSG_ARG_KEY_SHED_REASON = "shed_reason"
+    # delta delivery plane: a C2S message sets this when its sender can
+    # decode S2C delta frames (capability negotiation — swarm devices and
+    # pre-delta clients never set it and keep receiving full frames). The
+    # version the message is tagged with becomes the sender's last-ACKed
+    # base for S2C delta encoding.
+    MSG_ARG_KEY_DELTA_CAPABLE = "delta_capable"
 
     CLIENT_STATUS_ONLINE = "ONLINE"
     CLIENT_STATUS_OFFLINE = "OFFLINE"

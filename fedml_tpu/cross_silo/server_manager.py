@@ -23,6 +23,16 @@ Two aggregation modes (``--aggregation_mode``, docs/traffic.md):
   modes share ONE aggregation core (``_aggregate_models`` — the
   attack → defend → DP hook chain), which is what makes the sync-parity
   pin (async K=N, alpha=0 ≡ sync FedAvg, bitwise) possible.
+
+Delta delivery plane (ISSUE 9, ``fedml_tpu/delivery/``, docs/delivery.md):
+the server keeps a :class:`VersionedModelStore` of the last V dispatched
+global vectors. Compressed C2S updates decode against the exact version
+their sender trained from (async×compression is no longer refused), and
+S2C_SYNC ships a LOSSLESS delta frame against each client's last-ACKed
+version — falling back loudly to full-model frames when the base was
+evicted. ``--async_dispatch`` picks the FedBuff dispatch policy
+(sync-on-consume / server-push / client-pull via ``c2s_pull_request``),
+and ``--payload_filter`` restricts C2S payloads to adapter leaves.
 """
 
 from __future__ import annotations
@@ -41,7 +51,11 @@ from .. import constants
 from ..core.aggregate import stack_trees, weighted_average
 from ..core.distributed import FedMLCommManager, Message
 from ..core.dp import FedPrivacyMechanism
+from ..core.mlops import telemetry
 from ..core.security.defender import FedMLDefender
+from ..delivery import VersionedModelStore, delivery_identity, flatten_leaves
+from ..delivery.delta_codec import DELTA_KEY, DeltaCodec, payload_nbytes
+from ..delivery.payload_filter import FILTER_KEY, filter_from_args
 from ..ml.evaluate import make_eval_fn
 from ..utils.tree import tree_flatten_to_vector, tree_unflatten_from_vector
 from .message_define import MyMessage
@@ -96,23 +110,45 @@ class FedMLServerManager(FedMLCommManager):
         )
         self._rx: Optional["queue.Queue"] = None
         self._async_worker: Optional[threading.Thread] = None
+        # -- delta delivery plane (fedml_tpu/delivery/, docs/delivery.md) ---
+        # the version-indexed reference store is what lets a compressed C2S
+        # delta decode against the exact global its sender trained from —
+        # including stale senders in async mode (the old async×compression
+        # refusal is gone) — and lets S2C_SYNC ship a lossless delta
+        # against each client's last-ACKed version
+        self.store = VersionedModelStore(
+            int(getattr(args, "delta_store_versions", 8) or 8),
+            metric_prefix="comm.delta.server_store",
+        )
+        self.s2c_delta_on = (
+            str(getattr(args, "s2c_delta", "auto") or "auto").lower()
+            != "off"
+        )
+        # with the plane fully opted out (--s2c_delta off, no
+        # --compression) the store never serves a decode or encode — skip
+        # the per-version full-vector copy + digest entirely
+        self._store_active = self.s2c_delta_on or bool(
+            str(getattr(args, "compression", "") or ""))
+        self.async_dispatch = str(
+            getattr(args, "async_dispatch", "sync_on_consume")
+            or "sync_on_consume").lower()
+        # last version each client echoed on a delta-capable message —
+        # the S2C delta base; cleared on ONLINE (a restarted client lost
+        # its side of the store). Guarded by self._lock (comm thread +
+        # async worker both touch it).
+        self._acked: Dict[int, int] = {}
+        # client_pull dispatch: ranks whose pull waits for the next
+        # version bump (guarded by self._lock)
+        self._pending_pulls: set = set()
+        # adapter-only payloads: C2S messages carry only matching leaves;
+        # the rest stay frozen at the server's global on merge
+        self.payload_filter = filter_from_args(args, self.global_params)
         if self.async_mode:
             from ..traffic.admission import (
                 AdmissionController, queue_limit_from_args,
             )
             from ..traffic.async_aggregator import AsyncConfig, AsyncUpdateBuffer
 
-            if str(getattr(args, "compression", "") or ""):
-                # a compressed delta decodes against the GLOBAL the client
-                # trained from; the async server has moved past that
-                # version, so decoding against the head silently corrupts
-                # the update — refuse loudly until a version-indexed
-                # reference store exists
-                raise ValueError(
-                    "aggregation_mode=async does not support update "
-                    "compression yet (the delta's reference global is "
-                    "version-specific); drop --compression or use sync"
-                )
             self.async_cfg = AsyncConfig.from_args(args, self.client_num)
             self.buffer = AsyncUpdateBuffer(self.async_cfg)
             self.admission = AdmissionController.from_args(
@@ -192,6 +228,20 @@ class FedMLServerManager(FedMLCommManager):
                     staleness_alpha=self.async_cfg.staleness_alpha,
                     max_staleness=self.async_cfg.max_staleness,
                 )
+                if self.async_dispatch != "sync_on_consume":
+                    # which clients re-enter training when decides who
+                    # trains what — dispatch policy is run identity too
+                    # (default omitted: pre-delta async ledgers keep
+                    # resuming)
+                    world["dispatch"] = self.async_dispatch
+            delivery_id = delivery_identity(args)
+            if delivery_id is not None:
+                # lossy C2S codec config, adapter filter and store depth
+                # all change what aggregation ever sees — resuming this
+                # ledger under a different delivery configuration is a
+                # different federation and is refused (plain worlds keep
+                # the pre-delta ledger format)
+                world["delivery"] = delivery_id
             self._ledger.ensure_meta(
                 seed=int(getattr(args, "random_seed", 0)),
                 world=world,
@@ -203,6 +253,11 @@ class FedMLServerManager(FedMLCommManager):
             if bool(getattr(args, "preempt_signals", True)):
                 self._guard.install()
             self._guard.reset()
+        # seed the reference store with the version INIT dispatches (the
+        # post-resume round index): the first C2S deltas decode against it
+        if self._store_active:
+            self.store.put(self.round_idx,
+                           flatten_leaves(jax.tree.leaves(self.global_params)))
 
     # -- FSM ----------------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -216,6 +271,9 @@ class FedMLServerManager(FedMLCommManager):
             self.register_message_receive_handler(
                 MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
                 self._on_model_received_async,
+            )
+            self.register_message_receive_handler(
+                MyMessage.MSG_TYPE_C2S_PULL_REQUEST, self._on_pull_request,
             )
             if not self._async_worker.is_alive():
                 self._async_worker.start()
@@ -237,6 +295,11 @@ class FedMLServerManager(FedMLCommManager):
                 self._online.add(msg.get_sender_id())
                 self._dead.discard(msg.get_sender_id())
                 self._offline_declared.discard(msg.get_sender_id())
+                # a (re)connecting client lost its side of the version
+                # store — forget its ACK so it gets full frames until it
+                # echoes a version again
+                self._acked.pop(msg.get_sender_id(), None)
+                self._pending_pulls.discard(msg.get_sender_id())
             elif status == MyMessage.CLIENT_STATUS_OFFLINE:
                 # explicit departure (the MQTT last-will analog): stop
                 # waiting for this client from now on
@@ -343,20 +406,26 @@ class FedMLServerManager(FedMLCommManager):
             return
         from ..core.compression import UpdateCodec
 
-        meta = msg.get(UpdateCodec.META_KEY)
-        if meta:
-            # compressed update delta: the reference vector is this round's
-            # broadcast global
-            gvec, treedef, shapes = tree_flatten_to_vector(self.global_params)
-            vec = UpdateCodec.decode(gvec, msg.get_arrays(), meta)
-            params = tree_unflatten_from_vector(vec, treedef, shapes)
-        else:
-            leaves = [jnp.asarray(a) for a in msg.get_arrays()]
-            params = jax.tree.unflatten(
-                jax.tree.structure(self.global_params), leaves
-            )
-        n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0))
+        self._record_ack(msg)
         msg_round = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx))
+        params = self._reconstruct_update(
+            sender, msg_round, msg.get_arrays(),
+            msg.get(UpdateCodec.META_KEY), msg.get(FILTER_KEY),
+        )
+        if params is None:
+            # undecodable (filter mismatch / evicted base) — counted and
+            # logged by _reconstruct_update. In sync mode a client whose
+            # every message is undecodable must not stall the quorum
+            # forever (round_timeout defaults to 0): mark it dead so the
+            # round can complete without it; a later decodable message
+            # revives it like any other dropped client.
+            with self._lock:
+                self._dead.add(sender)
+                have_all = self._round_complete_locked()
+            if have_all:
+                self._finish_round(msg_round)
+            return
+        n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0))
         with self._lock:
             if msg_round != self.round_idx:
                 return  # round closed between the unlocked check and here
@@ -368,6 +437,106 @@ class FedMLServerManager(FedMLCommManager):
             have_all = self._round_complete_locked()
         if have_all:
             self._finish_round(msg_round)
+
+    # -- delta delivery plane: C2S decode (fedml_tpu/delivery/) -------------
+
+    def _record_ack(self, msg: Message) -> None:
+        """A delta-capable C2S message proves its sender holds the global
+        of the version it is tagged with — that version becomes the S2C
+        delta base for this client."""
+        if not msg.get(MyMessage.MSG_ARG_KEY_DELTA_CAPABLE):
+            return
+        version = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, -1))
+        if version < 0:
+            return
+        sender = msg.get_sender_id()
+        with self._lock:
+            if version > self._acked.get(sender, -1):
+                self._acked[sender] = version
+
+    def _filter_for(self, filter_meta) -> Optional[object]:
+        """The configured payload filter, validated against what the
+        message announces. Raises on any mismatch — merging leaves under a
+        different pattern would silently scramble the model."""
+        if not filter_meta:
+            if self.payload_filter is not None:
+                raise ValueError(
+                    "server runs --payload_filter "
+                    f"{self.payload_filter.pattern!r} but the client sent a "
+                    "full payload — both ends must configure the filter"
+                )
+            return None
+        if self.payload_filter is None:
+            raise ValueError(
+                f"client sent a filtered payload ({filter_meta!r}) but this "
+                "server has no --payload_filter configured"
+            )
+        if filter_meta.get("pattern") != self.payload_filter.pattern:
+            raise ValueError(
+                f"payload filter mismatch: client {filter_meta!r} vs server "
+                f"{self.payload_filter.pattern!r}"
+            )
+        return self.payload_filter
+
+    def _reconstruct_update(self, sender: int, client_version: int, arrays,
+                            codec_meta, filter_meta):
+        """Decode one C2S payload into a FULL params pytree.
+
+        A compressed delta decodes against the store's vector for the
+        version the client trained from — exactly the FedBuff requirement
+        that used to make async×compression impossible. Filtered payloads
+        merge into the CURRENT global, so unselected leaves are frozen at
+        the head for every buffer entry (their weighted average is the
+        head itself). Returns None when the update is undecodable (base
+        evicted, filter mismatch) — counted, logged, never folded.
+        """
+        from ..core.compression import UpdateCodec
+
+        try:
+            filt = self._filter_for(filter_meta)
+        except ValueError as e:
+            telemetry.counter_inc("comm.delta.filter_mismatch_drops")
+            logger.error("server: dropping update from client %d: %s",
+                         sender, e)
+            return None
+        with self._lock:
+            head = self.global_params
+        head_leaves = jax.tree.leaves(head)
+        if codec_meta:
+            base_vec = self.store.get(client_version)
+            if base_vec is None:
+                telemetry.counter_inc("comm.delta.c2s_base_missing")
+                logger.warning(
+                    "server: client %d's compressed delta references "
+                    "version %d, which the store evicted (capacity %d) — "
+                    "dropping the update and resyncing the client",
+                    sender, client_version, self.store.capacity,
+                )
+                return None
+            telemetry.counter_inc("comm.delta.c2s_delta_decodes")
+            if filt is not None:
+                # the filtered base is a fixed set of slices of the stored
+                # flat vector — never materialize (or device-place) the
+                # full model just to pull out the adapter leaves
+                sub_base = filt.select_from_vector(base_vec)
+                sub_vec = UpdateCodec.decode(
+                    jnp.asarray(sub_base), arrays, codec_meta)
+                sub_leaves = [
+                    jnp.asarray(l)
+                    for l in filt.split_vector(np.asarray(sub_vec))
+                ]
+                leaves = filt.merge(head_leaves, sub_leaves)
+                return jax.tree.unflatten(jax.tree.structure(head), leaves)
+            vec = UpdateCodec.decode(jnp.asarray(base_vec), arrays,
+                                     codec_meta)
+            return tree_unflatten_from_vector(vec, self._treedef,
+                                              self._shapes)
+        if filt is not None:
+            sub_leaves = [jnp.asarray(a) for a in arrays]
+            leaves = filt.merge(head_leaves, sub_leaves)
+            return jax.tree.unflatten(jax.tree.structure(head), leaves)
+        leaves = [jnp.asarray(a) for a in arrays]
+        return jax.tree.unflatten(jax.tree.structure(head), leaves)
 
     def _aggregate_models(self, raw, senders, round_r):
         """The ONE aggregation core both modes share: attack hooks →
@@ -396,6 +565,17 @@ class FedMLServerManager(FedMLCommManager):
         if self.dp is not None and self.dp.dp_type == "cdp":
             agg = self.dp.randomize_global(agg, jax.random.fold_in(rng, 7))
         agg = self.aggregator.on_after_aggregation(agg)
+        if self.payload_filter is not None:
+            # adapter-only semantics (docs/delivery.md): unselected leaves
+            # are FROZEN — restore them from the previous global bitwise,
+            # so float averaging of identical values (or central DP noise)
+            # can never drift a leaf no client is allowed to train
+            with self._lock:
+                prev_leaves = jax.tree.leaves(self.global_params)
+            agg_leaves = jax.tree.leaves(agg)
+            merged = self.payload_filter.merge(
+                prev_leaves, self.payload_filter.select(agg_leaves))
+            agg = jax.tree.unflatten(jax.tree.structure(agg), merged)
         with self._lock:
             # published under the lock: in async mode this runs on the
             # aggregator worker while the comm thread reads the global for
@@ -443,21 +623,30 @@ class FedMLServerManager(FedMLCommManager):
             return
 
         if self.round_idx < self.round_num:
-            leaves = [np.asarray(l)
-                      for l in jax.tree.leaves(self.global_params)]
+            # round_r + 1 is THE version these params are: re-reading
+            # self.round_idx mid-fan-out could see a further bump (timer
+            # thread racing the receive thread) and mis-tag the frames
+            version = round_r + 1
+            leaves = [np.asarray(l) for l in jax.tree.leaves(agg)]
+            vec = flatten_leaves(leaves)
+            # commit the new version to the reference store BEFORE any
+            # dispatch: a client may answer (and its delta be decoded)
+            # before this fan-out finishes. VersionedModelStore is
+            # internally locked — comm-thread readers and this writer
+            # serialize on the store's own mutex.
+            if self._store_active:
+                self.store.put(version, vec)  # graftlint: disable=G005
+            cache: Dict[int, tuple] = {}
             for client_rank in range(1, self.size):
                 # dropped clients still receive the sync (maybe the stall was
                 # transient); they rejoin the quorum when a model arrives.
                 # Clients that DECLARED OFFLINE have torn down — skip them.
                 if client_rank in self._offline_declared:
                     continue
-                msg = Message(
-                    MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank,
-                    client_rank,
+                self._send_model_to(
+                    client_rank, MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                    leaves=leaves, vec=vec, cache=cache, version=version,
                 )
-                msg.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
-                msg.set_arrays(leaves)
-                self._send_or_mark_dead(client_rank, msg)
             self._arm_round_timer()
         else:
             self._broadcast_finish(
@@ -554,12 +743,16 @@ class FedMLServerManager(FedMLCommManager):
         gates and enqueues (header-cheap); decode, staleness judgment and
         folding run on the aggregator worker — a slow defense/DP step
         backpressures into load-shedding, never into queue growth."""
+        from ..core.compression import UpdateCodec
+
         sender = msg.get_sender_id()
         client_version = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, -1))
+        self._record_ack(msg)
         item = (
             time.monotonic(), sender, client_version,
             float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0)),
             msg.get_arrays(),
+            msg.get(UpdateCodec.META_KEY), msg.get(FILTER_KEY),
         )
         verdict = self.admission.offer(lambda: self._try_enqueue(item))
         if not verdict.admitted:
@@ -648,14 +841,21 @@ class FedMLServerManager(FedMLCommManager):
     def _async_fold(self, item) -> None:
         """Decode one admitted update and fold it into the buffer with its
         exact staleness (server version at fold minus the version tag the
-        dispatched model carried)."""
-        from ..core.mlops import telemetry
-
-        t_enq, sender, client_version, n, arrays = item
-        leaves = [jnp.asarray(a) for a in arrays]
-        params = jax.tree.unflatten(
-            jax.tree.structure(self.global_params), leaves
-        )
+        dispatched model carried). A compressed delta decodes against the
+        STORE's vector for the client's tagged version — the whole point of
+        the version-indexed store: staleness-weighted folding is unchanged,
+        only the reference global is version-correct."""
+        t_enq, sender, client_version, n, arrays, codec_meta, \
+            filter_meta = item
+        params = self._reconstruct_update(
+            sender, client_version, arrays, codec_meta, filter_meta)
+        if params is None:
+            # base version evicted from the store: the update is
+            # undecodable — same remedy as an over-stale update, the
+            # sender rejoins at version head with a fresh model
+            self._send_model_to(
+                sender, MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
+            return
         verdict = self.buffer.fold(
             sender, n, params, client_version, self.round_idx
         )
@@ -707,28 +907,128 @@ class FedMLServerManager(FedMLCommManager):
                 "server: async training finished after %d steps")
             self._close_and_finish()
             return True
-        # FedBuff dispatch rule: a client re-enters training when its
-        # update is consumed — ship the new version to the contributors
-        # (pytree→numpy conversion hoisted out of the per-recipient loop)
+        # FedBuff dispatch policy (--async_dispatch, docs/delivery.md):
+        # sync_on_consume ships the new version to this step's
+        # contributors (a client re-enters training when its update is
+        # consumed); server_push pushes every version bump to all live
+        # clients; client_pull answers the pulls parked since the last
+        # bump. (pytree→numpy conversion hoisted out of the per-recipient
+        # loop; the delta encode per distinct base is cached across it)
         with self._lock:
             skip = set(self._offline_declared)
-        leaves = [np.asarray(l) for l in jax.tree.leaves(agg)]
-        for client_rank in sorted(set(senders)):
-            if client_rank in skip:
-                continue
+            pulls = set(self._pending_pulls)
+            self._pending_pulls.clear()
+        version = round_r + 1  # the version these params ARE (see
+        leaves = [np.asarray(l) for l in jax.tree.leaves(agg)]  # _send_model_to)
+        vec = flatten_leaves(leaves)
+        if self._store_active:
+            self.store.put(version, vec)
+        if self.async_dispatch == "server_push":
+            targets = [r for r in range(1, self.size) if r not in skip]
+        elif self.async_dispatch == "client_pull":
+            targets = sorted(pulls - skip)
+        else:
+            targets = [r for r in sorted(set(senders)) if r not in skip]
+        cache: Dict[int, tuple] = {}
+        for client_rank in targets:
             self._send_model_to(
                 client_rank, MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
-                leaves=leaves)
+                leaves=leaves, vec=vec, cache=cache, version=version)
         return True
 
+    def _on_pull_request(self, msg: Message) -> None:
+        """client_pull dispatch (docs/delivery.md): the client asks for a
+        model newer than the version it carries. Answer immediately when
+        the head version already is newer; otherwise park the pull — the
+        next server step answers it with the bumped version."""
+        sender = msg.get_sender_id()
+        client_version = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, -1))
+        self._record_ack(msg)
+        telemetry.counter_inc("traffic.pull_requests")
+        with self._lock:
+            if client_version < self.round_idx:
+                defer = False
+            else:
+                defer = True
+                self._pending_pulls.add(sender)
+        if defer:
+            telemetry.counter_inc("traffic.pulls_deferred")
+        elif not self.done.is_set():
+            self._send_model_to(
+                sender, MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
+
     def _send_model_to(self, client_rank: int, msg_type: str,
-                       leaves=None) -> None:
+                       leaves=None, vec=None, cache=None,
+                       version=None) -> None:
         """Version-tagged model dispatch (the version IS the round index —
-        the client echoes it back, making staleness exact)."""
+        the client echoes it back, making staleness exact). Ships a
+        lossless delta frame against the client's last-ACKed version when
+        possible (docs/delivery.md); ``cache`` memoizes the encode per
+        distinct base version across one fan-out.
+
+        Fan-out callers pass ``version`` with their snapshotted leaves: a
+        round can close mid-fan-out (timer thread vs receive thread), and
+        re-reading ``self.round_idx`` here would tag round r+1's params
+        with version r+2 — poisoning both stores' version indexing."""
         if leaves is None:
-            leaves = [np.asarray(l)
-                      for l in jax.tree.leaves(self.global_params)]
+            with self._lock:
+                # version tag and content snapshotted together: a dispatch
+                # racing a round/version bump must not tag version v+1 on
+                # version v's params
+                params = self.global_params
+                version = self.round_idx
+            leaves = [np.asarray(l) for l in jax.tree.leaves(params)]
+        elif version is None:
+            version = self.round_idx
         m = Message(msg_type, self.rank, client_rank)
-        m.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
-        m.set_arrays(leaves)
+        m.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, version)
+        arrays, delta_meta = self._encode_model_payload(
+            client_rank, leaves, vec, cache)
+        if delta_meta is not None:
+            m.add(DELTA_KEY, delta_meta)
+        m.set_arrays(arrays)
         self._send_or_mark_dead(client_rank, m)
+
+    def _encode_model_payload(self, client_rank: int, leaves, vec=None,
+                              cache=None):
+        """``(arrays, delta_meta-or-None)`` for one model dispatch: a
+        lossless delta against the client's last-ACKed version when that
+        base is still in the store, else the full leaf list — LOUDLY when
+        the fallback is an eviction (the operator sized the store too
+        small for the federation's staleness)."""
+        if not self.s2c_delta_on:
+            return leaves, None
+        with self._lock:
+            acked = self._acked.get(client_rank)
+        if acked is None:
+            # nothing ACKed yet (fresh/restarted client, or a peer that
+            # never advertised delta capability — swarm devices, pre-delta
+            # clients): full frame, quietly
+            telemetry.counter_inc("comm.delta.s2c_full_frames")
+            return leaves, None
+        base_vec = self.store.get(acked)
+        if base_vec is None:
+            telemetry.counter_inc("comm.delta.s2c_full_frames")
+            logger.warning(
+                "server: client %d's ACKed version %d was evicted from the "
+                "%d-version store — falling back to a full-model frame "
+                "(raise --delta_store_versions to keep deltas flowing)",
+                client_rank, acked, self.store.capacity,
+            )
+            return leaves, None
+        entry = cache.get(acked) if cache is not None else None
+        if entry is None:
+            if vec is None:
+                vec = flatten_leaves(leaves)
+            arrays, meta = DeltaCodec.encode(base_vec, vec)
+            entry = (arrays, meta)
+            if cache is not None:
+                cache[acked] = entry
+        arrays, meta = entry
+        raw = payload_nbytes(leaves)
+        telemetry.counter_inc("comm.delta.s2c_delta_frames")
+        telemetry.counter_inc(
+            "comm.delta.s2c_bytes_saved",
+            max(raw - payload_nbytes(arrays), 0),
+        )
+        return arrays, {**meta, "base_version": int(acked)}

@@ -1,0 +1,89 @@
+"""Delta delivery plane: version-indexed model store + bidirectional delta
+shipping (ISSUE 9 tentpole — docs/delivery.md).
+
+Round traffic before this package was full model pytrees in both directions.
+The pieces here make the wire carry *changes* instead:
+
+- :class:`~fedml_tpu.delivery.model_store.VersionedModelStore` — a bounded
+  ring of the last V committed global vectors keyed by server version
+  (= round index, version-tagged on every dispatch since the async traffic
+  plane), with content digests and eviction accounting. Both ends of the
+  wire hold one: the server decodes compressed C2S deltas against the
+  version the client actually trained from (closing the async×compression
+  refusal), and the client decodes S2C delta frames against the global it
+  last acknowledged.
+- :class:`~fedml_tpu.delivery.delta_codec.DeltaCodec` — the S2C delta wire
+  format. LOSSLESS by construction (sparse-exact scatter or XOR+zlib over
+  the raw bits), so a delta-shipped sync is bitwise-identical to a full
+  broadcast — which is what lets delta shipping default on without touching
+  any trajectory pin.
+- :class:`~fedml_tpu.delivery.payload_filter.PayloadFilter` — adapter-only
+  payloads: a regex over named pytree leaves (the
+  ``scale/partition_rules`` naming) selects which leaves ride the C2S wire;
+  everything else is frozen at the server's global. LoRA/adapter FedLLM
+  rounds ship ~0.1% of weights this way.
+
+Telemetry rides the ``comm.delta.*`` family (docs/telemetry.md); the store
+and codec configuration are run-ledger ``run_meta`` identity
+(:func:`delivery_identity`), so resuming a federation under a different
+delivery configuration is refused.
+"""
+
+from __future__ import annotations
+
+from .delta_codec import DeltaCodec
+from .model_store import VersionedModelStore
+from .payload_filter import PayloadFilter
+
+__all__ = [
+    "DeltaCodec",
+    "PayloadFilter",
+    "VersionedModelStore",
+    "delivery_identity",
+    "flatten_leaves",
+]
+
+
+def flatten_leaves(leaves):
+    """Host-side flatten of pytree leaves into ONE numpy vector (canonical
+    leaf order). The wire plane's counterpart of
+    ``utils.tree.tree_flatten_to_vector`` — deliberately numpy, so
+    serializing a model for dispatch never round-trips it through a
+    device buffer. The single definition every store put and delta encode
+    uses: server and client vectors can only agree if they flatten the
+    same way."""
+    import numpy as np
+
+    arrs = [np.ravel(np.asarray(l)) for l in leaves]
+    return np.concatenate(arrs) if arrs else np.zeros((0,), np.float32)
+
+
+def delivery_identity(args):
+    """The trajectory-affecting delivery configuration, as run-ledger
+    ``run_meta`` identity — or None when the delivery plane runs in its
+    default lossless shape (plain worlds keep the pre-delta ledger format,
+    so old checkpoints keep resuming).
+
+    Lossy C2S compression and the adapter filter change what the
+    aggregation ever sees, and the store depth decides which stale deltas
+    are even decodable — resuming a checkpoint under a different value of
+    any of these is a different federation.
+    """
+    scheme = str(getattr(args, "compression", "") or "").lower()
+    pattern = str(getattr(args, "payload_filter", "") or "")
+    if not scheme and not pattern:
+        return None
+    ident = {
+        "store_versions": int(getattr(args, "delta_store_versions", 8) or 8),
+    }
+    if scheme:
+        ident["compression"] = scheme
+        ident["compression_ratio"] = float(
+            getattr(args, "compression_ratio", 0.1))
+        if scheme == "quantize":
+            ident["quantize_bits"] = int(getattr(args, "quantize_bits", 8))
+        if scheme == "qsgd":
+            ident["qsgd_levels"] = int(getattr(args, "qsgd_levels", 256))
+    if pattern:
+        ident["payload_filter"] = pattern
+    return ident

@@ -1,0 +1,121 @@
+"""Lossless S2C delta wire codec.
+
+The C2S direction already had a (lossy) update codec
+(``core/compression.UpdateCodec``) — clients ship sparse/quantized deltas of
+what they *trained*. The S2C direction is different: the broadcast global is
+**shared reference state**. A lossy sync would make every client hold a
+slightly different "version r", and every subsequent C2S delta would decode
+against a base the server doesn't have. So the S2C codec here is lossless
+*by construction* — ``decode(base, encode(base, new)) == new`` bit for bit —
+which is also what keeps delta shipping on by default without perturbing any
+bitwise trajectory pin.
+
+Two frame schemes, chosen per message by measured size:
+
+- ``sparse`` — int32 indices + exact values of the entries whose RAW BITS
+  changed (bit comparison, so ``-0.0`` vs ``0.0`` and NaN payloads survive).
+  When the C2S direction runs top-k compression, the aggregated global delta
+  has support bounded by (cohort × k) — the S2C delta is then *exactly*
+  sparse and this frame is an order of magnitude smaller than the vector.
+- ``xorz`` — zlib over the XOR of the two vectors' raw bits. Dense updates
+  still compress (unchanged exponent/sign bytes XOR to zero runs).
+
+Whichever is smaller wins; if neither beats the raw vector the codec
+returns a ``raw`` frame (the full new vector) — never larger than the
+full-model message it replaces, modulo a few header bytes.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+# message param carrying the delta frame description (base version etc.);
+# absent = a plain full-model frame
+DELTA_KEY = "__s2c_delta__"
+
+_BIT_VIEWS = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+
+
+def _bits(vec: np.ndarray) -> np.ndarray:
+    """The vector's raw bits as an unsigned-int view (exact comparison /
+    XOR substrate; float equality would merge -0.0/0.0 and break NaN)."""
+    view = _BIT_VIEWS.get(vec.dtype.itemsize)
+    if view is None:
+        raise ValueError(
+            f"delta codec: unsupported itemsize {vec.dtype.itemsize} "
+            f"({vec.dtype})"
+        )
+    return np.ascontiguousarray(vec).view(view)
+
+
+def payload_nbytes(arrays: Sequence[np.ndarray]) -> int:
+    return int(sum(int(np.asarray(a).nbytes) for a in arrays))
+
+
+class DeltaCodec:
+    """Stateless lossless delta encode/decode over flat model vectors."""
+
+    @staticmethod
+    def encode(base_vec, new_vec,
+               level: int = 1) -> Tuple[List[np.ndarray], Dict]:
+        """``(base, new) -> (arrays, meta)``; reconstruction is bitwise."""
+        base = np.asarray(base_vec)
+        new = np.asarray(new_vec)
+        if base.shape != new.shape or base.dtype != new.dtype:
+            raise ValueError(
+                f"delta codec: base {base.dtype}{base.shape} and new "
+                f"{new.dtype}{new.shape} frames disagree"
+            )
+        meta: Dict = {"dim": int(new.shape[0]), "dtype": new.dtype.str}
+        base_bits = _bits(base)
+        new_bits = _bits(new)
+        changed = np.nonzero(base_bits != new_bits)[0]
+        raw_cost = int(new.nbytes)
+        sparse_cost = int(changed.size) * (4 + new.dtype.itemsize)
+        if changed.size and changed[-1] >= (1 << 31):
+            sparse_cost = raw_cost + 1  # int32 indices can't address it
+        xor_comp = None
+        if sparse_cost >= raw_cost // 2:
+            # dense-ish delta: XOR bits + zlib (zero runs where bytes agree)
+            xor_comp = zlib.compress(
+                (base_bits ^ new_bits).tobytes(), level)
+        if sparse_cost < raw_cost and (
+                xor_comp is None or sparse_cost <= len(xor_comp)):
+            meta["scheme"] = "sparse"
+            return [changed.astype(np.int32),
+                    np.ascontiguousarray(new[changed])], meta
+        if xor_comp is not None and len(xor_comp) < raw_cost:
+            meta["scheme"] = "xorz"
+            return [np.frombuffer(xor_comp, dtype=np.uint8)], meta
+        meta["scheme"] = "raw"
+        return [np.ascontiguousarray(new)], meta
+
+    @staticmethod
+    def decode(base_vec, arrays: Sequence[np.ndarray],
+               meta: Dict) -> np.ndarray:
+        """Reconstruct the new vector — bitwise — from ``base`` + frame."""
+        base = np.asarray(base_vec)
+        dim = int(meta["dim"])
+        dtype = np.dtype(meta["dtype"])
+        if base.shape != (dim,) or base.dtype != dtype:
+            raise ValueError(
+                f"delta codec: base {base.dtype}{base.shape} does not match "
+                f"frame ({dtype}, dim {dim})"
+            )
+        scheme = meta.get("scheme")
+        if scheme == "sparse":
+            out = np.array(base, copy=True)
+            idx = np.asarray(arrays[0])
+            out[idx] = np.asarray(arrays[1])
+            return out
+        if scheme == "xorz":
+            comp = np.ascontiguousarray(np.asarray(arrays[0])).tobytes()
+            xor = np.frombuffer(zlib.decompress(comp),
+                                dtype=_BIT_VIEWS[dtype.itemsize])
+            return (_bits(base) ^ xor).view(dtype)
+        if scheme == "raw":
+            return np.array(np.asarray(arrays[0]), copy=True)
+        raise ValueError(f"delta codec: unknown scheme {scheme!r}")

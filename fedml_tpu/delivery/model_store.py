@@ -1,0 +1,133 @@
+"""Version-indexed reference store for global model vectors.
+
+reference: the FedBuff line of work (Nguyen et al., AISTATS 2022) assumes a
+server that can reconstruct "the global the client trained from" for any
+update it is still willing to fold — without that, update compression and
+asynchrony are mutually exclusive (a delta only decodes against its exact
+base). The reference FedML framework keeps exactly one global in memory and
+therefore refuses the combination; so did this repo's server until ISSUE 9
+(``cross_silo/server_manager.py`` raised on ``async`` × ``--compression``).
+
+:class:`VersionedModelStore` is that reconstruction capability as a small,
+thread-safe object: a bounded ring of the last ``capacity`` committed global
+vectors keyed by **server version** (= the round index every dispatch is
+already tagged with), each entry carrying a content digest. Both wire ends
+hold one — the server for decoding C2S update deltas against the client's
+tagged base, the client for decoding S2C sync deltas against the global it
+last acknowledged. Eviction is oldest-version-first and *accounted*
+(``comm.delta.store_evictions``): an evicted base is a loud full-frame
+fallback on the S2C side and a drop-with-resync on the C2S side, never a
+silent corruption.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.mlops import telemetry
+
+
+def vector_digest(vec: np.ndarray) -> str:
+    """Content digest of a stored vector (dtype + bytes, sha256[:16])."""
+    h = hashlib.sha256()
+    h.update(str(vec.dtype.str).encode())
+    h.update(np.ascontiguousarray(vec).tobytes())
+    return h.hexdigest()[:16]
+
+
+class VersionedModelStore:
+    """Bounded ring of global model vectors keyed by server version.
+
+    ``put`` is idempotent per version (re-dispatching a version after a
+    resume re-stores the same bytes); capacity overflow evicts the OLDEST
+    versions — deltas are only ever requested against recent history, and
+    an evicted base must surface as an accounted fallback, not unbounded
+    memory. ``get`` counts hits/misses so the delta hit rate is readable
+    from telemetry alone (``fedml_tpu top``).
+
+    ``metric_prefix`` namespaces the counters per wire end
+    (``comm.delta.server_store.*`` vs ``comm.delta.client_store.*``): in
+    loopback worlds both ends share one process-wide registry.
+    """
+
+    def __init__(self, capacity: int = 8,
+                 metric_prefix: str = "comm.delta.store"):
+        if int(capacity) < 1:
+            raise ValueError(
+                f"delta_store_versions must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.metric_prefix = str(metric_prefix)
+        self._lock = threading.Lock()
+        self._entries: Dict[int, Tuple[np.ndarray, str]] = {}
+        self._evictions = 0
+
+    # -- write side ---------------------------------------------------------
+
+    def put(self, version: int, vec) -> str:
+        """Store ``vec`` under ``version``; returns the content digest.
+        Oldest versions beyond ``capacity`` are evicted and counted."""
+        version = int(version)
+        vec = np.array(np.asarray(vec), copy=True)  # detach from wire views
+        digest = vector_digest(vec)
+        evicted = 0
+        with self._lock:
+            self._entries[version] = (vec, digest)
+            while len(self._entries) > self.capacity:
+                oldest = min(self._entries)
+                del self._entries[oldest]
+                evicted += 1
+            self._evictions += evicted
+            occupancy = len(self._entries)
+        telemetry.counter_inc(f"{self.metric_prefix}.puts")
+        if evicted:
+            telemetry.counter_inc(f"{self.metric_prefix}.evictions", evicted)
+        telemetry.gauge_set(f"{self.metric_prefix}.occupancy",
+                            float(occupancy))
+        return digest
+
+    # -- read side ----------------------------------------------------------
+
+    def get(self, version) -> Optional[np.ndarray]:
+        """The stored vector for ``version`` (or None), counting the
+        hit/miss. The array is the stored instance — READ-ONLY by contract
+        (decoders copy before mutating)."""
+        if version is None:
+            telemetry.counter_inc(f"{self.metric_prefix}.misses")
+            return None
+        with self._lock:
+            entry = self._entries.get(int(version))
+        if entry is None:
+            telemetry.counter_inc(f"{self.metric_prefix}.misses")
+            return None
+        telemetry.counter_inc(f"{self.metric_prefix}.hits")
+        return entry[0]
+
+    def has(self, version) -> bool:
+        with self._lock:
+            return int(version) in self._entries if version is not None \
+                else False
+
+    def digest(self, version: int) -> Optional[str]:
+        with self._lock:
+            entry = self._entries.get(int(version))
+        return None if entry is None else entry[1]
+
+    def versions(self) -> List[int]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def latest(self) -> Optional[int]:
+        with self._lock:
+            return max(self._entries) if self._entries else None
+
+    def occupancy(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def evictions(self) -> int:
+        with self._lock:
+            return self._evictions

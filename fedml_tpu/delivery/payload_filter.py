@@ -1,0 +1,163 @@
+"""Adapter-only payload filter: regex over named pytree leaves.
+
+reference: LoRA (Hu et al., 2021) and the adapter-FL line — in the federated
+7B scenario clients fine-tune a small set of adapter/head parameters and the
+backbone stays frozen, so only ~0.1% of the weights ever need to cross the
+wire. The reference framework ships the full state dict regardless.
+
+The filter reuses the ``scale/partition_rules`` leaf-naming convention
+(``a/b/c`` paths via :func:`named_tree_paths`, ``re.search`` semantics):
+``--payload_filter "adapter|lora_|head"`` selects the leaves that ride the
+C2S update; the server merges them into its *current* global for
+aggregation, so unselected leaves are exactly frozen — every buffer entry
+carries the head's values for them and their weighted average is the head
+itself. The S2C direction needs no filter: frozen leaves are bit-identical
+between versions, so the lossless sparse delta frame
+(:mod:`~fedml_tpu.delivery.delta_codec`) prices them at ~zero bytes.
+
+Both ends construct the filter from the SAME ``args.payload_filter`` over
+the SAME model skeleton, so the selected index set is identical by
+construction; the C2S message additionally carries the pattern
+(:data:`FILTER_KEY`) and the receiver refuses a mismatch loudly instead of
+mis-merging leaves.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+from ..scale.partition_rules import named_tree_paths
+
+# message param announcing a filtered payload (absent = full leaf list)
+FILTER_KEY = "__payload_filter__"
+
+PyTree = Any
+
+
+class PayloadFilter:
+    """Select/merge a fixed subset of a pytree's leaves by leaf name."""
+
+    def __init__(self, pattern: str, template_tree: PyTree):
+        self.pattern = str(pattern)
+        try:
+            rx = re.compile(self.pattern)
+        except re.error as e:
+            raise ValueError(
+                f"bad payload_filter pattern {pattern!r}: {e}") from None
+        named = named_tree_paths(template_tree)
+        self.names = [name for name, _ in named]
+        self.indices = [i for i, (name, _) in enumerate(named)
+                        if rx.search(name) is not None]
+        # per-leaf (shape, dtype, flat offset) over the CANONICAL flatten
+        # order (delivery.flatten_leaves): selected leaves are fixed slices
+        # of the flat model vector, so codec paths can slice base vectors
+        # directly instead of round-tripping the whole model through a
+        # pytree (attrs only — no host copy of a possibly-on-device leaf)
+        self._shapes, self._dtypes, self._offsets = [], [], []
+        off = 0
+        for _, leaf in named:
+            shape = tuple(getattr(leaf, "shape", ()))
+            size = 1
+            for s in shape:
+                size *= int(s)
+            self._shapes.append(shape)
+            self._dtypes.append(np.dtype(getattr(leaf, "dtype", np.float32)))
+            self._offsets.append(off)
+            off += size
+        self.total_size = off
+        if not self.indices:
+            raise ValueError(
+                f"payload_filter {pattern!r} matches no leaf of the model "
+                f"(leaves: {self.names})"
+            )
+        if len(self.indices) == len(named):
+            raise ValueError(
+                f"payload_filter {pattern!r} matches EVERY leaf — drop the "
+                "filter instead of shipping a filtered full model"
+            )
+        self.selected_names = [self.names[i] for i in self.indices]
+
+    def select(self, leaves: Sequence[Any]) -> List[Any]:
+        """The filtered sub-list, in canonical leaf order."""
+        self._check_arity(leaves)
+        return [leaves[i] for i in self.indices]
+
+    def merge(self, full_leaves: Sequence[Any],
+              sub_leaves: Sequence[Any]) -> List[Any]:
+        """Replace the selected positions of ``full_leaves`` with
+        ``sub_leaves`` (a fresh list; inputs untouched)."""
+        self._check_arity(full_leaves)
+        if len(sub_leaves) != len(self.indices):
+            raise ValueError(
+                f"filtered payload carries {len(sub_leaves)} leaves, filter "
+                f"selects {len(self.indices)}"
+            )
+        out = list(full_leaves)
+        for pos, leaf in zip(self.indices, sub_leaves):
+            out[pos] = leaf
+        return out
+
+    def select_vector(self, leaves: Sequence[Any]) -> np.ndarray:
+        """Flat vector of the selected leaves (the codec substrate when
+        C2S compression composes with the filter)."""
+        sub = self.select(leaves)
+        return np.concatenate([np.ravel(np.asarray(l)) for l in sub])
+
+    def select_from_vector(self, vec: np.ndarray) -> np.ndarray:
+        """:meth:`select_vector` over an already-FLAT model vector (the
+        version store's format): the selected leaves are fixed slices, so
+        no pytree — and no device round-trip — is ever materialized."""
+        vec = np.asarray(vec)
+        if vec.size != self.total_size:
+            raise ValueError(
+                f"model vector length {vec.size} does not match the "
+                f"filter's template ({self.total_size})"
+            )
+        parts = []
+        for i in self.indices:
+            off = self._offsets[i]
+            size = int(np.prod(self._shapes[i])) if self._shapes[i] else 1
+            parts.append(vec[off:off + size])
+        return np.concatenate(parts)
+
+    def split_vector(self, vec: np.ndarray) -> List[np.ndarray]:
+        """Inverse of :meth:`select_vector`: slice a filtered flat vector
+        back into the selected leaves' shapes/dtypes (from the template
+        the filter was built over)."""
+        out: List[np.ndarray] = []
+        off = 0
+        vec = np.asarray(vec)
+        for i in self.indices:
+            shape, dtype = self._shapes[i], self._dtypes[i]
+            size = int(np.prod(shape)) if shape else 1
+            out.append(vec[off:off + size].reshape(shape).astype(
+                dtype, copy=False))
+            off += size
+        if off != vec.size:
+            raise ValueError(
+                f"filtered vector length {vec.size} does not match the "
+                f"selected leaves' total size {off}"
+            )
+        return out
+
+    def meta(self) -> Dict:
+        """What the C2S message announces about its filtered payload."""
+        return {"pattern": self.pattern, "n_selected": len(self.indices)}
+
+    def _check_arity(self, leaves: Sequence[Any]) -> None:
+        if len(leaves) != len(self.names):
+            raise ValueError(
+                f"payload filter built over {len(self.names)} leaves, got "
+                f"{len(leaves)}"
+            )
+
+
+def filter_from_args(args, template_tree: PyTree):
+    """The configured filter, or None. One parser for both wire ends."""
+    pattern = str(getattr(args, "payload_filter", "") or "")
+    if not pattern:
+        return None
+    return PayloadFilter(pattern, template_tree)

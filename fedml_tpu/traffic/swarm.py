@@ -398,8 +398,23 @@ def _device_args(a, rank: int, backend: str):
         random_seed=int(a.seed),
     )
     if backend == constants.COMM_BACKEND_GRPC:
-        overrides.update(comm_port=int(a.port), comm_host="127.0.0.1")
+        overrides.update(
+            comm_port=int(a.port), comm_host="127.0.0.1",
+            grpc_ranks_per_port=_ranks_per_port(a),
+        )
     return fedml.init(Arguments(overrides=overrides), should_init_logs=False)
+
+
+def _ranks_per_port(a) -> int:
+    """Resolved gRPC rank→port multiplexing for a swarm config: an explicit
+    ``--ranks_per_port``, else one port per device-host process (the
+    per-process rank-block size) — 2000 devices over 8 processes cost 9
+    listening ports instead of 2001. 1 = legacy port-per-rank."""
+    explicit = int(getattr(a, "ranks_per_port", 0) or 0)
+    if explicit > 0:
+        return explicit
+    procs = max(int(getattr(a, "procs", 1) or 1), 1)
+    return max((int(a.clients) + procs - 1) // procs, 1)
 
 
 def _percentiles(hist_summary: Optional[dict]) -> Dict:
@@ -437,7 +452,8 @@ def swarm_soak(a) -> Dict:
 
     server_over = dict(_server_overrides(a), backend=backend)
     if backend == constants.COMM_BACKEND_GRPC:
-        server_over.update(comm_port=int(a.port), comm_host="127.0.0.1")
+        server_over.update(comm_port=int(a.port), comm_host="127.0.0.1",
+                           grpc_ranks_per_port=_ranks_per_port(a))
     args_s = fedml.init(Arguments(overrides=server_over),
                         should_init_logs=False)
     ds, od = data_mod.load(args_s)
@@ -484,6 +500,8 @@ def swarm_soak(a) -> Dict:
                     "--think_s", str(a.think_s), "--dropout",
                     str(a.dropout), "--run_id", str(a.run_id),
                     "--timeout", str(a.timeout),
+                    "--procs", str(a.procs),
+                    "--ranks_per_port", str(_ranks_per_port(a)),
                 ))
                 base += count
 

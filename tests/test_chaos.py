@@ -70,6 +70,45 @@ class TestChaosInProcess:
             assert all(v == 1 for v in per.values()), (rnd, per)
 
 
+class TestChaosCompressed:
+    def test_fault_matrix_parity_with_delta_frames(self, tmp_path):
+        """ISSUE 9 satellite: the chaos matrix with --compression on — the
+        wire now carries compressed C2S deltas and lossless S2C delta
+        frames, and dedup + payload digests must still hold the run
+        BITWISE equal to the fault-free reference (quantize: stateless, so
+        replay/retry is idempotent)."""
+        from fedml_tpu.core.mlops import telemetry
+
+        reg = telemetry.registry()
+        corrupt0 = reg.counter("comm.corrupt_payloads")
+        decodes0 = reg.counter("comm.delta.c2s_delta_decodes")
+        a = _cfg(tmp_path, compression="quantize", compression_ratio=0.1)
+        ref = chaos.run_world(
+            a, run_id=f"chaoscomp-{os.getpid()}-a",
+            checkpoint_dir=str(tmp_path / "ref_ckpt"), faulty=False)
+        noisy = chaos.run_world(
+            a, run_id=f"chaoscomp-{os.getpid()}-b",
+            checkpoint_dir=str(tmp_path / "noisy_ckpt"), faulty=True)
+        for i, (x, y) in enumerate(zip(ref["params"], noisy["params"])):
+            assert x.dtype == y.dtype and np.array_equal(x, y), \
+                f"leaf {i} diverged under faults with compression on"
+        for rnd, per in noisy["server"].contrib_counts.items():
+            assert all(v == 1 for v in per.values()), (rnd, per)
+        # the fault matrix actually bit delta frames (digest drops) and
+        # the delta path actually ran (compressed decodes)
+        assert reg.counter("comm.corrupt_payloads") > corrupt0
+        assert reg.counter("comm.delta.c2s_delta_decodes") > decodes0
+
+    def test_eftopk_refused_for_chaos(self, tmp_path):
+        """Error-feedback compression cannot hold bitwise parity across a
+        kill/restart (the client residual dies with the process) — the
+        harness refuses it instead of flaking."""
+        a = _cfg(tmp_path, compression="eftopk")
+        with pytest.raises(ValueError, match="eftopk"):
+            chaos.run_world(a, run_id="x", checkpoint_dir=str(tmp_path),
+                            faulty=False)
+
+
 class TestChaosKillRestart:
     def test_sigterm_resume_bitwise_parity_and_ledger_diff(self, tmp_path):
         """kill -TERM during round R (timed off the durable ledger commit),

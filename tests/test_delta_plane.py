@@ -1,0 +1,712 @@
+"""Delta delivery plane tests (fedml_tpu/delivery/ — ISSUE 9).
+
+Pins the tentpole's guarantees:
+
+1. **Store**: bounded version ring, digests, eviction accounting.
+2. **Codec**: the S2C delta wire format is LOSSLESS — bitwise
+   reconstruction for sparse, dense, NaN/-0.0 and degenerate inputs.
+3. **S2C parity**: a delta-shipped federation ends bitwise-identical to a
+   full-broadcast one, with delta frames provably on the wire.
+4. **async×compression**: the old refusal is gone; a STALE client's
+   compressed delta decodes against its true base version and folds with
+   the correct staleness weight.
+5. **Eviction fallback**: evicted S2C bases fall back to full frames
+   (loudly); evicted C2S bases drop the update and resync the sender.
+6. **Ledger identity**: resuming under a different delivery config is
+   refused.
+7. **Dispatch policies**: server_push and client_pull (the new
+   ``c2s_pull_request`` wire edge) both complete real federations.
+8. **Adapter filter**: unselected leaves are frozen bitwise; payloads
+   shrink; filter×codec compose.
+9. **gRPC satellites**: rank→port multiplexing shares one server per
+   port; the raw wire format is the default and corrupt raw frames are
+   dropped by the digest, not crashed on.
+"""
+
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+import fedml_tpu as fedml
+from fedml_tpu import data as data_mod
+from fedml_tpu import models as model_mod
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.core.mlops import telemetry
+from fedml_tpu.cross_silo import FedMLCrossSiloClient, FedMLCrossSiloServer
+from fedml_tpu.delivery import VersionedModelStore, delivery_identity
+from fedml_tpu.delivery.delta_codec import (
+    DELTA_KEY,
+    DeltaCodec,
+    payload_nbytes,
+)
+from fedml_tpu.delivery.payload_filter import PayloadFilter, filter_from_args
+
+
+def make_args(run_id, **kw):
+    base = dict(
+        training_type="cross_silo", dataset="synthetic", model="lr",
+        client_num_in_total=3, client_num_per_round=3, comm_round=3,
+        epochs=2, batch_size=8, learning_rate=0.2, backend="LOOPBACK",
+        run_id=run_id, frequency_of_the_test=1,
+    )
+    base.update(kw)
+    return fedml.init(Arguments(overrides=base), should_init_logs=False)
+
+
+def run_world(run_id, n_clients=3, **kw):
+    args_s = make_args(run_id, role="server", client_num_in_total=n_clients,
+                       **kw)
+    ds, od = data_mod.load(args_s)
+    bundle = model_mod.create(args_s, od)
+    server = FedMLCrossSiloServer(args_s, None, ds, bundle)
+    clients = []
+    for rank in range(1, n_clients + 1):
+        args_c = make_args(run_id, role="client", rank=rank,
+                           client_num_in_total=n_clients, **kw)
+        clients.append(FedMLCrossSiloClient(args_c, None, ds, bundle))
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    result = server.run()
+    for t in threads:
+        t.join(timeout=60)
+    return result, server, clients
+
+
+def global_leaves(server):
+    import jax
+
+    return [np.asarray(l)
+            for l in jax.tree.leaves(server.manager.global_params)]
+
+
+# ---------------------------------------------------------------------------
+# units: store, codec, filter
+# ---------------------------------------------------------------------------
+
+
+class TestVersionedModelStore:
+    def test_put_get_roundtrip_and_digest(self):
+        s = VersionedModelStore(4, metric_prefix="t.store.a")
+        v = np.arange(8, dtype=np.float32)
+        d = s.put(3, v)
+        assert s.has(3) and s.digest(3) == d and len(d) == 16
+        got = s.get(3)
+        assert np.array_equal(got, v)
+        # stored copy is detached: mutating the source never changes it
+        v[0] = 99.0
+        assert s.get(3)[0] == 0.0
+
+    def test_bounded_ring_evicts_oldest(self):
+        s = VersionedModelStore(2, metric_prefix="t.store.b")
+        for ver in range(5):
+            s.put(ver, np.full(3, float(ver), np.float32))
+        assert s.versions() == [3, 4]
+        assert s.occupancy() == 2
+        assert s.evictions() == 3
+        assert s.latest() == 4
+        assert s.get(1) is None  # evicted → miss
+        assert s.get(4)[0] == 4.0
+
+    def test_capacity_validated(self):
+        with pytest.raises(ValueError, match="delta_store_versions"):
+            VersionedModelStore(0)
+
+    def test_put_is_idempotent_per_version(self):
+        s = VersionedModelStore(4, metric_prefix="t.store.c")
+        v = np.ones(4, np.float32)
+        assert s.put(1, v) == s.put(1, v)
+        assert s.occupancy() == 1
+
+
+class TestDeltaCodec:
+    def roundtrip(self, base, new):
+        arrays, meta = DeltaCodec.encode(base, new)
+        out = DeltaCodec.decode(base, arrays, meta)
+        assert out.dtype == new.dtype and out.shape == new.shape
+        assert np.array_equal(out.view(np.uint32), new.view(np.uint32)), \
+            f"scheme {meta['scheme']} not bitwise"
+        return arrays, meta
+
+    def test_sparse_bitwise_roundtrip(self):
+        rng = np.random.RandomState(0)
+        base = rng.randn(4096).astype(np.float32)
+        new = base.copy()
+        idx = rng.choice(4096, size=40, replace=False)
+        new[idx] += 1.0
+        arrays, meta = self.roundtrip(base, new)
+        assert meta["scheme"] == "sparse"
+        # 40 changed entries: ~320 payload bytes vs a 16 KB vector
+        assert payload_nbytes(arrays) < base.nbytes // 10
+
+    def test_dense_delta_still_bitwise(self):
+        rng = np.random.RandomState(1)
+        base = rng.randn(2048).astype(np.float32)
+        new = (base + rng.randn(2048) * 1e-3).astype(np.float32)
+        _, meta = self.roundtrip(base, new)
+        assert meta["scheme"] in ("xorz", "raw")
+
+    def test_identical_vectors_cost_nothing(self):
+        base = np.random.RandomState(2).randn(1024).astype(np.float32)
+        arrays, meta = DeltaCodec.encode(base, base.copy())
+        assert meta["scheme"] == "sparse"
+        assert payload_nbytes(arrays) == 0
+        assert np.array_equal(DeltaCodec.decode(base, arrays, meta), base)
+
+    def test_bit_exact_corner_cases(self):
+        # -0.0 vs 0.0 and NaN payloads must survive (bit comparison, not ==)
+        base = np.array([0.0, 1.0, np.nan, 3.0], np.float32)
+        new = np.array([-0.0, 1.0, np.nan, 4.0], np.float32)
+        arrays, meta = DeltaCodec.encode(base, new)
+        out = DeltaCodec.decode(base, arrays, meta)
+        assert np.array_equal(out.view(np.uint32), new.view(np.uint32))
+        assert np.signbit(out[0])
+
+    def test_mismatched_frames_refused(self):
+        a = np.zeros(4, np.float32)
+        with pytest.raises(ValueError, match="disagree"):
+            DeltaCodec.encode(a, np.zeros(5, np.float32))
+        arrays, meta = DeltaCodec.encode(a, a)
+        with pytest.raises(ValueError, match="does not match"):
+            DeltaCodec.decode(np.zeros(5, np.float32), arrays, meta)
+        with pytest.raises(ValueError, match="scheme"):
+            DeltaCodec.decode(a, arrays, {**meta, "scheme": "bogus"})
+
+
+class TestPayloadFilter:
+    def tree(self):
+        return {"params": {"Dense_0": {"kernel": np.ones((4, 3)),
+                                       "bias": np.zeros(3)},
+                           "head": {"kernel": np.ones((3, 2))}}}
+
+    def test_select_merge_roundtrip(self):
+        import jax
+
+        f = PayloadFilter("head", self.tree())
+        leaves = jax.tree.leaves(self.tree())
+        sub = f.select(leaves)
+        assert len(sub) == 1 and sub[0].shape == (3, 2)
+        merged = f.merge(leaves, [np.full((3, 2), 7.0)])
+        assert merged[f.indices[0]][0, 0] == 7.0
+        # unselected leaves untouched, original list untouched
+        assert leaves[f.indices[0]][0, 0] == 1.0
+
+    def test_vector_roundtrip(self):
+        import jax
+
+        from fedml_tpu.delivery import flatten_leaves
+
+        f = PayloadFilter("kernel", self.tree())
+        leaves = jax.tree.leaves(self.tree())
+        vec = f.select_vector(leaves)
+        assert vec.size == 4 * 3 + 3 * 2
+        back = f.split_vector(vec)
+        assert [b.shape for b in back] == [(4, 3), (3, 2)]
+        # slicing the FLAT model vector selects the same bytes as
+        # selecting leaves then flattening (the codec decode fast path)
+        full = flatten_leaves(leaves)
+        np.testing.assert_array_equal(f.select_from_vector(full), vec)
+        with pytest.raises(ValueError, match="does not match"):
+            f.select_from_vector(full[:-1])
+
+    def test_no_match_and_match_all_refused(self):
+        with pytest.raises(ValueError, match="matches no leaf"):
+            PayloadFilter("nonexistent", self.tree())
+        with pytest.raises(ValueError, match="EVERY leaf"):
+            PayloadFilter(".*", self.tree())
+        with pytest.raises(ValueError, match="bad payload_filter"):
+            PayloadFilter("(", self.tree())
+
+    def test_from_args(self):
+        a = types.SimpleNamespace(payload_filter="")
+        assert filter_from_args(a, self.tree()) is None
+        a.payload_filter = "bias"
+        assert filter_from_args(a, self.tree()).selected_names == [
+            "params/Dense_0/bias"]
+
+
+class TestDeliveryIdentity:
+    def test_plain_world_has_no_identity(self):
+        assert delivery_identity(types.SimpleNamespace()) is None
+
+    def test_codec_and_filter_are_identity(self):
+        a = types.SimpleNamespace(compression="topk", compression_ratio=0.05,
+                                  payload_filter="kernel",
+                                  delta_store_versions=4)
+        ident = delivery_identity(a)
+        assert ident == {"store_versions": 4, "compression": "topk",
+                         "compression_ratio": 0.05,
+                         "payload_filter": "kernel"}
+
+
+# ---------------------------------------------------------------------------
+# the tentpole pins: S2C parity, async×compression, eviction, ledger
+# ---------------------------------------------------------------------------
+
+
+class TestS2CDeltaParity:
+    def test_delta_sync_bitwise_equals_full_broadcast(self):
+        """S2C delta shipping (the default) must reproduce the
+        full-broadcast federation BITWISE — server global AND every
+        client's installed params — with delta frames provably used."""
+        import jax
+
+        reg = telemetry.registry()
+        frames0 = reg.counter("comm.delta.s2c_delta_frames")
+        r_full, s_full, c_full = run_world("s2c-full", s2c_delta="off")
+        assert reg.counter("comm.delta.s2c_delta_frames") == frames0
+        r_delta, s_delta, c_delta = run_world("s2c-delta")
+        assert reg.counter("comm.delta.s2c_delta_frames") > frames0
+        for i, (a, b) in enumerate(zip(global_leaves(s_full),
+                                       global_leaves(s_delta))):
+            assert a.dtype == b.dtype and np.array_equal(a, b), f"leaf {i}"
+        assert r_delta["test_acc"] == r_full["test_acc"]
+        for cf, cd in zip(c_full, c_delta):
+            for a, b in zip(
+                    jax.tree.leaves(cf.manager.trainer.get_model_params()),
+                    jax.tree.leaves(cd.manager.trainer.get_model_params())):
+                assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_delta_frames_save_bytes_on_the_wire(self):
+        reg = telemetry.registry()
+        saved0 = reg.counter("comm.delta.s2c_bytes_saved")
+        run_world("s2c-bytes", compression="topk", compression_ratio=0.05)
+        assert reg.counter("comm.delta.s2c_bytes_saved") > saved0
+
+
+class TestAsyncCompression:
+    def _server(self, run_id, **kw):
+        args_s = make_args(run_id, role="server", aggregation_mode="async",
+                           async_buffer_size=3, **kw)
+        ds, od = data_mod.load(args_s)
+        bundle = model_mod.create(args_s, od)
+        return FedMLCrossSiloServer(args_s, None, ds, bundle).manager, args_s
+
+    def test_stale_delta_decodes_against_true_base_and_weight(self):
+        """ISSUE 9 acceptance: a client that trained version 1 while the
+        server moved to version 3 has its compressed delta decoded against
+        the STORED version-1 global (not the head) and folded with weight
+        n·(1+s)^-alpha for s = 2 — exactly."""
+        import jax
+
+        from fedml_tpu.core.compression import UpdateCodec
+        from fedml_tpu.utils.tree import (
+            tree_flatten_to_vector,
+            tree_unflatten_from_vector,
+        )
+
+        mgr, args_s = self._server(
+            "stale-decode", compression="topk", compression_ratio=0.25,
+            async_staleness_alpha=1.0,
+        )
+        gvec, treedef, shapes = tree_flatten_to_vector(mgr.global_params)
+        base1 = np.asarray(gvec) + 1.0  # a known version-1 global
+        mgr.store.put(1, base1)
+        mgr.store.put(2, np.asarray(gvec) + 2.0)
+        mgr.round_idx = 3  # head version
+        mgr.store.put(3, np.asarray(gvec) + 3.0)
+
+        # the client trained FROM version 1 and ships a compressed delta
+        codec = UpdateCodec(args_s)
+        trained = base1 + np.linspace(0.0, 1.0, base1.size,
+                                      dtype=np.float32)
+        arrays, meta = codec.encode(base1, trained, 1)
+        item = (time.monotonic(), 2, 1, 5.0, arrays, meta, None)
+        mgr._async_fold(item)
+
+        entries = mgr.buffer.drain()
+        assert len(entries) == 1
+        e = entries[0]
+        assert e.sender == 2 and e.client_version == 1
+        assert e.staleness == 3 - 1
+        assert e.weight == pytest.approx(5.0 * (1.0 + 2) ** -1.0)
+        # decoded against the TRUE base: bitwise equal to decoding by hand
+        expect = tree_unflatten_from_vector(
+            UpdateCodec.decode(base1, arrays, meta), treedef, shapes)
+        for a, b in zip(jax.tree.leaves(expect), jax.tree.leaves(e.params)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_evicted_c2s_base_drops_and_resyncs(self):
+        """A compressed delta whose base version was evicted cannot decode
+        — the update is dropped (counted) and the sender is resynced at
+        version head, never folded corrupt."""
+        from fedml_tpu.core.compression import UpdateCodec
+        from fedml_tpu.utils.tree import tree_flatten_to_vector
+
+        reg = telemetry.registry()
+        missing0 = reg.counter("comm.delta.c2s_base_missing")
+        mgr, args_s = self._server(
+            "evict-c2s", compression="topk", compression_ratio=0.25,
+            delta_store_versions=2,
+        )
+        gvec, _, _ = tree_flatten_to_vector(mgr.global_params)
+        base0 = np.asarray(gvec)
+        for ver in (5, 6):  # capacity 2: version 0 (init) is evicted
+            mgr.store.put(ver, base0 + ver)
+        mgr.round_idx = 6
+        codec = UpdateCodec(args_s)
+        arrays, meta = codec.encode(base0, base0 + 0.5, 0)
+        mgr._async_fold((time.monotonic(), 1, 0, 1.0, arrays, meta, None))
+        assert mgr.buffer.occupancy() == 0
+        assert reg.counter("comm.delta.c2s_base_missing") == missing0 + 1
+
+    def test_async_compressed_world_matches_sync_compressed(self):
+        """async K=N alpha=0 ≡ sync BITWISE — now WITH compression on,
+        proving the store-decoded path hits the same aggregation core."""
+        r_sync, s_sync, _ = run_world(
+            "comp-sync", compression="topk", compression_ratio=0.1)
+        r_async, s_async, _ = run_world(
+            "comp-async", aggregation_mode="async", async_buffer_size=3,
+            async_staleness_alpha=0.0, compression="topk",
+            compression_ratio=0.1,
+        )
+        assert s_async.manager.round_idx == s_sync.manager.round_idx == 3
+        for i, (a, b) in enumerate(zip(global_leaves(s_sync),
+                                       global_leaves(s_async))):
+            assert a.dtype == b.dtype and np.array_equal(a, b), f"leaf {i}"
+
+
+class TestS2CEvictionFallback:
+    def test_evicted_ack_falls_back_to_full_frame(self):
+        mgr, _ = TestAsyncCompression()._server(
+            "evict-s2c", delta_store_versions=2)
+        reg = telemetry.registry()
+        full0 = reg.counter("comm.delta.s2c_full_frames")
+        delta0 = reg.counter("comm.delta.s2c_delta_frames")
+        leaves = global_leaves(types.SimpleNamespace(manager=mgr))
+        vec = np.concatenate([np.ravel(l) for l in leaves])
+        with mgr._lock:
+            mgr._acked[1] = 0  # client ACKed version 0 ...
+        for ver in (7, 8):     # ... which capacity-2 evicts
+            mgr.store.put(ver, vec + ver)
+        arrays, meta = mgr._encode_model_payload(1, leaves, vec, {})
+        assert meta is None and len(arrays) == len(leaves)
+        assert reg.counter("comm.delta.s2c_full_frames") == full0 + 1
+        # a live ACK gets a delta frame with the right base version
+        with mgr._lock:
+            mgr._acked[1] = 8
+        arrays, meta = mgr._encode_model_payload(1, leaves, vec, {})
+        assert meta is not None and meta["base_version"] == 8
+        assert reg.counter("comm.delta.s2c_delta_frames") == delta0 + 1
+
+
+class TestLedgerIdentity:
+    def test_resume_under_different_delivery_config_refused(self, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        result, server, _ = run_world(
+            "deliv-ledger", compression="topk", compression_ratio=0.1,
+            checkpoint_dir=ckpt, checkpoint_rounds=1,
+        )
+        assert server.manager.round_idx == 3
+        from fedml_tpu.core.runstate import RunLedger
+
+        meta = RunLedger.for_checkpoint_dir(ckpt).meta()
+        assert meta["world"]["delivery"]["compression"] == "topk"
+        # dropping --compression is a DIFFERENT delivery config: refused
+        args_s = make_args("deliv-ledger-2", role="server",
+                           checkpoint_dir=ckpt)
+        ds, od = data_mod.load(args_s)
+        bundle = model_mod.create(args_s, od)
+        with pytest.raises(RuntimeError, match="different federation"):
+            FedMLCrossSiloServer(args_s, None, ds, bundle)
+        # and so is a different store depth under the same codec
+        args_s2 = make_args("deliv-ledger-3", role="server",
+                            checkpoint_dir=ckpt, compression="topk",
+                            compression_ratio=0.1, delta_store_versions=3)
+        with pytest.raises(RuntimeError, match="different federation"):
+            FedMLCrossSiloServer(args_s2, None, ds, bundle)
+
+
+# ---------------------------------------------------------------------------
+# dispatch policies
+# ---------------------------------------------------------------------------
+
+
+class TestDispatchPolicies:
+    def test_server_push_completes(self):
+        result, server, clients = run_world(
+            "push", aggregation_mode="async", async_buffer_size=3,
+            async_dispatch="server_push", comm_round=3,
+        )
+        assert server.manager.round_idx == 3
+        assert result is not None
+        for c in clients:
+            assert c.manager.done.wait(timeout=30)
+
+    def test_client_pull_completes_via_pull_requests(self):
+        reg = telemetry.registry()
+        pulls0 = reg.counter("traffic.pull_requests")
+        result, server, clients = run_world(
+            "pull", aggregation_mode="async", async_buffer_size=3,
+            async_dispatch="client_pull", comm_round=3,
+        )
+        assert server.manager.round_idx == 3
+        assert result is not None
+        assert reg.counter("traffic.pull_requests") > pulls0
+        for c in clients:
+            assert c.manager.done.wait(timeout=30)
+
+    def test_policy_requires_async_mode(self):
+        with pytest.raises(ValueError, match="aggregation_mode=async"):
+            Arguments(overrides=dict(async_dispatch="client_pull"))
+        with pytest.raises(ValueError, match="async_dispatch"):
+            Arguments(overrides=dict(aggregation_mode="async",
+                                     async_dispatch="bonkers"))
+
+
+# ---------------------------------------------------------------------------
+# adapter filter
+# ---------------------------------------------------------------------------
+
+
+class TestAdapterFilter:
+    def test_unselected_leaves_frozen_bitwise(self):
+        """--payload_filter kernel: bias leaves never change from init —
+        bitwise — while kernel leaves train; bytes saved is counted."""
+        import jax
+
+        from fedml_tpu.scale.partition_rules import named_tree_paths
+
+        reg = telemetry.registry()
+        saved0 = reg.counter("comm.delta.c2s_bytes_saved")
+        result, server, _ = run_world("filter", payload_filter="kernel")
+        assert server.manager.round_idx == 3
+        args_s = make_args("filter-skel", role="server")
+        ds, od = data_mod.load(args_s)
+        bundle = model_mod.create(args_s, od)
+        init = bundle.init(jax.random.PRNGKey(0))
+        final = server.manager.global_params
+        for (name, a), b in zip(named_tree_paths(init),
+                                jax.tree.leaves(final)):
+            a, b = np.asarray(a), np.asarray(b)
+            if "kernel" in name:
+                assert not np.array_equal(a, b), f"{name} never trained"
+            else:
+                assert np.array_equal(a, b), f"frozen leaf {name} drifted"
+        assert reg.counter("comm.delta.c2s_bytes_saved") > saved0
+
+    def test_filter_composes_with_compression(self):
+        result, server, _ = run_world(
+            "filter-codec", payload_filter="kernel", compression="topk",
+            compression_ratio=0.25,
+        )
+        assert server.manager.round_idx == 3
+        assert result is not None
+
+    def test_filter_mismatch_dropped_loudly(self):
+        """A filtered payload against an unfiltered server is refused,
+        counted, and never merged."""
+        mgr, _ = TestAsyncCompression()._server("filter-mismatch")
+        reg = telemetry.registry()
+        drops0 = reg.counter("comm.delta.filter_mismatch_drops")
+        out = mgr._reconstruct_update(
+            1, 0, [np.zeros(3, np.float32)], None,
+            {"pattern": "kernel", "n_selected": 1})
+        assert out is None
+        assert reg.counter("comm.delta.filter_mismatch_drops") == drops0 + 1
+
+
+# ---------------------------------------------------------------------------
+# satellites: gRPC multiplexing + raw default
+# ---------------------------------------------------------------------------
+
+
+class TestGrpcRankMultiplexing:
+    def test_port_mapping(self):
+        from fedml_tpu.core.distributed.grpc_backend import port_for_rank
+
+        assert [port_for_rank(9000, r, 1) for r in range(4)] \
+            == [9000, 9001, 9002, 9003]
+        assert port_for_rank(9000, 0, 8) == 9000
+        assert [port_for_rank(9000, r, 4) for r in range(1, 9)] \
+            == [9001] * 4 + [9002] * 4
+
+    def test_ranks_share_one_server_and_route_correctly(self):
+        from fedml_tpu.core.distributed.grpc_backend import (
+            GRPCCommManager,
+            _SharedGrpcServer,
+            port_for_rank,
+        )
+        from fedml_tpu.core.distributed.message import Message
+        from fedml_tpu.parallel.multihost import free_port
+
+        base = free_port()
+        servers0 = _SharedGrpcServer.server_count()
+        mgrs = {}
+        for rank in (0, 1, 2):
+            mgrs[rank] = GRPCCommManager(
+                host="127.0.0.1", port=port_for_rank(base, rank, 2),
+                rank=rank, world_size=3, base_port=base, ranks_per_port=2,
+            )
+        try:
+            # 3 ranks, 2 listening sockets: rank 0 alone, ranks 1+2 shared
+            assert _SharedGrpcServer.server_count() == servers0 + 2
+            got = {r: [] for r in (0, 1, 2)}
+
+            class Obs:
+                def __init__(self, r):
+                    self.r = r
+
+                def receive_message(self, t, m):
+                    got[self.r].append((t, m.get_sender_id()))
+
+            threads = []
+            for r, m in mgrs.items():
+                m.add_observer(Obs(r))
+                th = threading.Thread(target=m.handle_receive_message,
+                                      daemon=True)
+                th.start()
+                threads.append(th)
+
+            def send(frm, to, tag):
+                msg = Message(tag, frm, to)
+                msg.set_arrays([np.arange(5, dtype=np.float32)])
+                mgrs[frm].send_message(msg)
+
+            send(0, 1, "to1")
+            send(0, 2, "to2")
+            send(1, 0, "to0a")
+            send(2, 0, "to0b")
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not (
+                    ("to1", 0) in got[1] and ("to2", 0) in got[2]
+                    and len([x for x in got[0]
+                             if x[0].startswith("to0")]) == 2):
+                time.sleep(0.02)
+            assert ("to1", 0) in got[1]
+            assert ("to2", 0) in got[2]
+            assert "to1" not in [t for t, _ in got[2]]
+            assert "to2" not in [t for t, _ in got[1]]
+            assert sorted(t for t, _ in got[0] if t.startswith("to0")) \
+                == ["to0a", "to0b"]
+        finally:
+            for m in mgrs.values():
+                m.stop_receive_message()
+        # the last rank out stopped its shared server
+        assert _SharedGrpcServer.server_count() == servers0
+
+    def test_duplicate_rank_registration_refused(self):
+        from fedml_tpu.core.distributed.grpc_backend import GRPCCommManager
+        from fedml_tpu.parallel.multihost import free_port
+
+        port = free_port()
+        m = GRPCCommManager(host="127.0.0.1", port=port, rank=1,
+                            world_size=2, base_port=port - 1,
+                            ranks_per_port=1)
+        try:
+            with pytest.raises(ValueError, match="already registered"):
+                GRPCCommManager(host="127.0.0.1", port=port, rank=1,
+                                world_size=2, base_port=port - 1,
+                                ranks_per_port=1)
+        finally:
+            m.stop_receive_message()
+
+
+class TestRawWireDefault:
+    def test_schema_default_is_raw(self):
+        assert Arguments(overrides={}).grpc_wire_format == "raw"
+        assert Arguments(
+            overrides=dict(grpc_wire_format="npz")).grpc_wire_format == "npz"
+        with pytest.raises(ValueError, match="grpc_wire_format"):
+            Arguments(overrides=dict(grpc_wire_format="pickle"))
+
+    def test_corrupt_raw_frame_dropped_not_crashed(self):
+        """Chaos corrupt-frame coverage for the now-default raw format:
+        a bit-flipped raw frame is rejected by the payload digest and
+        counted, exactly like the npz path."""
+        from fedml_tpu.core.distributed.delivery import safe_deserialize
+        from fedml_tpu.core.distributed.message import Message
+
+        reg = telemetry.registry()
+        for fmt in ("raw", "npz"):
+            msg = Message("t", 1, 0)
+            msg.set_arrays([np.arange(64, dtype=np.float32)])
+            msg.wire_format = fmt
+            msg.corrupt_on_wire = True
+            corrupt0 = reg.counter("comm.corrupt_payloads")
+            assert safe_deserialize(msg.serialize(), f"test-{fmt}") is None
+            assert reg.counter("comm.corrupt_payloads") == corrupt0 + 1
+
+    def test_comm_bytes_counter_counts_frames(self):
+        from fedml_tpu.core.distributed.message import Message
+
+        reg = telemetry.registry()
+        b0 = reg.counter("comm.bytes_sent")
+        msg = Message("t", 0, 1)
+        msg.set_arrays([np.zeros(16, np.float32)])
+        frame = msg.serialize()
+        assert reg.counter("comm.bytes_sent") == b0 + len(frame)
+
+
+class TestTopDeltaSummary:
+    """`fedml_tpu top` surfaces the comm.delta.* family: hit rate, bytes
+    saved per direction, store health — silent when the plane never
+    engaged."""
+
+    @staticmethod
+    def _run_file(tmp_path, metrics):
+        import json as _json
+
+        p = tmp_path / "run_delta_edge_0.jsonl"
+        events = [
+            {"kind": "round_record", "round": 0, "wall_s": 1.0,
+             "phases": {"dispatch": 0.5}},
+            {"kind": "telemetry_summary", "metrics": metrics},
+        ]
+        p.write_text("".join(_json.dumps(e) + "\n" for e in events))
+        return str(p)
+
+    def test_delta_block_rendered(self, tmp_path, capsys):
+        from fedml_tpu.cli import main
+
+        path = self._run_file(tmp_path, {
+            "counters": {
+                "comm.delta.s2c_delta_frames": 18,
+                "comm.delta.s2c_full_frames": 2,
+                "comm.delta.s2c_bytes_saved": 3_000_000,
+                "comm.delta.c2s_delta_decodes": 24,
+                "comm.delta.c2s_bytes_saved": 5_500_000,
+                "comm.delta.server_store.evictions": 3,
+            },
+            "gauges": {"comm.delta.server_store.occupancy": 8},
+        })
+        assert main(["top", path]) == 0
+        out = capsys.readouterr().out
+        assert "delivery plane" in out
+        assert "18 delta / 2 full frames" in out
+        assert "delta hit rate 0.90" in out
+        assert "saved 3.00 MB" in out
+        assert "24 delta decodes" in out
+        assert "saved 5.50 MB" in out
+        assert "occupancy 8" in out and "evictions 3" in out
+
+    def test_plain_runs_stay_silent(self, tmp_path, capsys):
+        from fedml_tpu.cli import main
+
+        path = self._run_file(tmp_path, {"counters": {"rounds": 4}})
+        assert main(["top", path]) == 0
+        assert "delivery plane" not in capsys.readouterr().out
+
+
+class TestArgumentsSurface:
+    def test_delivery_knob_validation(self):
+        with pytest.raises(ValueError, match="compression"):
+            Arguments(overrides=dict(compression="gzip"))
+        with pytest.raises(ValueError, match="s2c_delta"):
+            Arguments(overrides=dict(s2c_delta="maybe"))
+        with pytest.raises(ValueError, match="delta_store_versions"):
+            Arguments(overrides=dict(delta_store_versions=0))
+        with pytest.raises(ValueError, match="payload_filter"):
+            Arguments(overrides=dict(payload_filter="("))
+        a = Arguments(overrides=dict(
+            compression="eftopk", compression_ratio="0.05",
+            delta_store_versions="16", aggregation_mode="async",
+            async_dispatch="server_push",
+        ))
+        assert a.compression_ratio == 0.05
+        assert a.delta_store_versions == 16
+        assert a.async_dispatch == "server_push"

@@ -255,10 +255,21 @@ class TestAsyncSyncParity:
         for a, b in zip(global_leaves(s_sync), global_leaves(s_async)):
             assert np.array_equal(a, b)
 
-    def test_async_rejects_compression(self):
-        with pytest.raises(ValueError, match="compression"):
-            run_world("par-comp", aggregation_mode="async",
-                      compression="eftopk", compression_ratio=0.1)
+    def test_async_with_compression_runs_end_to_end(self):
+        """ISSUE 9: the async×compression refusal is GONE — compressed
+        C2S deltas decode against the version-indexed model store
+        (fedml_tpu/delivery/), so the combination runs end-to-end. The
+        exact stale-base decode is pinned in tests/test_delta_plane.py."""
+        reg = telemetry.registry()
+        decodes0 = reg.counter("comm.delta.c2s_delta_decodes")
+        result, server, _ = run_world(
+            "par-comp", aggregation_mode="async", async_buffer_size=3,
+            async_staleness_alpha=0.5, compression="topk",
+            compression_ratio=0.1,
+        )
+        assert server.manager.round_idx == 3
+        assert result is not None
+        assert reg.counter("comm.delta.c2s_delta_decodes") > decodes0
 
 
 class TestAsyncShedAndRetry:

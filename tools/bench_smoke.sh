@@ -18,13 +18,13 @@ cd "$(dirname "$0")/.."
 track_dir=$(mktemp -d /tmp/fedml_bench_smoke_track.XXXXXX)
 trap 'rm -rf "$track_dir"' EXIT
 
-out=$(timeout -k 10 180 env \
+out=$(timeout -k 10 240 env \
     BENCH_PLATFORM=cpu \
     BENCH_SMOKE=1 \
-    BENCH_LEGS=fedavg,fedavg_million_client \
+    BENCH_LEGS=fedavg,fedavg_million_client,fedavg_compressed_round \
     BENCH_REGISTRY_N=20000 \
     BENCH_COHORT_K=256 \
-    BENCH_BUDGET_S=170 \
+    BENCH_BUDGET_S=220 \
     BENCH_MIN_LEG_S=5 \
     BENCH_LEG_TIMEOUT_S=100 \
     BENCH_CACHE_TTL_S=0 \
@@ -100,6 +100,17 @@ assert line.get("million_steady_compiles", -1) == 0, line
 assert line.get("million_prefetch_overlap", 0) > 0, line
 assert line.get("million_registry_n") == 20000, line
 
+# delta-delivery leg (fedml_tpu/delivery/, docs/delivery.md): the delta
+# path must ENGAGE (frames + decodes on the wire) and steady-state
+# comm.bytes must drop >= 10x at parity accuracy (ISSUE 9 acceptance)
+assert "fedavg_compressed_round_error" not in line, line
+assert "fedavg_compressed_round_skipped" not in line, line
+assert line.get("compressed_s2c_delta_frames", 0) > 0, line
+assert line.get("compressed_c2s_delta_decodes", 0) > 0, line
+assert line.get("compressed_reduction_x", 0) >= 10.0, line
+acc_drop = line.get("uncompressed_acc", 1) - line.get("compressed_acc", 0)
+assert acc_drop <= 0.05, f"accuracy not at parity: {line}"
+
 print("bench_smoke: OK —",
       f"{line['fedavg_cpu_smoke_rounds_per_sec']:.2f} rounds/s,",
       f"compile {line.get('fedavg_compile_s', '?')}s,",
@@ -108,5 +119,8 @@ print("bench_smoke: OK —",
       f"registry {line['million_registry_n']}cl",
       f"@ {line['million_rounds_per_sec']:.2f} rounds/s",
       f"(overlap {line['million_prefetch_overlap']:.2f}),",
+      f"delta {line['compressed_reduction_x']:.1f}x bytes",
+      f"(acc {line['compressed_acc']:.3f} vs"
+      f" {line['uncompressed_acc']:.3f}),",
       f"{len(records)} round records, {samples} metric samples")
 EOF

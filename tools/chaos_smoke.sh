@@ -51,13 +51,51 @@ if [ "$rc" -ne 0 ]; then
     exit 1
 fi
 
+# ---- compressed/delta leg (ISSUE 9 satellite) ------------------------------
+# the SAME fault matrix + kill/resume with the delta delivery plane on:
+# compressed C2S deltas (stateless quantize) + lossless S2C delta frames —
+# dedup and payload digests must survive DELTA frames bitwise. Fresh
+# workdir: the delivery config is run-ledger identity, so reusing leg 1's
+# checkpoints would be (correctly) refused.
+workdir_c=$(mktemp -d /tmp/fedml_chaos_smoke_comp.XXXXXX)
+trap 'rm -rf "$workdir" "$workdir_c"' EXIT
+out=$(timeout -k 10 300 env JAX_PLATFORMS=cpu python -m fedml_tpu.cli chaos \
+    --clients 2 --rounds 4 --seed 7 \
+    --loss 0.1 --duplicate 0.2 --corrupt 0.2 \
+    --compression quantize \
+    --kill-round 1 --workdir "$workdir_c" 2>/dev/null)
+rc=$?
+
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL — compressed chaos leg exited rc=$rc" >&2
+    printf '%s\n' "$out" >&2
+    exit 1
+fi
+
+python - "$out" <<'EOF'
+import json
+import sys
+
+verdict = json.loads(sys.argv[1])
+assert verdict["ok"], verdict["problems"]
+assert verdict["parity"], verdict["problems"]
+print("chaos_smoke: compressed/delta OK —",
+      f"{verdict['rounds']} rounds x {verdict['clients']} clients,",
+      f"preemption_exercised={verdict['preemption_exercised']}")
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL — compressed verdict did not validate" >&2
+    exit 1
+fi
+
 # ---- multiprocess gRPC leg (ISSUE 7 satellite) -----------------------------
 # the SAME fault matrix + kill/resume, but the clients are real OS processes
 # over gRPC (spawned via the swarm harness's ProcSpawner); parity is checked
 # against the fault-free LOOPBACK reference, so bitwise equality must hold
 # ACROSS transports
 workdir2=$(mktemp -d /tmp/fedml_chaos_smoke_grpc.XXXXXX)
-trap 'rm -rf "$workdir" "$workdir2"' EXIT
+trap 'rm -rf "$workdir" "$workdir_c" "$workdir2"' EXIT
 
 # rounds 6 x epochs 2 keeps the federation alive long enough past the
 # round-1 ledger commit for the self-SIGTERM to land (a faster world can
