@@ -402,19 +402,27 @@ def cmd_lint(args) -> int:
     analysis (P008/P009). ``--shard``: graftshard (tools/graftshard) —
     partition-rule coverage (S001), spec validity (S002), implicit-reshard
     (S003), host-transfer (S004) and static HBM budgets (S005, via
-    ``--model``/``--mesh``). Shells into the same entry points CI uses,
-    anchored at the repo root so results are identical from any cwd.
+    ``--model``/``--mesh``). ``--rep``: graftrep (tools/graftrep) —
+    determinism discipline (D001 key reuse, D002 seed provenance, D003
+    unordered accumulation, D004 dtype drift, D005 run-identity leaks) and
+    fused/unfused round structural equivalence (``--equiv``). Shells into
+    the same entry points CI uses, anchored at the repo root so results
+    are identical from any cwd.
 
     Exit codes (all suites): 0 clean, 1 findings, 2 the analyzer itself
     crashed (or usage error) — CI failures are diagnosable at a glance."""
     import subprocess
 
-    if getattr(args, "proto", False) and getattr(args, "shard", False):
-        print("fedml_tpu lint: --proto and --shard are different suites — "
-              "pick one (or run both like tools/lint_smoke.sh does)")
+    picked = [flag for flag in ("proto", "shard", "rep")
+              if getattr(args, flag, False)]
+    if len(picked) > 1:
+        print(f"fedml_tpu lint: --{picked[0]} and --{picked[1]} are "
+              "different suites — pick one (or run all four like "
+              "tools/lint_smoke.sh does)")
         return 2
     suite = ("graftproto" if getattr(args, "proto", False)
              else "graftshard" if getattr(args, "shard", False)
+             else "graftrep" if getattr(args, "rep", False)
              else "graftlint")
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if not os.path.isdir(os.path.join(repo_root, "tools", suite)):
@@ -432,7 +440,17 @@ def cmd_lint(args) -> int:
             print("fedml_tpu lint: --runtime is a graftlint/graftshard "
                   "pass; it does not combine with --proto")
             return 2
+        if suite == "graftrep":
+            print("fedml_tpu lint: --runtime is a graftlint/graftshard "
+                  "pass; graftrep's jax-backed pass is --equiv")
+            return 2
         cmd.append("--runtime")
+    if getattr(args, "equiv", False):
+        if suite != "graftrep":
+            print("fedml_tpu lint: --equiv is the graftrep round-"
+                  "equivalence pass — add --rep")
+            return 2
+        cmd.append("--equiv")
     if getattr(args, "model", ""):
         if suite != "graftshard":
             print("fedml_tpu lint: --model is the graftshard HBM "
@@ -585,7 +603,8 @@ def main(argv=None) -> int:
         "lint",
         help="run static analysis over the tree (graftlint; --proto for "
         "the comm-plane protocol suite, --shard for the TPU execution "
-        "plane's sharding/HBM suite)",
+        "plane's sharding/HBM suite, --rep for the determinism & "
+        "round-equivalence suite)",
     )
     p_lint.add_argument("paths", nargs="*", default=[],
                         help="files/dirs to lint (default: fedml_tpu)")
@@ -599,6 +618,15 @@ def main(argv=None) -> int:
                         "spec validity, implicit-reshard/host-transfer "
                         "detection, static HBM budgets) instead of "
                         "graftlint")
+    p_lint.add_argument("--rep", action="store_true",
+                        help="run graftrep (PRNG-key discipline, seed "
+                        "provenance, unordered accumulation, dtype drift, "
+                        "run-identity leaks) instead of graftlint")
+    p_lint.add_argument("--equiv", action="store_true",
+                        help="(--rep) also prove fused/unfused round "
+                        "structural equivalence: _train_round vs "
+                        "build_round_core under jax.make_jaxpr for "
+                        "FedAvg/FedOpt/SCAFFOLD")
     p_lint.add_argument("--runtime", action="store_true",
                         help="also run the suite's runtime pass: graftlint "
                         "traces the round engine under jax.make_jaxpr, "
@@ -711,6 +739,12 @@ def main(argv=None) -> int:
                          "device-host process; 1 = legacy port-per-rank)")
     p_swarm.add_argument("--port", type=int, default=18950,
                          help="gRPC base port")
+    p_swarm.add_argument("--s2c_delta", choices=("auto", "off"),
+                         default="off",
+                         help="S2C delta plane for the soak: auto makes "
+                         "devices delta-capable (ACK + base store + frame "
+                         "decode) so dispatches ship delta frames; off "
+                         "keeps the legacy full-frame soak")
     p_swarm.add_argument("--timeout", type=float, default=300.0)
     p_swarm.add_argument("--run_id", default="swarm")
     # internal: one gRPC device-host process (the orchestrator's child)

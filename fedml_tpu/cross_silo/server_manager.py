@@ -927,6 +927,9 @@ class FedMLServerManager(FedMLCommManager):
             targets = [r for r in range(1, self.size) if r not in skip]
         elif self.async_dispatch == "client_pull":
             targets = sorted(pulls - skip)
+            # one answer fan-out per version bump: how many parked pulls
+            # each bump batched (docs/telemetry.md traffic.* family)
+            telemetry.observe("traffic.pull_batch_size", float(len(targets)))
         else:
             targets = [r for r in sorted(set(senders)) if r not in skip]
         cache: Dict[int, tuple] = {}
@@ -1006,25 +1009,33 @@ class FedMLServerManager(FedMLCommManager):
             # clients): full frame, quietly
             telemetry.counter_inc("comm.delta.s2c_full_frames")
             return leaves, None
-        base_vec = self.store.get(acked)
-        if base_vec is None:
-            telemetry.counter_inc("comm.delta.s2c_full_frames")
-            logger.warning(
-                "server: client %d's ACKed version %d was evicted from the "
-                "%d-version store — falling back to a full-model frame "
-                "(raise --delta_store_versions to keep deltas flowing)",
-                client_rank, acked, self.store.capacity,
-            )
-            return leaves, None
         entry = cache.get(acked) if cache is not None else None
         if entry is None:
-            if vec is None:
-                vec = flatten_leaves(leaves)
-            arrays, meta = DeltaCodec.encode(base_vec, vec)
-            entry = (arrays, meta)
+            # ONE store lookup + ONE encode per distinct ACKed base per
+            # fan-out (client-pull batching, docs/delivery.md): a thousand
+            # parked pulls on the same base hit the store once; the evicted
+            # case is cached too so the fallback never re-probes per client
+            base_vec = self.store.get(acked)
+            if base_vec is None:
+                logger.warning(
+                    "server: ACKed version %d (client %d) was evicted from "
+                    "the %d-version store — falling back to full-model "
+                    "frames for this base (raise --delta_store_versions to "
+                    "keep deltas flowing)",
+                    acked, client_rank, self.store.capacity,
+                )
+                entry = (None, None)
+            else:
+                if vec is None:
+                    vec = flatten_leaves(leaves)
+                arrays, meta = DeltaCodec.encode(base_vec, vec)
+                entry = (arrays, meta)
             if cache is not None:
                 cache[acked] = entry
         arrays, meta = entry
+        if meta is None:
+            telemetry.counter_inc("comm.delta.s2c_full_frames")
+            return leaves, None
         raw = payload_nbytes(leaves)
         telemetry.counter_inc("comm.delta.s2c_delta_frames")
         telemetry.counter_inc(

@@ -77,7 +77,10 @@ def make_silo_dp_train_fn(bundle, args, local_cap: int, mesh, axis=SILO_AXIS):
         def epoch_body(carry, e):
             params, opt_state = carry
             erng = jax.random.fold_in(drng, e)
-            perm = jax.random.permutation(erng, local_cap)
+            # key discipline (graftrep D001): shuffle key and per-batch base
+            # derived up front — the consumed perm key is never reused
+            perm_rng, step_rng = jax.random.split(erng)
+            perm = jax.random.permutation(perm_rng, local_cap)
 
             def batch_body(carry, i):
                 params, opt_state = carry
@@ -87,7 +90,7 @@ def make_silo_dp_train_fn(bundle, args, local_cap: int, mesh, axis=SILO_AXIS):
                 bx = jnp.take(x, idx, axis=0)
                 by = jnp.take(y, idx, axis=0)
                 bmask = (idx < n_local).astype(jnp.float32)
-                brng = jax.random.fold_in(erng, i)
+                brng = jax.random.fold_in(step_rng, i)
                 (loss, _), grads = grad_fn(params, bx, by, bmask, brng)
                 # weighted all-reduce: exact global-batch gradient with
                 # per-device padding masked out

@@ -91,7 +91,11 @@ def make_local_train_fn(
 
         def epoch_body(carry, erng):
             params, opt_state = carry
-            perm = jax.random.permutation(erng, cap)
+            # key discipline (graftrep D001): the epoch key fans out into a
+            # shuffle key and a per-batch base BEFORE anything samples —
+            # a consumed key is never reused as a fold_in base
+            perm_rng, step_rng = jax.random.split(erng)
+            perm = jax.random.permutation(perm_rng, cap)
 
             def batch_body(carry, i):
                 params, opt_state = carry
@@ -99,7 +103,7 @@ def make_local_train_fn(
                 bx = jnp.take(x, idx, axis=0)
                 by = jnp.take(y, idx, axis=0)
                 bmask = (idx < n).astype(jnp.float32)
-                brng = jax.random.fold_in(erng, i)
+                brng = jax.random.fold_in(step_rng, i)
                 (loss, _), grads = grad_fn(
                     params, bx, by, bmask, brng, global_params
                 )

@@ -146,11 +146,19 @@ class SwarmClientManager(FedMLCommManager):
     dispatched model back as its update (num_samples=1), which exercises
     every server-side path (admission, staleness, folding, aggregation)
     with realistic payload bytes at a per-device cost that scales to
-    thousands."""
+    thousands.
+
+    With ``delta_capable=True`` the device also speaks the S2C delta plane
+    (docs/delivery.md): it advertises ``delta_capable`` on its C2S
+    updates, keeps a small version-indexed base store, and decodes delta
+    frames against the global it last held — so a swarm soak exercises the
+    server's per-base encode cache and ACK tracking at scale, not just
+    full-frame dispatch."""
 
     def __init__(self, args, schedule: SwarmSchedule, timers: TimerWheel,
                  comm=None, rank: int = 0, size: int = 0,
-                 backend: str = constants.COMM_BACKEND_LOOPBACK):
+                 backend: str = constants.COMM_BACKEND_LOOPBACK,
+                 delta_capable: bool = False):
         super().__init__(args, comm, rank, size, backend)
         self.schedule = schedule
         self.timers = timers
@@ -165,6 +173,14 @@ class SwarmClientManager(FedMLCommManager):
         self._version = -1
         self._arrays: List[np.ndarray] = []
         self._dropped = False
+        self._delta_on = bool(delta_capable)
+        self._store = None
+        self._leaf_meta: Optional[List] = None
+        if self._delta_on:
+            from ..delivery import VersionedModelStore
+
+            self._store = VersionedModelStore(
+                4, metric_prefix="swarm.delta_store")
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -184,18 +200,68 @@ class SwarmClientManager(FedMLCommManager):
         )
 
     def _on_ready(self, msg: Message) -> None:
+        self._announce_online()
+
+    def _announce_online(self) -> None:
+        """ONLINE announcement — also the delta-base-missing recovery (the
+        server clears this device's ACK on receipt, so the next dispatch
+        falls back to a full frame)."""
         status = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
         status.add(MyMessage.MSG_ARG_KEY_CLIENT_STATUS,
                    MyMessage.CLIENT_STATUS_ONLINE)
         self._send_quiet(status)
 
+    def _decode_frame(self, version: int, arrays,
+                      dmeta) -> Optional[List[np.ndarray]]:
+        """Delta-plane decode of one dispatch: full frames refresh the base
+        store; delta frames decode against the stored base (or trigger the
+        ONLINE resync when that base is gone)."""
+        from ..delivery import flatten_leaves
+        from ..delivery.delta_codec import DeltaCodec
+
+        if dmeta is None:
+            self._leaf_meta = [(np.asarray(a).shape, np.asarray(a).dtype)
+                               for a in arrays]
+            self._store.put(version, flatten_leaves(arrays))
+            return list(arrays)
+        base = self._store.get(int(dmeta["base_version"]))
+        if base is None or self._leaf_meta is None:
+            telemetry.counter_inc("swarm.delta_base_missing")
+            self._announce_online()
+            return None
+        vec = DeltaCodec.decode(base, arrays, dmeta)
+        self._store.put(version, vec)
+        telemetry.counter_inc("swarm.delta_decodes")
+        out, off = [], 0
+        for shape, dtype in self._leaf_meta:
+            n = int(np.prod(shape, dtype=np.int64))
+            out.append(np.asarray(vec[off:off + n],
+                                  dtype=dtype).reshape(shape))
+            off += n
+        return out
+
     def _on_dispatch(self, msg: Message) -> None:
         version = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0))
         with self._state_lock:
             if version <= self._version:
-                return  # replayed/stale dispatch
+                # replayed/stale dispatch: checked BEFORE the delta decode
+                # so a duplicated frame can never pollute the base store,
+                # inflate decode counters, or fire the ONLINE resync (which
+                # would clear the server's ACK and silently degrade this
+                # device to full frames)
+                return
+        arrays = msg.get_arrays()
+        if self._delta_on:
+            from ..delivery.delta_codec import DELTA_KEY
+
+            arrays = self._decode_frame(version, arrays, msg.get(DELTA_KEY))
+            if arrays is None:
+                return  # undecodable delta: resynced via ONLINE instead
+        with self._state_lock:
+            if version <= self._version:
+                return  # a fresher dispatch landed during the decode
             self._version = version
-            self._arrays = msg.get_arrays()
+            self._arrays = arrays
         if self._dropped:
             return  # silent device: receives, never answers
         if self.schedule.drops_out():
@@ -218,6 +284,9 @@ class SwarmClientManager(FedMLCommManager):
             MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         out.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, version)
         out.add(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0)
+        if self._delta_on:
+            # ACK: this version becomes the server's S2C delta base for us
+            out.add(MyMessage.MSG_ARG_KEY_DELTA_CAPABLE, 1)
         out.set_arrays(arrays)
         telemetry.counter_inc("swarm.updates_sent")
         self._send_quiet(out)
@@ -363,6 +432,10 @@ def python_module_cmd(module: str, *args: str) -> List[str]:
 # ---------------------------------------------------------------------------
 
 
+def _s2c_delta(a) -> str:
+    return str(getattr(a, "s2c_delta", "off") or "off").lower()
+
+
 def _server_overrides(a) -> Dict:
     return dict(
         training_type="cross_silo", dataset="synthetic", model="lr",
@@ -371,6 +444,7 @@ def _server_overrides(a) -> Dict:
         comm_round=int(a.steps), epochs=1, batch_size=8, learning_rate=0.2,
         random_seed=int(a.seed), role="server", rank=0,
         run_id=str(a.run_id),
+        s2c_delta=_s2c_delta(a),
         aggregation_mode="async",
         async_buffer_size=int(a.buffer),
         async_staleness_alpha=float(a.staleness_alpha),
@@ -480,6 +554,7 @@ def swarm_soak(a) -> Dict:
                     comm=LoopbackCommManager(rank, world_size,
                                              str(a.run_id)),
                     rank=rank, size=world_size,
+                    delta_capable=_s2c_delta(a) != "off",
                 )
                 devices.append(dev)
                 pump.add(dev)
@@ -502,6 +577,7 @@ def swarm_soak(a) -> Dict:
                     "--timeout", str(a.timeout),
                     "--procs", str(a.procs),
                     "--ranks_per_port", str(_ranks_per_port(a)),
+                    "--s2c_delta", _s2c_delta(a),
                 ))
                 base += count
 
@@ -556,6 +632,14 @@ def swarm_soak(a) -> Dict:
                                counters.get("swarm.updates_sent", 0.0)),
         "swarm_retries": (None if grpc_mode
                           else counters.get("swarm.retries", 0.0)),
+        # delta plane (server side: valid for both backends — the server
+        # always runs in the orchestrator process)
+        "s2c_delta": _s2c_delta(a),
+        "s2c_delta_frames": counters.get("comm.delta.s2c_delta_frames",
+                                         0.0),
+        "s2c_full_frames": counters.get("comm.delta.s2c_full_frames", 0.0),
+        "swarm_delta_decodes": (None if grpc_mode else
+                                counters.get("swarm.delta_decodes", 0.0)),
         "devices_finished": (
             None if grpc_mode
             else sum(1 for d in devices if d.done.is_set())),
@@ -588,6 +672,7 @@ def run_device_worker(a) -> int:
                 timers,
                 rank=rank, size=world_size,
                 backend=constants.COMM_BACKEND_GRPC,
+                delta_capable=_s2c_delta(a) != "off",
             )
             dev.run_async()
             devices.append(dev)

@@ -1,0 +1,40 @@
+"""Known-bad: PRNG keys reused after a sampler consumed them (D001)."""
+import jax
+
+
+def double_sample(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))
+    return a + b
+
+
+def sample_then_derive(key):
+    noise = jax.random.normal(key, (4,))
+    sub = jax.random.fold_in(key, 1)
+    return noise, sub
+
+
+def loop_reuse(key, xs):
+    out = []
+    for _x in xs:
+        out.append(jax.random.bernoulli(key))
+    return out
+
+
+def closure_reuse(key):
+    perm = jax.random.permutation(key, 8)
+
+    def body(i):
+        return jax.random.fold_in(key, i)
+
+    return perm, body
+
+
+def helper_consumes(key):
+    return jax.random.normal(key, (4,))
+
+
+def call_then_sample(key):
+    a = helper_consumes(key)
+    b = jax.random.normal(key, (4,))
+    return a + b

@@ -1,0 +1,36 @@
+"""Known-good: disciplined key handling — derive first, consume once."""
+import jax
+
+
+def fold_in_fanout(key):
+    k1 = jax.random.fold_in(key, 1)
+    k2 = jax.random.fold_in(key, 2)
+    k3 = jax.random.fold_in(key, 3)
+    return (jax.random.normal(k1, (4,)) + jax.random.normal(k2, (4,))
+            + jax.random.normal(k3, (4,)))
+
+
+def split_then_consume(key):
+    perm_rng, step_rng = jax.random.split(key)
+    perm = jax.random.permutation(perm_rng, 8)
+    return perm, jax.random.normal(step_rng, (4,))
+
+
+def branch_exclusive(key, flag):
+    if flag:
+        return jax.random.normal(key, (4,))
+    return jax.random.uniform(key, (4,))
+
+
+def loop_derived(key, n):
+    outs = []
+    for i in range(n):
+        outs.append(jax.random.normal(jax.random.fold_in(key, i), (4,)))
+    return outs
+
+
+def rebind_each_round(key, n):
+    for _ in range(n):
+        key, sub = jax.random.split(key)
+        _ = jax.random.normal(sub, (4,))
+    return key

@@ -1,0 +1,9 @@
+"""Pragma fixture: the same D001 shape, suppressed inline with a reason."""
+import jax
+
+
+def double_sample(key):
+    a = jax.random.normal(key, (4,))
+    # trace-time-static demo: both draws bake into one compile-time constant
+    b = jax.random.uniform(key, (4,))  # graftrep: disable=D001
+    return a + b

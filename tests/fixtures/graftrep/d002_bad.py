@@ -1,0 +1,24 @@
+"""Known-bad: PRNG seeds from wall-clock/entropy, bare module samplers."""
+import os
+import time
+
+import jax
+import numpy as np
+
+
+def clock_seed():
+    return jax.random.PRNGKey(int(time.time()))
+
+
+def entropy_seed():
+    seed = int.from_bytes(os.urandom(4), "little")
+    return np.random.RandomState(seed)
+
+
+def bare_module_sampler(n):
+    return np.random.rand(n)
+
+
+@jax.jit
+def traced_clock(x):
+    return x * time.time()

@@ -1,0 +1,17 @@
+"""Known-good: seeds derived from config/round only; seeded instances."""
+import jax
+import numpy as np
+
+
+def config_seed(args):
+    return jax.random.PRNGKey(int(args.random_seed))
+
+
+def round_sampler(round_idx, total, per):
+    rs = np.random.RandomState(round_idx)
+    return rs.choice(total, per, replace=False)
+
+
+def seeded_instance(seed, n):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=n)

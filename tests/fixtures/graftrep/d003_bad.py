@@ -1,0 +1,27 @@
+"""Known-bad: unordered iteration feeding order-visible accumulation."""
+import jax.numpy as jnp
+
+
+def float_sum_over_set(values):
+    total = 0.0
+    for v in set(values):
+        total += v
+    return total
+
+
+def stack_over_set(arrs):
+    pool = set(arrs)
+    return jnp.stack([a for a in pool])
+
+
+class Manager:
+    def __init__(self):
+        self._clients = {}
+        self._dead = set()
+
+    def fan_out(self, make_message):
+        for rank in self._clients.keys():
+            self.send_message(make_message(rank))
+
+    def weigh(self, weights):
+        return sum(weights[r] for r in self._dead)

@@ -1,0 +1,27 @@
+"""Known-good: sorted iteration, integer counting, local dict literals."""
+import jax.numpy as jnp
+
+
+def float_sum_sorted(values):
+    total = 0.0
+    for v in sorted(set(values)):
+        total += v
+    return total
+
+
+def count_over_set(values):
+    seen = set(values)
+    return sum(1 for v in seen if v is not None)
+
+
+def stack_ordered(arrs):
+    return jnp.stack([a for a in sorted(arrs)])
+
+
+class Manager:
+    def __init__(self):
+        self._clients = {}
+
+    def fan_out(self, make_message):
+        for rank in sorted(self._clients):
+            self.send_message(make_message(rank))

@@ -1,0 +1,17 @@
+"""Known-bad: float64 promotion inside traced round code."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def promote_in_traced(x):
+    scale = np.float64(0.5)
+    wide = x.astype(float)
+    acc = jnp.zeros((4,), dtype=np.float64)
+    return wide * scale + acc
+
+
+@jax.jit
+def host_reduce_in_traced(x):
+    return x - np.mean(np.ones(3))

@@ -1,0 +1,15 @@
+"""Known-good: explicit narrow dtypes on device; numpy stays host-side."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def narrow_in_traced(x):
+    scale = jnp.float32(0.5)
+    acc = jnp.zeros((4,), dtype=jnp.float32)
+    return x.astype(jnp.float32) * scale + acc
+
+
+def host_side_report(history):
+    return float(np.mean(np.asarray(history, np.float32)))

@@ -1,0 +1,18 @@
+"""Known-bad: wall-clock/host identity leaking into ledger-bound state."""
+import socket
+import time
+
+
+def commit_with_wallclock(ledger, round_idx):
+    stamp = time.time()
+    ledger.commit_round(round_idx, committed_at=stamp)
+
+
+class Engine:
+    def _ledger_world(self):
+        return {"engine": "sp", "host": socket.gethostname()}
+
+
+def clocked_control(server, msg):
+    if time.time() % 2 > 1:
+        server.send_message(msg)

@@ -1,0 +1,20 @@
+"""Known-good: pure ledger payloads; durations are telemetry, not state."""
+import time
+
+
+def commit_pure(ledger, round_idx, ckpt_step, cohort):
+    ledger.commit_round(round_idx, ckpt_step=ckpt_step, cohort=cohort)
+
+
+def duration_telemetry(telemetry, t0):
+    telemetry.observe("round.duration_s", time.time() - t0)
+
+
+class Engine:
+    def _ledger_world(self):
+        return {"engine": "sp", "optimizer": "FedAvg"}
+
+
+def deadline_control(server, msg, deadline):
+    if time.monotonic() > deadline:
+        server.send_message(msg)

@@ -1,6 +1,7 @@
-"""Shared CLI driver for the lint suites (graftlint / graftproto).
+"""Shared CLI driver for the lint suites (graftlint / graftproto /
+graftshard / graftrep).
 
-One implementation of the common contract so the two suites cannot drift:
+One implementation of the common contract so the suites cannot drift:
 
 - flags: paths, --format text|json (--json alias), --baseline,
   --no-baseline, --write-baseline (refused with --select), --select,

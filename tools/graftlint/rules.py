@@ -358,6 +358,11 @@ def check_function(analyzer: Analyzer, mod: ModuleInfo,
 
 
 def _terminates(block: List[ast.stmt]) -> bool:
+    """Whether a block's tail cannot fall through (return/raise/...).
+
+    Shared infrastructure: graftrep's D001 branch join reuses this so an
+    ``if … return`` arm's key consumption never leaks into the mutually
+    exclusive sibling arm — the same discipline G002 applies to donation."""
     if not block:
         return False
     last = block[-1]

@@ -1,0 +1,23 @@
+"""graftrep: static determinism & round-equivalence verification.
+
+The fourth static-analysis suite (after graftlint/graftproto/graftshard),
+on the same shared driver (:mod:`tools.graftlint.clikit`):
+
+- **D-rules** (pure AST, no jax import): PRNG-key discipline (D001),
+  seed provenance (D002), unordered iteration into accumulation (D003),
+  dtype-promotion drift (D004), run-identity leaks into ledger state
+  (D005) — the static enforcement of every bitwise guarantee the parity
+  tests pin at runtime.
+- **--equiv** (imports jax): traces the unfused ``FedAvgAPI._train_round``
+  trust chain and ``round_engine.build_round_core``'s fused mirror under
+  ``jax.make_jaxpr``, canonicalizes both jaxprs, and diffs them — a
+  drifted mirror is a lint finding naming the first diverging equation,
+  not a silent wait for a parity test to notice.
+
+Entry points: ``python -m tools.graftrep`` / ``fedml_tpu lint --rep``.
+"""
+
+from .analyzer import analyze_paths
+from .findings import REP_RULES, Finding
+
+__all__ = ["analyze_paths", "Finding", "REP_RULES"]

@@ -1,0 +1,374 @@
+"""``--equiv``: fused/unfused round structural equivalence under make_jaxpr.
+
+The fused round path (``round_engine.build_round_core``) is a hand-written
+mirror of ``FedAvgAPI._train_round`` — defended, until now, only by runtime
+parity tests that compare numbers to a tolerance. This module proves the
+stronger structural claim: both paths trace to the SAME canonical jaxpr.
+
+How the two traces are aligned:
+
+- **Unfused**: the real ``_train_round`` is traced with its host seams
+  pinned — ``_client_sampling`` returns the fixed cohort, ``_gather_cohort``
+  returns the wrapper's traced ``(cx, cy, cn)`` arguments, and the
+  host-float ``sp_api._masked_mean`` is swapped for the device twin
+  (``round_engine._masked_mean``) for the duration of the trace (the host
+  pull is the loss-sync seam, outside the compared chain). The new round
+  state is read back off the api object.
+- **Fused**: ``build_round_core``'s program over the same traced arguments.
+  Both wrappers compute ``fold_in``/``split`` on the CONCRETE root key, so
+  PRNG material enters both jaxprs as (equal) constants, not equations.
+- Returned values are pinned to ``(new_state, train_loss)`` on both sides;
+  everything else is dead code and removed by DCE.
+
+Canonicalization (the rules, also documented in docs/graftrep.md):
+
+1. **DCE** — backward liveness from the outputs; unused equations (e.g. the
+   fused path's ``examples`` counter) drop out.
+2. **Constant folding by content** — consts and literals are labeled by
+   ``dtype/shape/sha1(bytes)``, so equal values unify regardless of which
+   trace produced them, and alpha-renaming cannot hide a changed constant.
+3. **Parallel-safe ordering** — equations are re-scheduled by Kahn's
+   algorithm, breaking ties by (primitive, params, operand labels): any two
+   topological orders of the same dataflow graph canonicalize identically.
+4. **Alpha-renaming** — inputs become ``in0..inN``, scheduled outputs
+   ``v0..vN``; sub-jaxprs (pjit/scan bodies) are canonicalized recursively
+   and expanded inline so a divergence INSIDE the shared cohort program is
+   still named precisely.
+
+Limits: this is structural equality of the traced programs, not of XLA's
+optimized HLO; host-side seams (sampling, gather, loss sync, telemetry)
+are pinned equal by construction and verified separately by the parity
+tests; FedSGD/FedNova share the same aggregate core but have no fused/
+unfused *pair* of mirrors to compare.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .findings import Finding
+
+REL_ENGINE = os.path.join("fedml_tpu", "simulation",
+                          "round_engine.py").replace(os.sep, "/")
+
+
+# ---------------------------------------------------------------------------
+# jaxpr canonicalization
+# ---------------------------------------------------------------------------
+
+
+def _const_label(val: Any) -> str:
+    import numpy as np
+
+    try:
+        arr = np.asarray(val)
+        h = hashlib.sha1(
+            arr.tobytes() + str(arr.dtype).encode() + str(arr.shape).encode()
+        ).hexdigest()[:10]
+        return f"const[{arr.dtype}{list(arr.shape)}:{h}]"
+    except Exception:  # non-array const (rare)
+        return f"const[{type(val).__name__}:{val!r}]"
+
+
+def _aval_str(v: Any) -> str:
+    aval = getattr(v, "aval", None)
+    if aval is None:
+        return "?"
+    short = getattr(aval, "str_short", None)
+    return short() if callable(short) else str(aval)
+
+
+def _is_jaxpr_like(v: Any) -> bool:
+    return hasattr(v, "eqns") or hasattr(v, "jaxpr")
+
+
+def _param_label(v: Any, memo: Optional[Dict[int, Tuple]] = None
+                 ) -> Tuple[str, Optional[List[str]]]:
+    """(stable label for scheduling/diff, expanded sub-lines or None).
+
+    ``memo`` (id(param) → result, scoped to one ``canonicalize`` call so
+    ids stay live) keeps sub-jaxpr canonicalization linear: scheduling
+    consults every ready eqn's signature repeatedly, and without the memo
+    each consult would re-canonicalize the whole pjit/scan body."""
+    if memo is not None:
+        hit = memo.get(id(v))
+        if hit is not None:
+            return hit
+    if _is_jaxpr_like(v):
+        sub = canonicalize(v)
+        digest = hashlib.sha1("\n".join(sub).encode()).hexdigest()[:10]
+        out: Tuple[str, Optional[List[str]]] = (f"jaxpr:{digest}", sub)
+    elif isinstance(v, (list, tuple)) and any(_is_jaxpr_like(x) for x in v):
+        labels, subs = [], []
+        for x in v:
+            lab, sub = _param_label(x, memo)
+            labels.append(lab)
+            if sub:
+                subs.extend(sub)
+        out = ("[" + ", ".join(labels) + "]", subs or None)
+    elif callable(v):
+        out = (f"fn:{getattr(v, '__name__', type(v).__name__)}", None)
+    else:
+        out = (repr(v), None)
+    if memo is not None:
+        memo[id(v)] = out
+    return out
+
+
+def canonicalize(closed: Any,
+                 _depth: int = 0) -> List[str]:
+    """ClosedJaxpr/Jaxpr → canonical line list (see module docstring)."""
+    jaxpr = getattr(closed, "jaxpr", closed)
+    consts = list(getattr(closed, "consts", ()))
+    if len(consts) < len(jaxpr.constvars):
+        # raw Jaxpr param (scan body etc.): no const values — label by aval
+        consts = None
+
+    names: Dict[Any, str] = {}
+    for i, v in enumerate(jaxpr.invars):
+        names[v] = f"in{i}:{_aval_str(v)}"
+    for i, v in enumerate(jaxpr.constvars):
+        if consts is not None:
+            names[v] = _const_label(consts[i])
+        else:
+            names[v] = f"cvar:{_aval_str(v)}"
+
+    def label_of(v: Any) -> str:
+        if hasattr(v, "val"):  # Literal
+            return _const_label(v.val)
+        return names.get(v, "?unbound")
+
+    # DCE: backward liveness from the outputs
+    live = {v for v in jaxpr.outvars if not hasattr(v, "val")}
+    kept: List[Any] = []
+    for eqn in reversed(jaxpr.eqns):
+        if any(o in live for o in eqn.outvars):
+            kept.append(eqn)
+            for iv in eqn.invars:
+                if not hasattr(iv, "val"):
+                    live.add(iv)
+    kept.reverse()
+
+    # Kahn scheduling with deterministic content tie-break. Signatures are
+    # memoized per eqn (operand labels are final once an eqn is ready, and
+    # eqn_sig only ever runs on ready eqns) and sub-jaxpr canonicalization
+    # per param object — without these the scheduler re-canonicalizes the
+    # pjit cohort program O(n^2) times.
+    defined = set(names)
+    remaining = list(kept)
+    lines: List[str] = []
+    counter = [0]
+    param_memo: Dict[int, Tuple] = {}
+    sig_memo: Dict[int, Tuple] = {}
+
+    def eqn_sig(eqn: Any) -> Tuple:
+        sig = sig_memo.get(id(eqn))
+        if sig is not None:
+            return sig
+        ops = tuple(label_of(v) for v in eqn.invars)
+        param_bits = []
+        for k in sorted(eqn.params):
+            lab, _sub = _param_label(eqn.params[k], param_memo)
+            param_bits.append(f"{k}={lab}")
+        sig = (eqn.primitive.name, tuple(param_bits), ops)
+        sig_memo[id(eqn)] = sig
+        return sig
+
+    while remaining:
+        ready = [e for e in remaining
+                 if all((hasattr(v, "val") or v in defined)
+                        for v in e.invars)]
+        if not ready:  # cycle cannot happen in a jaxpr; defensive
+            ready = remaining[:1]
+        chosen = min(ready, key=eqn_sig)
+        remaining.remove(chosen)
+        prim, params, ops = eqn_sig(chosen)
+        outs = []
+        for o in chosen.outvars:
+            if type(o).__name__ == "DropVar":
+                outs.append("_")
+                continue
+            nm = f"v{counter[0]}:{_aval_str(o)}"
+            counter[0] += 1
+            names[o] = nm
+            defined.add(o)
+            outs.append(nm)
+        lines.append(f"{', '.join(outs)} = {prim}"
+                     f"[{' '.join(params)}] {' '.join(ops)}")
+        for k in sorted(chosen.params):
+            _lab, sub = _param_label(chosen.params[k], param_memo)
+            if sub:
+                pad = "  " * (_depth + 1)
+                lines.extend(f"{pad}{k}> {ln}" for ln in sub)
+
+    lines.append("return " + " ".join(label_of(v) for v in jaxpr.outvars))
+    return lines
+
+
+def diff_canonical(a: List[str], b: List[str]
+                   ) -> Optional[Tuple[int, str, str]]:
+    """First diverging (index, line_a, line_b), or None when equal."""
+    for i, (la, lb) in enumerate(zip(a, b)):
+        if la != lb:
+            return i, la, lb
+    if len(a) != len(b):
+        i = min(len(a), len(b))
+        return (i,
+                a[i] if i < len(a) else "<end of unfused program>",
+                b[i] if i < len(b) else "<end of fused program>")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# tracing the two round paths
+# ---------------------------------------------------------------------------
+
+
+def _example_round(api):
+    """(per, cohort, cx, cy, cn, state0) — the same example geometry the
+    graftlint runtime pass uses."""
+    import numpy as np
+
+    per = min(int(api.args.client_num_per_round), api.ds.client_num)
+    cohort = np.arange(per)
+    cx, cy, cn = api._gather_cohort(cohort)
+    return per, cohort, cx, cy, cn, api._round_state()
+
+
+def trace_fused(api, per: int, cohort, cx, cy, cn, state0,
+                round_idx: int = 0,
+                core_factory: Optional[Callable] = None):
+    """Canonical jaxpr of the fused mirror over traced (state, cx, cy, cn).
+
+    ``core_factory`` defaults to the real ``build_round_core``; tests pass
+    a skewed factory to prove the checker bites.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.simulation.round_engine import build_round_core
+
+    factory = core_factory or build_round_core
+    core = factory(api, n_cohort=per, n_valid=per)
+
+    def fused(state, cx_, cy_, cn_):
+        # concrete key math: fold_in/split run eagerly on the real root key,
+        # entering the jaxpr as constants — identical on the unfused side
+        rkey = jax.random.fold_in(api.root_rng, round_idx)
+        rngs = jax.random.split(rkey, per)
+        cohort_idx = jnp.asarray(cohort, jnp.int32)
+        new_state, metrics = core(state, cohort_idx, cx_, cy_, cn_, rngs,
+                                  None, rkey)
+        return new_state, metrics["train_loss"]
+
+    return jax.make_jaxpr(fused)(state0, cx, cy, cn)
+
+
+def trace_unfused(api, per: int, cohort, cx, cy, cn, state0,
+                  round_idx: int = 0):
+    """Canonical jaxpr of the REAL ``_train_round`` with host seams pinned
+    (see module docstring). Restores every patched attribute."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.simulation import round_engine
+    from fedml_tpu.simulation import sp_api as sp_mod
+
+    saved_state = api._round_state()
+    saved_sampling = api._client_sampling
+    saved_gather = api._gather_cohort
+    saved_mm = sp_mod._masked_mean
+    # the cohort-index seam is pinned to the representation the fused
+    # caller (_train_round_fused) ships: int32 on device — np-int64 host
+    # indices lower through an extra device_put that is a seam artifact,
+    # not round math
+    cohort_dev = jnp.asarray(cohort, jnp.int32)
+
+    def unfused(state, cx_, cy_, cn_):
+        api._set_round_state(dict(state))
+        api._gather_cohort = lambda _c: (cx_, cy_, cn_)
+        out = api._train_round(round_idx)
+        return api._round_state(), out["train_loss"]
+
+    try:
+        api._client_sampling = lambda _r: cohort_dev
+        sp_mod._masked_mean = round_engine._masked_mean
+        return jax.make_jaxpr(unfused)(state0, cx, cy, cn)
+    finally:
+        sp_mod._masked_mean = saved_mm
+        api._client_sampling = saved_sampling
+        api._gather_cohort = saved_gather
+        api._set_round_state(saved_state)
+
+
+def compare_round_paths(api, round_idx: int = 0,
+                        core_factory: Optional[Callable] = None) -> Dict:
+    """Trace both mirrors, canonicalize, diff. Returns the verdict dict
+    that rides the JSON payload (one row per optimizer)."""
+    per, cohort, cx, cy, cn, state0 = _example_round(api)
+    closed_u = trace_unfused(api, per, cohort, cx, cy, cn, state0,
+                             round_idx)
+    closed_f = trace_fused(api, per, cohort, cx, cy, cn, state0,
+                           round_idx, core_factory=core_factory)
+    canon_u = canonicalize(closed_u)
+    canon_f = canonicalize(closed_f)
+    delta = diff_canonical(canon_u, canon_f)
+    row: Dict[str, Any] = {
+        "optimizer": str(api.opt_name),
+        "equal": delta is None,
+        "eqn_count_unfused": len(canon_u),
+        "eqn_count_fused": len(canon_f),
+        "diverges_at": None,
+    }
+    if delta is not None:
+        i, lu, lf = delta
+        row["diverges_at"] = i
+        row["unfused_eqn"] = lu
+        row["fused_eqn"] = lf
+    return row
+
+
+# ---------------------------------------------------------------------------
+# the --equiv entry
+# ---------------------------------------------------------------------------
+
+
+def check_round_equivalence(repo_root: str) -> Tuple[List[Finding], List[Dict]]:
+    """Compare the mirrors for FedAvg/FedOpt/SCAFFOLD; a divergence is a
+    D006 finding naming the first differing canonical equation."""
+    sys.path.insert(0, repo_root)
+    try:
+        from ..graftlint.runtime_check import _CONFIGS, _tiny_api
+    except Exception as e:  # pragma: no cover - env without the package
+        raise RuntimeError(
+            f"graftrep --equiv unavailable: {type(e).__name__}: {e}"
+        ) from e
+
+    findings: List[Finding] = []
+    report: List[Dict] = []
+    for overrides in _CONFIGS:
+        opt = overrides["federated_optimizer"]
+        try:
+            api = _tiny_api(overrides)
+            row = compare_round_paths(api)
+        except Exception as e:  # the tracer itself failing is exit 2
+            raise RuntimeError(
+                f"graftrep --equiv: tracing {opt} failed: "
+                f"{type(e).__name__}: {e}"
+            ) from e
+        report.append(row)
+        if not row["equal"]:
+            findings.append(Finding(
+                rule="D006", path=REL_ENGINE, line=1, col=0,
+                message=(
+                    f"fused mirror diverges from _train_round for {opt} at "
+                    f"canonical eqn {row['diverges_at']}: unfused "
+                    f"`{row['unfused_eqn']}` vs fused `{row['fused_eqn']}`"
+                ),
+                # one baseline key per (optimizer, divergence site)
+                line_text=f"equiv::{opt}::{row['diverges_at']}",
+            ))
+    return findings, report

@@ -1,0 +1,1000 @@
+"""Rule checkers D001–D005 over the analyzed function set.
+
+The D-rules statically enforce the determinism discipline underneath the
+repo's bitwise guarantees:
+
+- **D001** PRNG-key reuse: a key is *dead* after a sampler consumed it.
+  Deriving (``split``/``fold_in``) is unlimited; sampling is once-per-key.
+  Dataflow-tracked through locals, aliases, closures (nested defs analyzed
+  in source order with proper scoping) and helper calls (interprocedural
+  "consumes-param" summaries).
+- **D002** nondeterministic seed provenance: wall-clock / ``os.urandom`` /
+  ``id()`` flowing into a PRNG seed position, any of those appearing inside
+  traced code (they bake into trace constants that differ per process), and
+  bare unseeded ``random``/``np.random`` module samplers anywhere.
+- **D003** unordered iteration into accumulation: a ``set`` (or a shared
+  attr-``dict`` populated in arrival order) feeding a float sum, a
+  ``jnp``/``np`` reduction/stack, or a ``Message`` fan-out — float addition
+  and wire bytes are both order-visible. (Dict-comprehension-over-set
+  pytree construction is graftlint G003's, not repeated here.)
+- **D004** dtype-promotion drift: explicit float64 / ``dtype=float`` casts
+  and host ``np.*`` reductions inside traced or round/aggregation code —
+  x86 promotes where TPU does not, killing cross-platform bitwise parity.
+- **D005** run-identity leaks: wall-clock/hostname/pid flowing into
+  ledger-committed state (``commit_round``/``ensure_meta`` payloads, the
+  round-state/world dicts a resume replays) or gating send/aggregate/commit
+  control flow.
+
+Scope notes (documented limits, mirrored in docs/graftrep.md): D001 treats
+nested function bodies as loop bodies (a ``lax.scan`` body runs per step);
+``monotonic``/``perf_counter`` are durations, not run identity, and stay
+out of D005; D003's dict half only fires on *attribute* dicts (shared,
+arrival-ordered) — local literals are insertion-ordered by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..graftlint.analyzer import (
+    Analyzer,
+    FuncInfo,
+    ModuleInfo,
+    _is_jaxish,
+    _is_numpy,
+    _walk_shallow,
+    dotted,
+)
+from .findings import Finding
+
+# jax.random functions that DERIVE new keys (unlimited uses of the key arg)
+DERIVERS = {"split", "fold_in", "clone", "wrap_key_data"}
+# jax.random functions with no key argument at all
+KEYLESS = {"PRNGKey", "key", "key_data", "key_impl", "default_prng_impl"}
+
+# module-level samplers on the stdlib `random` / `np.random` modules that
+# draw from hidden, unseeded global state
+BARE_SAMPLERS = {
+    "random", "randint", "randrange", "uniform", "choice", "choices",
+    "shuffle", "sample", "getrandbits", "gauss", "normalvariate",
+    "betavariate", "expovariate", "vonmisesvariate", "paretovariate",
+    "weibullvariate", "triangular", "lognormvariate", "rand", "randn",
+    "normal", "permutation", "bytes", "standard_normal", "binomial",
+    "poisson", "exponential", "gumbel",
+}
+
+# wall-clock / machine-identity producers. monotonic/perf_counter are
+# durations — deliberately absent (timeout/flush logic is legitimate).
+WALLCLOCK_FNS = {
+    "time.time", "time.time_ns", "datetime.now", "datetime.utcnow",
+    "datetime.datetime.now", "datetime.datetime.utcnow", "date.today",
+    "datetime.date.today",
+}
+IDENTITY_FNS = {
+    "socket.gethostname", "socket.getfqdn", "platform.node", "os.getpid",
+    "os.getppid", "os.uname", "uuid.uuid1", "uuid.uuid4", "getpass.getuser",
+    "os.getlogin",
+}
+ENTROPY_FNS = {
+    "os.urandom", "secrets.token_bytes", "secrets.token_hex",
+    "secrets.randbits", "secrets.randbelow", "secrets.token_urlsafe",
+}
+
+# seed sinks: (call-name-tail, positions of the seed-carrying args)
+SEED_SINK_TAILS = {
+    "PRNGKey": (0,), "key": (0,), "fold_in": (1,), "seed": (0,),
+    "RandomState": (0,), "default_rng": (0,),
+}
+
+NP_REDUCERS = {"mean", "sum", "average", "var", "std", "prod", "dot",
+               "cumsum", "nansum", "nanmean"}
+
+SUMMISH_JNP = {"sum", "mean", "average", "stack", "concatenate", "prod",
+               "asarray", "array"}
+
+LEDGER_SINKS = {"commit_round", "ensure_meta"}
+ROUND_STATE_FNS = ("_ledger_world", "ledger_identity", "_round_state",
+                   "_ckpt_state")
+
+_ROUNDISH = ("aggregate", "_train_round", "round_core", "superround",
+             "_round_state")
+
+
+def _mk(mod: ModuleInfo, rule: str, node: ast.AST, message: str) -> Finding:
+    line = getattr(node, "lineno", 1)
+    col = getattr(node, "col_offset", 0)
+    return Finding(rule=rule, path=mod.rel, line=line, col=col,
+                   message=message, line_text=mod.line_text(line))
+
+
+def _jax_random_fn(mod: ModuleInfo, call: ast.Call) -> Optional[str]:
+    """``jax.random.X(...)`` (any import spelling) → ``"X"``, else None."""
+    ds = dotted(call.func)
+    if ds is None:
+        return None
+    parts = ds.split(".")
+    last = parts[-1]
+    if len(parts) == 1:
+        # from jax.random import split / fold_in / normal ...
+        fi = mod.from_imports.get(last)
+        if fi and fi[0] in ("jax.random", "jax._src.random"):
+            return fi[1]
+        return None
+    head = parts[0]
+    # jax.random.X / jrandom.X (import jax.random as jrandom) /
+    # random.X (from jax import random)
+    if head == "jax" and len(parts) >= 3 and parts[1] == "random":
+        return last
+    tgt = mod.imports.get(head, "")
+    if tgt == "jax.random":
+        return last
+    fi = mod.from_imports.get(head)
+    if fi and fi[0] == "jax" and fi[1] == "random":
+        return last
+    return None
+
+
+def _key_arg(call: ast.Call, fname: str) -> Optional[ast.expr]:
+    if fname in KEYLESS:
+        return None
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "key":
+            return kw.value
+    return None
+
+
+def _np_random_fn(mod: ModuleInfo, call: ast.Call) -> Optional[str]:
+    """``np.random.X(...)`` / stdlib ``random.X(...)`` → ``"X"``."""
+    ds = dotted(call.func)
+    if ds is None:
+        return None
+    parts = ds.split(".")
+    if len(parts) < 2:
+        return None
+    head, last = parts[0], parts[-1]
+    if _is_jaxish(mod, head):
+        return None
+    if len(parts) == 3 and parts[1] == "random" and _is_numpy(mod, head):
+        return last
+    if len(parts) == 2 and mod.imports.get(head, head) == "random" \
+            and head == "random":
+        return last
+    if len(parts) == 2 and mod.imports.get(head, "") == "numpy.random":
+        return last
+    return None
+
+
+def _source_call(mod: ModuleInfo, e: ast.expr,
+                 names: Sequence[str]) -> Optional[str]:
+    """``e`` is a call to one of the dotted ``names`` (suffix-matched on the
+    last two components so ``dt.datetime.now()`` still resolves)."""
+    if not isinstance(e, ast.Call):
+        return None
+    ds = dotted(e.func)
+    if ds is None:
+        return None
+    for want in names:
+        if ds == want or ds.endswith("." + want):
+            return want
+    return None
+
+
+def _expr_contains(e: ast.expr, pred) -> Optional[ast.expr]:
+    for node in ast.walk(e):
+        if isinstance(node, ast.expr) and pred(node):
+            return node
+    return None
+
+
+# ---------------------------------------------------------------------------
+# D001: PRNG-key reuse
+# ---------------------------------------------------------------------------
+
+
+def build_key_summaries(modules: Dict[str, ModuleInfo],
+                        lint: Analyzer) -> Dict[FuncInfo, Set[int]]:
+    """Param positions each function CONSUMES as PRNG keys (a sampler uses
+    them, directly or through one resolved call hop) — the interprocedural
+    half of D001."""
+    consumes: Dict[FuncInfo, Set[int]] = {}
+    funcs = [(m, f) for m in modules.values()
+             for f in m.funcs_by_node.values()]
+    for _ in range(3):
+        changed = False
+        for mod, fi in funcs:
+            pos_of = {name: i for i, name in enumerate(fi.params())}
+            cur = consumes.setdefault(fi, set())
+            for node in _walk_shallow(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = _jax_random_fn(mod, node)
+                if fname is not None:
+                    if fname in DERIVERS or fname in KEYLESS:
+                        continue
+                    karg = _key_arg(node, fname)
+                    if isinstance(karg, ast.Name) and karg.id in pos_of:
+                        if pos_of[karg.id] not in cur:
+                            cur.add(pos_of[karg.id])
+                            changed = True
+                    continue
+                for t in lint.resolve_call_targets(mod, fi, node):
+                    for p in consumes.get(t, ()):  # callee's consumed params
+                        if p < len(node.args) and isinstance(
+                                node.args[p], ast.Name):
+                            name = node.args[p].id
+                            if name in pos_of and pos_of[name] not in cur:
+                                cur.add(pos_of[name])
+                                changed = True
+        if not changed:
+            break
+    return consumes
+
+
+class _Key:
+    __slots__ = ("id", "depth")
+    _next = [0]
+
+    def __init__(self, depth: int):
+        _Key._next[0] += 1
+        self.id = _Key._next[0]
+        self.depth = depth
+
+
+class _Binding:
+    __slots__ = ("depth", "key")
+
+    def __init__(self, depth: int):
+        self.depth = depth
+        self.key: Optional[_Key] = None
+
+
+class _D001Checker:
+    """Whole-closure-tree key analysis: runs on each TOP-LEVEL function and
+    descends into nested defs in source order (a nested body is treated as
+    a loop body — ``lax.scan``/``vmap`` bodies execute per step)."""
+
+    def __init__(self, lint: Analyzer, mod: ModuleInfo, fi: FuncInfo,
+                 summaries: Dict[FuncInfo, Set[int]]):
+        self.lint = lint
+        self.mod = mod
+        self.root = fi
+        self.summaries = summaries
+        self.findings: List[Finding] = []
+        self.scopes: List[Dict[str, _Binding]] = []
+        self.attr_keys: Dict[str, _Key] = {}
+        self.consumed: Dict[int, Tuple[int, str]] = {}  # key id -> (line, by)
+        self.depth = 0
+        self.cur_fi = fi
+
+    # -- scoping ------------------------------------------------------------
+    def _bind(self, name: str) -> None:
+        self.scopes[-1][name] = _Binding(self.depth)
+
+    def _binding(self, name: str) -> _Binding:
+        for scope in reversed(self.scopes):
+            if name in scope:
+                return scope[name]
+        b = _Binding(0)  # captured from beyond the tree (module global)
+        self.scopes[0][name] = b
+        return b
+
+    def _key_of(self, e: ast.expr) -> Optional[_Key]:
+        if isinstance(e, ast.Name):
+            b = self._binding(e.id)
+            if b.key is None:
+                b.key = _Key(b.depth)
+            return b.key
+        if isinstance(e, ast.Attribute):
+            path = dotted(e)
+            if path is None:
+                return None
+            k = self.attr_keys.get(path)
+            if k is None:
+                k = self.attr_keys[path] = _Key(0)
+            return k
+        return None  # subscripts/calls: a fresh value each evaluation
+
+    def _bind_target(self, t: ast.expr) -> None:
+        if isinstance(t, ast.Name):
+            self._bind(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._bind_target(e)
+        elif isinstance(t, ast.Starred):
+            self._bind_target(t.value)
+        elif isinstance(t, ast.Attribute):
+            path = dotted(t)
+            if path:
+                self.attr_keys.pop(path, None)
+
+    # -- entry --------------------------------------------------------------
+    def run(self) -> List[Finding]:
+        self._enter_function(self.root)
+        return self.findings
+
+    def _enter_function(self, fi: FuncInfo) -> None:
+        prev = self.cur_fi
+        self.cur_fi = fi
+        self.scopes.append({})
+        a = fi.node.args
+        for p in (a.posonlyargs + a.args + a.kwonlyargs):
+            self._bind(p.arg)
+        if a.vararg:
+            self._bind(a.vararg.arg)
+        if a.kwarg:
+            self._bind(a.kwarg.arg)
+        if isinstance(fi.node, ast.Lambda):
+            self._visit_expr(fi.node.body)
+        else:
+            self._visit_block(fi.node.body)
+        self.scopes.pop()
+        self.cur_fi = prev
+
+    # -- statements ----------------------------------------------------------
+    def _visit_block(self, stmts: List[ast.stmt]) -> None:
+        for s in stmts:
+            self._visit_stmt(s)
+
+    def _visit_stmt(self, s: ast.stmt) -> None:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._bind(s.name)
+            fi = self.mod.funcs_by_node.get(id(s))
+            if fi is not None:
+                # a nested def is a latent loop body: bump depth so a
+                # captured key consumed inside it reads as repeated use
+                self.depth += 1
+                self._enter_function(fi)
+                self.depth -= 1
+            return
+        if isinstance(s, ast.ClassDef):
+            self._bind(s.name)
+            return
+        if isinstance(s, ast.Assign):
+            self._visit_expr(s.value)
+            for t in s.targets:
+                self._bind_target(t)
+            return
+        if isinstance(s, ast.AnnAssign):
+            if s.value is not None:
+                self._visit_expr(s.value)
+                self._bind_target(s.target)
+            return
+        if isinstance(s, ast.AugAssign):
+            self._visit_expr(s.value)
+            if isinstance(s.target, ast.Name):
+                self._bind(s.target.id)
+            return
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            self._visit_expr(s.iter)
+            self.depth += 1
+            self._bind_target(s.target)
+            self._visit_block(s.body)
+            self.depth -= 1
+            self._visit_block(s.orelse)
+            return
+        if isinstance(s, ast.While):
+            self._visit_expr(s.test)
+            self.depth += 1
+            self._visit_block(s.body)
+            self.depth -= 1
+            self._visit_block(s.orelse)
+            return
+        if isinstance(s, ast.If):
+            from ..graftlint.rules import _terminates
+
+            self._visit_expr(s.test)
+            before = dict(self.consumed)
+            self._visit_block(s.body)
+            # a branch that terminates (return/raise/...) contributes
+            # nothing to the join — code after the If never follows it
+            after_body = ({} if _terminates(s.body) else self.consumed)
+            self.consumed = dict(before)
+            self._visit_block(s.orelse)
+            if s.orelse and _terminates(s.orelse):
+                self.consumed = dict(before)
+            merged = dict(self.consumed)  # may-consumed union of branches
+            merged.update(after_body)
+            self.consumed = merged
+            return
+        if isinstance(s, ast.Try):
+            self._visit_block(s.body)
+            for h in s.handlers:
+                if h.name:
+                    self._bind(h.name)
+                self._visit_block(h.body)
+            self._visit_block(s.orelse)
+            self._visit_block(s.finalbody)
+            return
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                self._visit_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._bind_target(item.optional_vars)
+            self._visit_block(s.body)
+            return
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, ast.expr):
+                self._visit_expr(child)
+            elif isinstance(child, ast.stmt):
+                self._visit_stmt(child)
+
+    # -- expressions ---------------------------------------------------------
+    def _visit_expr(self, e: Optional[ast.expr]) -> None:
+        if e is None:
+            return
+        if isinstance(e, ast.Call):
+            self._visit_call(e)
+            return
+        if isinstance(e, ast.Lambda):
+            fi = self.mod.funcs_by_node.get(id(e))
+            if fi is not None:
+                self.depth += 1
+                self._enter_function(fi)
+                self.depth -= 1
+            return
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.DictComp,
+                          ast.GeneratorExp)):
+            for gen in e.generators:
+                self._visit_expr(gen.iter)
+            self.depth += 1
+            self.scopes.append({})
+            for gen in e.generators:
+                self._bind_target(gen.target)
+                for cond in gen.ifs:
+                    self._visit_expr(cond)
+            if isinstance(e, ast.DictComp):
+                self._visit_expr(e.key)
+                self._visit_expr(e.value)
+            else:
+                self._visit_expr(e.elt)
+            self.scopes.pop()
+            self.depth -= 1
+            return
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.expr):
+                self._visit_expr(child)
+
+    def _visit_call(self, call: ast.Call) -> None:
+        for a in call.args:
+            self._visit_expr(a)
+        for kw in call.keywords:
+            self._visit_expr(kw.value)
+        if not isinstance(call.func, (ast.Name, ast.Attribute)):
+            self._visit_expr(call.func)
+
+        fname = _jax_random_fn(self.mod, call)
+        if fname is not None:
+            karg = _key_arg(call, fname)
+            if karg is None:
+                return
+            key = self._key_of(karg)
+            if key is None:
+                return
+            label = dotted(karg) or "<key>"
+            if fname in DERIVERS:
+                self._check_dead(key, call, label,
+                                 f"jax.random.{fname}", consuming=False)
+            else:
+                self._consume(key, call, label, f"jax.random.{fname}")
+            return
+
+        # interprocedural: helper(key) where the helper's summary says the
+        # param position reaches a sampler
+        for t in self.lint.resolve_call_targets(self.mod, self.cur_fi, call):
+            for p in self.summaries.get(t, ()):
+                if p < len(call.args):
+                    key = self._key_of(call.args[p])
+                    if key is not None:
+                        label = dotted(call.args[p]) or "<key>"
+                        self._consume(key, call, label,
+                                      f"{dotted(call.func) or t.name}()")
+
+    def _check_dead(self, key: _Key, call: ast.Call, label: str,
+                    by: str, consuming: bool) -> None:
+        prior = self.consumed.get(key.id)
+        if prior is not None:
+            line, consumer = prior
+            verb = "consumed again by" if consuming else "fed to"
+            self.findings.append(_mk(
+                self.mod, "D001", call,
+                f"key `{label}` was consumed by {consumer} (line {line}) "
+                f"and is {verb} {by} — a consumed key is dead; derive "
+                "subkeys BEFORE sampling",
+            ))
+
+    def _consume(self, key: _Key, call: ast.Call, label: str,
+                 by: str) -> None:
+        prior = self.consumed.get(key.id)
+        if prior is not None:
+            self._check_dead(key, call, label, by, consuming=True)
+            return
+        if key.depth < self.depth:
+            self.findings.append(_mk(
+                self.mod, "D001", call,
+                f"key `{label}` defined outside this loop/closure is "
+                f"consumed by {by} inside it — every iteration draws the "
+                "same stream; fold the loop index in first",
+            ))
+        self.consumed[key.id] = (call.lineno, by)
+
+
+# ---------------------------------------------------------------------------
+# D002: nondeterministic seed provenance
+# ---------------------------------------------------------------------------
+
+_D002_SOURCES = tuple(WALLCLOCK_FNS) + tuple(ENTROPY_FNS) + (
+    "uuid.uuid4", "uuid.uuid1")
+
+
+class _D002Checker:
+    def __init__(self, lint: Analyzer, mod: ModuleInfo, fi: FuncInfo):
+        self.lint = lint
+        self.mod = mod
+        self.fi = fi
+        self.findings: List[Finding] = []
+        self.tainted: Dict[str, str] = {}  # name -> source description
+
+    def _source_of(self, e: ast.expr) -> Optional[str]:
+        """A nondeterministic expression (source call, id(), tainted name)
+        anywhere inside ``e``."""
+        for node in ast.walk(e):
+            if not isinstance(node, ast.expr):
+                continue
+            src = _source_call(self.mod, node, _D002_SOURCES)
+            if src is not None:
+                return src
+            if (isinstance(node, ast.Call) and dotted(node.func) == "id"
+                    and node.args):
+                return "id()"
+            if isinstance(node, ast.Name) and node.id in self.tainted:
+                return self.tainted[node.id]
+        return None
+
+    def run(self) -> List[Finding]:
+        body = ([ast.Expr(self.fi.node.body)]
+                if isinstance(self.fi.node, ast.Lambda)
+                else self.fi.node.body)
+        self._record = False
+        self._visit(body)  # pass 1: taint fixpoint across loops
+        self._record = True
+        self._visit(body)
+        return self.findings
+
+    def _visit(self, stmts: List[ast.stmt]) -> None:
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            if isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = getattr(s, "value", None)
+                if value is not None:
+                    self._check_exprs(value)
+                    src = self._source_of(value) if value is not None else None
+                    targets = (s.targets if isinstance(s, ast.Assign)
+                               else [s.target])
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            if src is not None:
+                                self.tainted[t.id] = src
+                            elif not isinstance(s, ast.AugAssign):
+                                self.tainted.pop(t.id, None)
+                continue
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, ast.expr):
+                    self._check_exprs(child)
+                elif isinstance(child, ast.stmt):
+                    self._visit([child])
+
+    def _check_exprs(self, e: ast.expr) -> None:
+        if not self._record:
+            return
+        for node in ast.walk(e):
+            if not isinstance(node, ast.Call):
+                continue
+            self._check_call(node)
+
+    def _check_call(self, call: ast.Call) -> None:
+        # bare unseeded module samplers: nondeterministic anywhere
+        npfn = _np_random_fn(self.mod, call)
+        if npfn in BARE_SAMPLERS:
+            self.findings.append(_mk(
+                self.mod, "D002", call,
+                f"unseeded module-level `{dotted(call.func)}` draws from "
+                "hidden global state — use a seeded np.random.RandomState/"
+                "default_rng (or jax.random with a config-derived key)",
+            ))
+            return
+        # seed sinks fed from a nondeterministic source
+        ds = dotted(call.func)
+        tail = ds.split(".")[-1] if ds else ""
+        positions = SEED_SINK_TAILS.get(tail)
+        is_seed_sink = positions is not None and (
+            _jax_random_fn(self.mod, call) in ("PRNGKey", "key", "fold_in")
+            or (npfn in ("seed", "RandomState", "default_rng"))
+            or (ds == "random.seed"
+                and not _is_jaxish(self.mod, "random"))
+        )
+        if is_seed_sink:
+            for p in positions:
+                if p < len(call.args):
+                    src = self._source_of(call.args[p])
+                    if src is not None:
+                        self.findings.append(_mk(
+                            self.mod, "D002", call,
+                            f"PRNG seeded from `{src}` — the trajectory "
+                            "can never be replayed; derive seeds from "
+                            "config (random_seed, round index, rank)",
+                        ))
+                        break
+        # inside traced code, a wall-clock/entropy read bakes a
+        # per-process constant into the jaxpr
+        if self.fi.traced:
+            src = _source_call(self.mod, call, _D002_SOURCES)
+            if src is not None:
+                self.findings.append(_mk(
+                    self.mod, "D002", call,
+                    f"`{src}` inside traced `{self.fi.qualname}` bakes a "
+                    "per-process constant into the compiled program — two "
+                    "hosts trace two different programs",
+                ))
+
+
+# ---------------------------------------------------------------------------
+# D003: unordered iteration into accumulation
+# ---------------------------------------------------------------------------
+
+
+def _attr_container_kinds(mod: ModuleInfo) -> Dict[str, str]:
+    """self-attributes assigned ``set()``/``{}``/``dict()`` anywhere in the
+    module's classes → "set" | "dict" (shared, arrival-ordered state)."""
+    kinds: Dict[str, str] = {}
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        t = node.targets[0]
+        if not (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                and t.value.id == "self"):
+            continue
+        v = node.value
+        if isinstance(v, ast.Set) or (
+                isinstance(v, ast.Call) and dotted(v.func) in ("set",
+                                                               "frozenset")):
+            kinds[t.attr] = "set"
+        elif isinstance(v, ast.Dict) and not v.keys or (
+                isinstance(v, ast.Call) and dotted(v.func) == "dict"
+                and not v.args and not v.keywords):
+            kinds.setdefault(t.attr, "dict")
+    return kinds
+
+
+class _D003Checker:
+    def __init__(self, lint: Analyzer, mod: ModuleInfo, fi: FuncInfo,
+                 attr_kinds: Dict[str, str]):
+        self.mod = mod
+        self.fi = fi
+        self.attr_kinds = attr_kinds
+        self.set_locals: Set[str] = set()
+        self.findings: List[Finding] = []
+
+    # -- classification -----------------------------------------------------
+    def _unordered(self, e: ast.expr) -> Optional[str]:
+        """Why ``e`` iterates in unspecified order, or None."""
+        if isinstance(e, (ast.Set, ast.SetComp)):
+            return "a set"
+        if isinstance(e, ast.Call):
+            ds = dotted(e.func)
+            if ds in ("set", "frozenset"):
+                return "a set"
+            if ds in ("list", "tuple", "iter", "reversed") and e.args:
+                return self._unordered(e.args[0])
+            if isinstance(e.func, ast.Attribute):
+                if e.func.attr in ("union", "intersection", "difference",
+                                  "symmetric_difference"):
+                    inner = self._unordered(e.func.value)
+                    if inner:
+                        return "a set"
+                if e.func.attr in ("keys", "values", "items"):
+                    recv = e.func.value
+                    if (isinstance(recv, ast.Attribute)
+                            and isinstance(recv.value, ast.Name)
+                            and recv.value.id == "self"
+                            and self.attr_kinds.get(recv.attr) == "dict"):
+                        return (f"shared dict `self.{recv.attr}` "
+                                "(arrival-ordered)")
+            return None
+        if isinstance(e, ast.Name) and e.id in self.set_locals:
+            return "a set"
+        if isinstance(e, ast.BinOp):
+            left = self._unordered(e.left)
+            right = self._unordered(e.right)
+            if left == "a set" or right == "a set":
+                return "a set"
+            return None
+        if (isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name)
+                and e.value.id == "self"):
+            kind = self.attr_kinds.get(e.attr)
+            if kind == "set":
+                return f"shared set `self.{e.attr}`"
+            if kind == "dict":
+                return f"shared dict `self.{e.attr}` (arrival-ordered)"
+        return None
+
+    # -- entry ---------------------------------------------------------------
+    def run(self) -> List[Finding]:
+        if isinstance(self.fi.node, ast.Lambda):
+            return []
+        self._scan_set_locals(self.fi.node)
+        for node in _walk_shallow(self.fi.node):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                why = self._unordered(node.iter)
+                if why:
+                    self._check_loop_body(node, why)
+            elif isinstance(node, ast.Call):
+                self._check_summish(node)
+        return self.findings
+
+    def _scan_set_locals(self, root: ast.AST) -> None:
+        for _ in range(2):  # one extra pass for chained set locals
+            for node in _walk_shallow(root):
+                if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    name = node.targets[0].id
+                    why = self._unordered(node.value)
+                    if why is not None and "set" in why:
+                        self.set_locals.add(name)
+                    else:
+                        self.set_locals.discard(name)
+
+    # -- sinks ---------------------------------------------------------------
+    def _check_loop_body(self, loop: ast.For, why: str) -> None:
+        for node in ast.walk(loop):
+            if isinstance(node, ast.AugAssign) and isinstance(
+                    node.op, ast.Add):
+                if isinstance(node.value, ast.Constant) and isinstance(
+                        node.value.value, int):
+                    continue  # integer counting commutes
+                self.findings.append(_mk(
+                    self.mod, "D003", node,
+                    f"accumulation inside iteration over {why} — float "
+                    "addition is order-visible and set order is "
+                    "process-dependent; iterate sorted(...)",
+                ))
+                return
+            if isinstance(node, ast.Call):
+                ds = dotted(node.func)
+                tail = ds.split(".")[-1] if ds else ""
+                if tail == "send_message" or ds == "Message" or (
+                        ds or "").endswith(".Message"):
+                    self.findings.append(_mk(
+                        self.mod, "D003", node,
+                        f"message fan-out inside iteration over {why} — "
+                        "send order is wire-visible (retry/dedup windows, "
+                        "payload digests); iterate sorted(...)",
+                    ))
+                    return
+
+    def _check_summish(self, call: ast.Call) -> None:
+        ds = dotted(call.func)
+        if ds is None:
+            return
+        parts = ds.split(".")
+        tail = parts[-1]
+        is_builtin_sum = ds == "sum"
+        is_np_sum = (len(parts) > 1 and tail in SUMMISH_JNP
+                     and (_is_jaxish(self.mod, parts[0])
+                          or _is_numpy(self.mod, parts[0])))
+        is_stack_trees = tail == "stack_trees"
+        if not (is_builtin_sum or is_np_sum or is_stack_trees):
+            return
+        for a in call.args:
+            comp = a if isinstance(a, (ast.GeneratorExp, ast.ListComp)) \
+                else None
+            if comp is None:
+                why = self._unordered(a)
+                if why and not is_builtin_sum:
+                    self.findings.append(_mk(
+                        self.mod, "D003", call,
+                        f"`{ds}` over {why} — element order is "
+                        "process-dependent; sort first",
+                    ))
+                continue
+            if is_builtin_sum and isinstance(comp.elt, ast.Constant):
+                continue  # sum(1 for ...) counts, order-free
+            for gen in comp.generators:
+                why = self._unordered(gen.iter)
+                if why:
+                    self.findings.append(_mk(
+                        self.mod, "D003", call,
+                        f"`{ds}` accumulates over {why} — float addition/"
+                        "stacking is order-visible; iterate sorted(...)",
+                    ))
+                    return
+
+
+# ---------------------------------------------------------------------------
+# D004: dtype-promotion drift
+# ---------------------------------------------------------------------------
+
+
+def _is_float64_expr(mod: ModuleInfo, e: ast.expr) -> bool:
+    if isinstance(e, ast.Name) and e.id == "float":
+        return True
+    if isinstance(e, ast.Constant) and e.value in ("float64", "double"):
+        return True
+    ds = dotted(e)
+    if ds is None:
+        return False
+    parts = ds.split(".")
+    return parts[-1] in ("float64", "double") and (
+        _is_numpy(mod, parts[0]) or _is_jaxish(mod, parts[0]))
+
+
+class _D004Checker:
+    def __init__(self, lint: Analyzer, mod: ModuleInfo, fi: FuncInfo):
+        self.mod = mod
+        self.fi = fi
+        self.findings: List[Finding] = []
+
+    def run(self) -> List[Finding]:
+        where = (f"traced `{self.fi.qualname}`" if self.fi.traced
+                 else f"round/aggregation code `{self.fi.qualname}`")
+        for node in _walk_shallow(self.fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            ds = dotted(node.func)
+            parts = ds.split(".") if ds else []
+            # explicit float64 constructor: np.float64(x) / jnp.float64(x)
+            if parts and parts[-1] in ("float64", "double") and len(parts) > 1 \
+                    and (_is_numpy(self.mod, parts[0])
+                         or _is_jaxish(self.mod, parts[0])):
+                self.findings.append(_mk(
+                    self.mod, "D004", node,
+                    f"`{ds}(...)` in {where} promotes to float64 — "
+                    "cross-platform bitwise parity needs one explicit "
+                    "narrow dtype",
+                ))
+                continue
+            # .astype(float) / .astype("float64") / .astype(np.float64)
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype" and node.args
+                    and _is_float64_expr(self.mod, node.args[0])):
+                self.findings.append(_mk(
+                    self.mod, "D004", node,
+                    f".astype(float64) in {where} — weak Python `float` "
+                    "means float64; name the narrow dtype explicitly",
+                ))
+                continue
+            # dtype=float / dtype="float64" / dtype=np.float64 keywords
+            for kw in node.keywords:
+                if kw.arg == "dtype" and _is_float64_expr(self.mod,
+                                                          kw.value):
+                    self.findings.append(_mk(
+                        self.mod, "D004", kw.value,
+                        f"dtype=float64 in {where} — x64 math diverges "
+                        "bitwise from the f32 path on other platforms",
+                    ))
+            # numpy reductions inside TRACED code run on host at trace time
+            # with float64 accumulators
+            if (self.fi.traced and len(parts) > 1
+                    and parts[-1] in NP_REDUCERS
+                    and _is_numpy(self.mod, parts[0])):
+                self.findings.append(_mk(
+                    self.mod, "D004", node,
+                    f"`{ds}` inside {where} runs on host with a float64 "
+                    "accumulator at trace time — use the jnp twin",
+                ))
+        return self.findings
+
+
+# ---------------------------------------------------------------------------
+# D005: run-identity leaks
+# ---------------------------------------------------------------------------
+
+_D005_SOURCES = tuple(WALLCLOCK_FNS) + tuple(IDENTITY_FNS)
+
+
+class _D005Checker:
+    def __init__(self, lint: Analyzer, mod: ModuleInfo, fi: FuncInfo):
+        self.mod = mod
+        self.fi = fi
+        self.findings: List[Finding] = []
+        self.tainted: Dict[str, str] = {}
+
+    def _source_of(self, e: ast.expr) -> Optional[str]:
+        for node in ast.walk(e):
+            if not isinstance(node, ast.expr):
+                continue
+            src = _source_call(self.mod, node, _D005_SOURCES)
+            if src is not None:
+                return src
+            if isinstance(node, ast.Name) and node.id in self.tainted:
+                return self.tainted[node.id]
+        return None
+
+    def run(self) -> List[Finding]:
+        if isinstance(self.fi.node, ast.Lambda):
+            return []
+        # taint pass (document order, two rounds for loops)
+        for _ in range(2):
+            for node in _walk_shallow(self.fi.node):
+                if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    src = self._source_of(node.value)
+                    if src is not None:
+                        self.tainted[node.targets[0].id] = src
+        state_fn = any(tok in self.fi.name for tok in ROUND_STATE_FNS)
+        for node in _walk_shallow(self.fi.node):
+            if isinstance(node, ast.Call):
+                self._check_ledger_sink(node)
+            if state_fn and isinstance(node, ast.Return) \
+                    and node.value is not None:
+                src = self._source_of(node.value)
+                if src is not None:
+                    self.findings.append(_mk(
+                        self.mod, "D005", node,
+                        f"`{src}` flows into the state `{self.fi.qualname}` "
+                        "returns — resumed runs replay this dict and can "
+                        "never reproduce it bitwise",
+                    ))
+            if isinstance(node, ast.If):
+                self._check_control(node)
+        return self.findings
+
+    def _check_ledger_sink(self, call: ast.Call) -> None:
+        ds = dotted(call.func)
+        tail = ds.split(".")[-1] if ds else ""
+        if tail not in LEDGER_SINKS:
+            return
+        for e in list(call.args) + [kw.value for kw in call.keywords]:
+            src = self._source_of(e)
+            if src is not None:
+                self.findings.append(_mk(
+                    self.mod, "D005", call,
+                    f"`{src}` flows into ledger commit `{tail}` — "
+                    "committed round state must be a pure function of "
+                    "(seed, config, round)",
+                ))
+                return
+
+    def _check_control(self, node: ast.If) -> None:
+        src = self._source_of(node.test)
+        if src is None:
+            return
+        for inner in ast.walk(node):
+            if isinstance(inner, ast.Call):
+                ds = dotted(inner.func) or ""
+                tail = ds.split(".")[-1]
+                if tail in ("send_message", "commit_round") or \
+                        "aggregate" in tail or "dispatch" in tail:
+                    self.findings.append(_mk(
+                        self.mod, "D005", node,
+                        f"`{src}` gates `{tail}` — wall-clock/host "
+                        "identity steering the round path makes runs "
+                        "unreplayable (telemetry it instead)",
+                    ))
+                    return
+
+
+# ---------------------------------------------------------------------------
+# Entry
+# ---------------------------------------------------------------------------
+
+
+def check_determinism(modules: Dict[str, ModuleInfo],
+                      lint: Analyzer) -> List[Finding]:
+    summaries = build_key_summaries(modules, lint)
+    findings: List[Finding] = []
+    for mod in modules.values():
+        attr_kinds = _attr_container_kinds(mod)
+        for fi in mod.funcs_by_node.values():
+            if fi.parent is None:
+                findings += _D001Checker(lint, mod, fi, summaries).run()
+            findings += _D002Checker(lint, mod, fi).run()
+            findings += _D003Checker(lint, mod, fi, attr_kinds).run()
+            if fi.traced or any(tok in fi.qualname for tok in _ROUNDISH):
+                findings += _D004Checker(lint, mod, fi).run()
+            findings += _D005Checker(lint, mod, fi).run()
+    return findings

@@ -1,13 +1,15 @@
 #!/usr/bin/env bash
-# CI smoke for ALL THREE static-analysis gates:
+# CI smoke for ALL FOUR static-analysis gates:
 #  - graftlint  (G001–G005, JAX trace/donation/recompile/thread safety)
 #  - graftproto (P001–P009, comm-plane protocol + lock-order verification)
 #  - graftshard (S001–S005, sharding/HBM verification of the TPU
 #                execution plane)
+#  - graftrep   (D001–D006, determinism discipline + fused/unfused round
+#                equivalence of the trust pipeline)
 # The shipped tree must have ZERO non-baselined findings in each suite
 # (tools/<suite>/baseline.json holds the suppressed-but-visible debt —
-# graftshard's ships EMPTY), the JSON reports must parse, and each gate
-# must bite on a known-bad fixture.
+# graftshard's and graftrep's ship EMPTY), the JSON reports must parse,
+# and each gate must bite on a known-bad fixture.
 #
 # Exit-code contract (all suites): 0 clean, 1 findings, 2 analyzer crash —
 # a CI failure here is diagnosable at a glance.
@@ -123,6 +125,40 @@ fi
 if python -m tools.graftshard tests/fixtures/graftshard/s002_bad.py \
         --no-baseline >/dev/null 2>&1; then
     echo "lint_smoke: FAIL — graftshard passed a known-bad fixture" >&2
+    exit 1
+fi
+
+# ---- graftrep: the determinism pass, machine-readable ----------------------
+rep_out=$(timeout -k 10 120 python -m tools.graftrep fedml_tpu/ --json)
+rc=$?
+
+if [ "$rc" -ne 0 ]; then
+    echo "lint_smoke: FAIL — graftrep exited rc=$rc" >&2
+    printf '%s\n' "$rep_out" >&2
+    exit 1
+fi
+
+python - "$rep_out" <<'EOF'
+import json
+import sys
+
+payload = json.loads(sys.argv[1])
+assert payload["exit_code"] == 0, payload
+assert payload["findings"] == [], payload["findings"]
+# graftrep's baseline must stay EMPTY: the determinism discipline holds
+# everywhere the bitwise guarantees reach, debt is fixed not suppressed
+assert payload["baselined"] == 0, payload
+print("lint_smoke: graftrep OK — 0 findings (baseline empty)")
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "lint_smoke: FAIL — graftrep JSON output did not validate" >&2
+    exit 1
+fi
+
+if python -m tools.graftrep tests/fixtures/graftrep/d001_bad.py \
+        --no-baseline >/dev/null 2>&1; then
+    echo "lint_smoke: FAIL — graftrep passed a known-bad fixture" >&2
     exit 1
 fi
 

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # CI smoke for the async traffic plane (fedml_tpu/traffic/, docs/traffic.md):
-# two short client-swarm soaks against the FedBuff-style async server.
+# three short client-swarm soaks against the FedBuff-style async server.
 #
 #  leg 1 (light load):  admission wide open — the soak must complete every
 #     server step with ZERO shed updates and report a p99 dispatch→ready
@@ -9,6 +9,11 @@
 #     (nonzero traffic.shed_updates), still complete every step through
 #     the clients' NACK-retry-after re-offers, and hold peak RSS bounded
 #     (overload degrades to load-shedding, not memory growth).
+#  leg 3 (grpc+delta):  a small-N soak over REAL multiprocess gRPC with
+#     rank→port multiplexing (--ranks_per_port) and the S2C delta plane on
+#     (s2c_delta=auto): every device-host process must exit 0, delta
+#     frames must actually flow (comm.delta.s2c_delta_frames > 0), and the
+#     verdict reports p99 dispatch→ready next to the loopback leg's.
 #
 # This is the executable form of the traffic-plane contract;
 # tests/test_traffic.py is the fine-grained half.
@@ -76,5 +81,40 @@ print("swarm_smoke: overload OK —",
       f"{r['steps_completed']} steps, rss {r['rss_peak_mb']:.0f} MB")
 EOF
 [ $? -ne 0 ] && { echo "swarm_smoke: FAIL — overload verdict" >&2; exit 1; }
+
+grpc=$(run_leg --clients 12 --steps 4 --buffer 6 --think_s 0.02 \
+    --backend grpc --procs 2 --ranks_per_port 6 --port 18972 \
+    --s2c_delta auto --seed 7 --timeout 200 --run_id swarm-smoke-grpc)
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "swarm_smoke: FAIL — grpc+delta leg exited rc=$rc" >&2
+    printf '%s\n' "$grpc" >&2
+    exit 1
+fi
+
+python - "$grpc" "$light" <<'EOF'
+import json
+import sys
+
+r = json.loads(sys.argv[1])
+light = json.loads(sys.argv[2])
+assert r["ok"], r
+assert r["backend"] == "GRPC", r
+assert r["steps_completed"] == r["steps_requested"], r
+# every device-host process finished all its devices (FINISH reached)
+assert all(rc == 0 for rc in r["worker_exit_codes"]), r["worker_exit_codes"]
+# the delta plane actually engaged over the wire: the server shipped
+# delta frames against device-ACKed bases, not just full models
+assert r["s2c_delta_frames"] > 0, r
+p99_g = r["dispatch_ready_s"]["p99"]
+p99_l = light["dispatch_ready_s"]["p99"]
+assert p99_g is not None, r
+print("swarm_smoke: grpc+delta OK —",
+      f"{r['clients']} devices / {len(r['worker_exit_codes'])} procs,",
+      f"{r['s2c_delta_frames']:.0f} delta frames,",
+      f"p99 dispatch→ready {1e3 * p99_g:.1f}ms",
+      f"(loopback leg: {1e3 * p99_l:.1f}ms)")
+EOF
+[ $? -ne 0 ] && { echo "swarm_smoke: FAIL — grpc+delta verdict" >&2; exit 1; }
 
 echo "swarm_smoke: PASS"
