@@ -12,6 +12,7 @@ and cross-silo FL is an async message plane over gRPC/TCP.
 from __future__ import annotations
 
 import logging
+import threading as _threading
 from typing import Optional
 
 from . import constants  # noqa: F401
@@ -21,6 +22,9 @@ from .utils.seed import seed_everything
 __version__ = "0.1.0"
 
 _global_args: Optional[Arguments] = None
+# guards the ambient-args latch (graftiso I001): concurrent inits (the
+# multi-tenant shape) must not interleave the publish
+_global_args_lock = _threading.Lock()
 
 
 def init(args: Optional[Arguments] = None, should_init_logs: bool = True) -> Arguments:
@@ -44,7 +48,8 @@ def init(args: Optional[Arguments] = None, should_init_logs: bool = True) -> Arg
     from .core import mlops
 
     mlops.init(args)
-    _global_args = args
+    with _global_args_lock:
+        _global_args = args
     logging.getLogger(__name__).info(
         "init: platform=%s backend=%s optimizer=%s",
         args.training_type,

@@ -175,7 +175,9 @@ def run_world(a, run_id: str, checkpoint_dir: str, faulty: bool,
                     return
                 time.sleep(0.02)
 
-        threading.Thread(target=watch, daemon=True).start()
+        watcher = threading.Thread(target=watch, daemon=True,
+                                   name="chaos-kill-watcher")
+        watcher.start()
 
     threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
     for t in threads:
@@ -193,8 +195,16 @@ def run_world(a, run_id: str, checkpoint_dir: str, faulty: bool,
             if not server.manager.preempted:
                 spawner.wait_all(timeout_s=30.0)
             spawner.kill_all()
+        # reap the in-process client threads: on a clean FINISH they exit
+        # promptly; a preempted leg leaves them parked on a dead endpoint,
+        # so the join is deadline-bounded (they are daemons — the process
+        # exit that follows a preemption reclaims them)
+        deadline = time.monotonic() + 5.0
+        for t in threads:
+            t.join(timeout=max(deadline - time.monotonic(), 0.05))
     if kill_round >= 0:
         stop_watch.set()
+        watcher.join(timeout=5.0)
     import jax
 
     leaves = [np.asarray(l)
@@ -283,9 +293,20 @@ def orchestrate(a) -> int:
     chaos_out = os.path.join(workdir, "chaos_out")
 
     logger.info("chaos: reference (fault-free) leg …")
+    from fedml_tpu.core import world as world_mod
+
+    threads_before = world_mod.thread_snapshot()
     ref = run_world(a, run_id=f"chaos-ref-{os.getpid()}-{time.time_ns()}",
                     checkpoint_dir=ref_ckpt, faulty=False)
     ref_params = ref["params"]
+    # thread-leak witness (graftiso I005's runtime half): the in-process
+    # world must not leak a non-daemon thread past its shutdown
+    leaked = world_mod.leaked_threads(threads_before)
+    if leaked:
+        print(json.dumps({"ok": False,
+                          "error": f"reference leg leaked threads: "
+                                   f"{leaked}"}))
+        return 1
 
     kill_round = int(a.kill_round)
     logger.info("chaos: faulty leg (loss=%.2f dup=%.2f corrupt=%.2f, "

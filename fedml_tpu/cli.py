@@ -405,7 +405,11 @@ def cmd_lint(args) -> int:
     ``--model``/``--mesh``). ``--rep``: graftrep (tools/graftrep) —
     determinism discipline (D001 key reuse, D002 seed provenance, D003
     unordered accumulation, D004 dtype drift, D005 run-identity leaks) and
-    fused/unfused round structural equivalence (``--equiv``). Shells into
+    fused/unfused round structural equivalence (``--equiv``). ``--iso``:
+    graftiso (tools/graftiso) — serving-plane state ownership (I001
+    module-global state in handlers, I002 unscoped singleton access, I003
+    class-level defaults & cross-instance aliasing, I004 ambient config,
+    I005 untethered thread lifecycle). Shells into
     the same entry points CI uses, anchored at the repo root so results
     are identical from any cwd.
 
@@ -413,16 +417,17 @@ def cmd_lint(args) -> int:
     crashed (or usage error) — CI failures are diagnosable at a glance."""
     import subprocess
 
-    picked = [flag for flag in ("proto", "shard", "rep")
+    picked = [flag for flag in ("proto", "shard", "rep", "iso")
               if getattr(args, flag, False)]
     if len(picked) > 1:
         print(f"fedml_tpu lint: --{picked[0]} and --{picked[1]} are "
-              "different suites — pick one (or run all four like "
+              "different suites — pick one (or run all five like "
               "tools/lint_smoke.sh does)")
         return 2
     suite = ("graftproto" if getattr(args, "proto", False)
              else "graftshard" if getattr(args, "shard", False)
              else "graftrep" if getattr(args, "rep", False)
+             else "graftiso" if getattr(args, "iso", False)
              else "graftlint")
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if not os.path.isdir(os.path.join(repo_root, "tools", suite)):
@@ -443,6 +448,11 @@ def cmd_lint(args) -> int:
         if suite == "graftrep":
             print("fedml_tpu lint: --runtime is a graftlint/graftshard "
                   "pass; graftrep's jax-backed pass is --equiv")
+            return 2
+        if suite == "graftiso":
+            print("fedml_tpu lint: --runtime is a graftlint/graftshard "
+                  "pass; graftiso's runtime witness is the swarm/chaos "
+                  "thread-leak assertion (fedml_tpu swarm / chaos)")
             return 2
         cmd.append("--runtime")
     if getattr(args, "equiv", False):
@@ -617,6 +627,11 @@ def main(argv=None) -> int:
                         help="run graftshard (partition-rule coverage, "
                         "spec validity, implicit-reshard/host-transfer "
                         "detection, static HBM budgets) instead of "
+                        "graftlint")
+    p_lint.add_argument("--iso", action="store_true",
+                        help="run graftiso (tools/graftiso: state-"
+                        "ownership, tenant-isolation & thread-lifecycle "
+                        "verification of the serving plane) instead of "
                         "graftlint")
     p_lint.add_argument("--rep", action="store_true",
                         help="run graftrep (PRNG-key discipline, seed "

@@ -28,8 +28,8 @@ MessageHandler = Callable[[Message], None]
 class FedMLCommManager(Observer):
     def __init__(self, args, comm=None, rank: int = 0, size: int = 0,
                  backend: str = constants.COMM_BACKEND_LOOPBACK):
+        from ..world import WorldScope
         from .delivery import DedupWindow, RetryPolicy, SenderStamp
-        from .payload_store import store_from_args
 
         self.args = args
         self.size = int(size)
@@ -38,9 +38,15 @@ class FedMLCommManager(Observer):
         self.com_manager: Optional[BaseCommunicationManager] = comm
         self.message_handler_dict: Dict[str, MessageHandler] = {}
         self._thread: Optional[threading.Thread] = None
+        # the explicit owner of this participant's run state (graftiso,
+        # docs/graftiso.md): telemetry scope, payload store, and the
+        # thread/timer registry the shutdown path drains — keyed by
+        # (run_id, rank) so tenant A's teardown can never touch tenant B
+        self.world = WorldScope.for_args(args, rank=self.rank)
         # payload-by-reference mode (reference MQTT+S3 split): arrays above
-        # the inline limit ride the shared store, not the control channel
-        self.payload_store = store_from_args(args)
+        # the inline limit ride the world-keyed store, not the control
+        # channel
+        self.payload_store = self.world.payload_store
         self.payload_inline_limit = int(
             getattr(args, "payload_inline_limit_bytes", 1 * 1024 * 1024)
         )
@@ -84,11 +90,12 @@ class FedMLCommManager(Observer):
         self._thread = threading.Thread(
             target=self.com_manager.handle_receive_message, daemon=True
         )
+        # tethered to the world: finish() → world.shutdown() joins it
+        self.world.register_thread(self._thread)
         self._thread.start()
         return self._thread
 
     def send_message(self, message: Message) -> None:
-        from ..mlops import telemetry
         from .delivery import TransientSendError, arrays_digest
         from .payload_store import PAYLOAD_REF_KEY
 
@@ -104,8 +111,8 @@ class FedMLCommManager(Observer):
         ):
             # content-addressed: an N-client broadcast of the same model
             # writes one blob; stale blobs age out via TTL sweep
-            telemetry.counter_inc("comm.payload_offloads")
-            telemetry.counter_inc(
+            self.world.telemetry.counter_inc("comm.payload_offloads")
+            self.world.telemetry.counter_inc(
                 "comm.payload_offload_bytes",
                 sum(a.nbytes for a in message.arrays),
             )
@@ -125,7 +132,7 @@ class FedMLCommManager(Observer):
                 lambda: self.com_manager.send_message(message),
                 is_transient=lambda e: isinstance(e, TransientSendError),
                 on_retry=lambda attempt, e: (
-                    telemetry.counter_inc("comm.send_retries"),
+                    self.world.telemetry.counter_inc("comm.send_retries"),
                     logger.info(
                         "rank %d: transient send failure for %r (%s) — "
                         "retry %d", self.rank, message.get_type(), e, attempt,
@@ -133,17 +140,16 @@ class FedMLCommManager(Observer):
                 ),
             )
         except Exception:
-            telemetry.counter_inc("comm.send_failures")
+            self.world.telemetry.counter_inc("comm.send_failures")
             raise
 
     def receive_message(self, msg_type: str, msg: Message) -> None:
-        from ..mlops import telemetry
         from .delivery import PayloadCorruptError
         from .payload_store import PAYLOAD_REF_KEY
 
         ref = msg.get(PAYLOAD_REF_KEY)
         if ref:
-            telemetry.counter_inc("comm.payload_fetches")
+            self.world.telemetry.counter_inc("comm.payload_fetches")
             if self.payload_store is None:
                 # fail HERE, loudly — otherwise the handler sees an empty
                 # array list and dies far away in tree_unflatten
@@ -167,7 +173,7 @@ class FedMLCommManager(Observer):
                 )
                 return
             except PayloadCorruptError as e:
-                telemetry.counter_inc("comm.corrupt_payloads")
+                self.world.telemetry.counter_inc("comm.corrupt_payloads")
                 logger.error(
                     "rank %d: payload blob %r for %r failed its checksum "
                     "after re-fetch (%s) — dropping message",
@@ -187,14 +193,14 @@ class FedMLCommManager(Observer):
                     Message.MSG_ARG_KEY_EPOCH, 0)), int(seq),
             )
             if verdict == "duplicate":
-                telemetry.counter_inc("comm.dedup_drops")
+                self.world.telemetry.counter_inc("comm.dedup_drops")
                 logger.info(
                     "rank %d: duplicate %r from %d (seq %s) dropped",
                     self.rank, msg_type, msg.get_sender_id(), seq,
                 )
                 return
             if verdict == "stale_epoch":
-                telemetry.counter_inc("comm.stale_epoch_drops")
+                self.world.telemetry.counter_inc("comm.stale_epoch_drops")
                 logger.info(
                     "rank %d: stale-epoch %r from %d dropped (sender "
                     "restarted)", self.rank, msg_type, msg.get_sender_id(),
@@ -208,7 +214,6 @@ class FedMLCommManager(Observer):
 
     def _fetch_verified(self, ref: str, msg: Message):
         """Payload-store fetch with integrity verification + one re-fetch."""
-        from ..mlops import telemetry
         from .delivery import PayloadCorruptError, arrays_digest
 
         want = msg.get(Message.MSG_ARG_KEY_PAYLOAD_SHA256)
@@ -217,7 +222,7 @@ class FedMLCommManager(Observer):
             if want is None or arrays_digest(arrays) == want:
                 return arrays
             if attempt == 0:
-                telemetry.counter_inc("comm.payload_refetches")
+                self.world.telemetry.counter_inc("comm.payload_refetches")
                 logger.warning(
                     "rank %d: payload blob %r failed checksum — "
                     "re-fetching once", self.rank, ref,
@@ -228,8 +233,13 @@ class FedMLCommManager(Observer):
         )
 
     def finish(self) -> None:
-        """Stop the loop (reference :57-60 calls MPI Abort; we just stop)."""
+        """Stop the loop (reference :57-60 calls MPI Abort; we just stop),
+        then drain the world scope: cancel registered timers and join
+        registered worker threads — rank-scoped, so one participant's
+        teardown never touches another's (idempotent; a worker driving its
+        own shutdown is skipped, not self-joined)."""
         self.com_manager.stop_receive_message()
+        self.world.shutdown()
 
     def join(self, timeout: Optional[float] = None) -> None:
         if self._thread is not None:

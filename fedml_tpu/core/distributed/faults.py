@@ -145,6 +145,9 @@ class FaultyComm(BaseCommunicationManager):
         self._cor_rngs = [np.random.RandomState(r["seed"])
                           for r in plan.corrupts]
         self._lock = threading.Lock()
+        # pending delay timers (graftiso I005): cancelled on stop so an
+        # injected link delay can never deliver into a torn-down node
+        self._timers: List[threading.Timer] = []
 
     # -- fault logic --------------------------------------------------------
 
@@ -220,6 +223,9 @@ class FaultyComm(BaseCommunicationManager):
             return
         t = threading.Timer(delay_s, self._transmit, args=(msg, corrupt))
         t.daemon = True
+        with self._lock:
+            self._timers = [x for x in self._timers if x.is_alive()]
+            self._timers.append(t)
         t.start()
 
     def _transmit(self, msg: Message, corrupt: bool) -> None:
@@ -249,4 +255,8 @@ class FaultyComm(BaseCommunicationManager):
         self.inner.handle_receive_message()
 
     def stop_receive_message(self) -> None:
+        with self._lock:
+            timers, self._timers = self._timers, []
+        for t in timers:
+            t.cancel()
         self.inner.stop_receive_message()

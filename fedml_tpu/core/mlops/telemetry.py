@@ -209,6 +209,90 @@ def observe(name: str, value: float) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Run-scoped telemetry (the serving plane's world-keyed metrics facade)
+# ---------------------------------------------------------------------------
+
+
+class TelemetryScope:
+    """A run identity's view of a metrics registry.
+
+    Serving-plane handler/worker code bumps counters through the scope
+    carried on its :class:`~fedml_tpu.core.world.WorldScope`
+    (``self.world.telemetry.counter_inc(...)``) instead of the module
+    helpers — the process-wide registry is then reachable from a handler
+    only through an explicit run discriminator (graftiso I002,
+    docs/graftiso.md). In a single-tenant process the default scope wraps
+    the process-global registry, so every existing counter name, the
+    Prometheus exposition, and ``fedml_tpu top`` are unchanged; the
+    multi-tenant serving plane installs dedicated per-run registries via
+    :func:`install_scope` without touching a single call site.
+    """
+
+    __slots__ = ("run_id", "registry")
+
+    def __init__(self, run_id: Optional[str] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        self.run_id = run_id
+        self.registry = registry if registry is not None else MetricsRegistry()
+
+    def counter_inc(self, name: str, value: float = 1.0) -> None:
+        self.registry.inc(name, value)
+
+    def gauge_set(self, name: str, value: float) -> None:
+        self.registry.gauge_set(name, value)
+
+    def observe(self, name: str, value: float,
+                buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> None:
+        self.registry.observe(name, value, buckets)
+
+    def counter(self, name: str) -> float:
+        return self.registry.counter(name)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return self.registry.snapshot()
+
+
+_DEFAULT_SCOPE = TelemetryScope(run_id=None, registry=_REG)
+
+# dedicated per-run scopes (multi-tenant serving): run_id -> scope.
+# Accessed only through scope_for/install_scope with the run discriminator.
+_SCOPES: Dict[str, TelemetryScope] = {}
+_SCOPES_LOCK = threading.Lock()
+
+
+def default_scope() -> TelemetryScope:
+    """The process-global scope (wraps the module registry)."""
+    return _DEFAULT_SCOPE
+
+
+def scope_for(run_id: Optional[str] = None) -> TelemetryScope:
+    """The telemetry scope for a run identity.
+
+    Returns the process-global default unless a dedicated scope was
+    installed for ``run_id`` (:func:`install_scope` — the multi-tenant
+    hook), so single-tenant behavior is bitwise what it always was."""
+    if run_id is None:
+        return _DEFAULT_SCOPE
+    with _SCOPES_LOCK:
+        return _SCOPES.get(str(run_id), _DEFAULT_SCOPE)
+
+
+def install_scope(run_id: str) -> TelemetryScope:
+    """Create (or return) a dedicated registry-backed scope for a run —
+    the multi-tenant serving plane's per-tenant metrics namespace."""
+    with _SCOPES_LOCK:
+        scope = _SCOPES.get(str(run_id))
+        if scope is None:
+            scope = _SCOPES[str(run_id)] = TelemetryScope(run_id=str(run_id))
+        return scope
+
+
+def uninstall_scope(run_id: str) -> None:
+    with _SCOPES_LOCK:
+        _SCOPES.pop(str(run_id), None)
+
+
+# ---------------------------------------------------------------------------
 # Process state + init
 # ---------------------------------------------------------------------------
 
@@ -315,10 +399,12 @@ def install_jax_listeners() -> bool:
     """Count XLA compiles and persistent-cache hits/misses into the registry.
 
     ``jax.monitoring`` has no unregister API, so this installs once per
-    process; the listeners only touch the registry (no jax state)."""
+    process; the listeners only touch the registry (no jax state). The
+    install-once latch is checked AND flipped under ``_STATE_LOCK``
+    (graftiso I001): two runs initialising on different threads — the
+    multi-tenant shape — must not both register and double-count every
+    compile."""
     global _LISTENERS_INSTALLED
-    if _LISTENERS_INSTALLED:
-        return True
     try:
         from jax import monitoring
     except ImportError:  # pragma: no cover - jax is a hard dep in practice
@@ -336,9 +422,12 @@ def install_jax_listeners() -> bool:
         elif event == "/jax/compilation_cache/compile_time_saved_sec":
             _REG.inc("jax.compilation_cache.time_saved_s", duration_secs)
 
-    monitoring.register_event_listener(on_event)
-    monitoring.register_event_duration_secs_listener(on_duration)
-    _LISTENERS_INSTALLED = True
+    with _STATE_LOCK:
+        if _LISTENERS_INSTALLED:
+            return True
+        monitoring.register_event_listener(on_event)
+        monitoring.register_event_duration_secs_listener(on_duration)
+        _LISTENERS_INSTALLED = True
     return True
 
 

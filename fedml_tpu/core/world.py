@@ -1,0 +1,218 @@
+"""World scope: the explicit owner of a federation's per-run state.
+
+reference: none — the reference binds one federation to one ``runner.py``
+process and keeps its MLOps state in module globals (PAPER.md), so
+"which federation owns this counter/thread/blob" never has to be asked.
+This repo is heading for M concurrent federations in one process (ROADMAP
+"many worlds, one process, one mesh"), where that question is THE
+correctness question: any mutable run state reachable from a message
+handler outside an explicitly-scoped world object is a cross-tenant leak.
+
+:class:`WorldScope` is that object. One scope per federation participant
+— keyed by ``(run_id, rank)``, the same identity the loopback broker and
+the run ledger already use — owning:
+
+- the **telemetry scope** (:class:`~fedml_tpu.core.mlops.telemetry.
+  TelemetryScope`): handler/worker code bumps counters through
+  ``world.telemetry``, never through the process-global registry
+  directly. Single-tenant processes get the process-global default, so
+  every existing counter and ``fedml_tpu top`` keep working unchanged.
+- the **payload store** (the bulk channel's world-keyed end): built once
+  per world from the run's args instead of ambiently inside each comm
+  manager.
+- the **thread/timer registry + shutdown hooks**: every worker thread or
+  timer a federation starts registers here, and :meth:`shutdown` cancels
+  timers, runs hooks, and joins threads — so killing world A can never
+  orphan (or, worse, share) world B's workers. This is the runtime
+  contract behind graftiso I005; the swarm/chaos harnesses additionally
+  assert no non-daemon thread leaks a soak (``thread_snapshot`` /
+  ``leaked_threads``).
+
+``tools/graftiso`` statically enforces the discipline this module exists
+for (docs/graftiso.md): I001 no module-global mutable state written from
+handler code, I002 no unscoped process-wide registry access, I005 every
+federation thread tethered to its scope's shutdown path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from .mlops import telemetry
+
+
+class WorldScope:
+    """Per-(run, rank) ownership root for a federation participant's
+    mutable serving-plane state."""
+
+    # process index of live scopes — advisory (introspection + the
+    # multi-tenant serving plane's lookup), always accessed through the
+    # (run_id, rank) discriminator; entries are replaced, never implicitly
+    # shut down (the owning manager drives its own lifecycle)
+    _scopes: Dict[Tuple[str, int], "WorldScope"] = {}
+    _scopes_lock = threading.Lock()
+
+    def __init__(self, run_id: str, rank: int, args=None):
+        self.run_id = str(run_id)
+        self.rank = int(rank)
+        # single-tenant default: the process-global registry — every
+        # existing counter name and `fedml_tpu top` keep working. The
+        # multi-tenant PR installs per-run scopes via
+        # telemetry.install_scope(run_id) without touching call sites.
+        self.telemetry = telemetry.scope_for(self.run_id)
+        # world-keyed bulk channel (reference MQTT+S3 split): one store
+        # per world, built from the run's args at construction — handlers
+        # never read ambient config to find it
+        self.payload_store = None
+        if args is not None:
+            from .distributed.payload_store import store_from_args
+
+            self.payload_store = store_from_args(args)
+        self._lock = threading.Lock()
+        self._threads: List[threading.Thread] = []
+        self._timers: List[threading.Timer] = []
+        self._hooks: List[Callable[[], None]] = []
+        self._closed = False
+
+    # -- registry ------------------------------------------------------------
+
+    @classmethod
+    def for_args(cls, args, rank: Optional[int] = None) -> "WorldScope":
+        """Build (and index) the scope for a run's args. A re-construction
+        under the same (run_id, rank) replaces the index entry — the
+        previous owner keeps its reference and its own shutdown."""
+        run_id = str(getattr(args, "run_id", "0") or "0")
+        r = int(rank if rank is not None else getattr(args, "rank", 0))
+        scope = cls(run_id, r, args=args)
+        with cls._scopes_lock:
+            cls._scopes[(run_id, r)] = scope
+        return scope
+
+    @classmethod
+    def get(cls, run_id: str, rank: int) -> Optional["WorldScope"]:
+        """The live scope for (run_id, rank), if one is indexed."""
+        with cls._scopes_lock:
+            return cls._scopes.get((str(run_id), int(rank)))
+
+    @classmethod
+    def release(cls, run_id: str, rank: int) -> None:
+        """Drop (and shut down) the indexed scope for (run_id, rank)."""
+        with cls._scopes_lock:
+            scope = cls._scopes.pop((str(run_id), int(rank)), None)
+        if scope is not None:
+            scope.shutdown()
+
+    # -- thread / lifecycle registry -----------------------------------------
+
+    def register_thread(self, thread: threading.Thread) -> threading.Thread:
+        """Tether a worker thread to this world: :meth:`shutdown` joins it.
+        Returns the thread for chaining. Registering on an already-closed
+        scope cannot be honored (nothing will drain the list again) — it
+        is logged loudly instead of silently losing the tether."""
+        with self._lock:
+            if self._closed:
+                closed = True
+            else:
+                closed = False
+                self._threads = [t for t in self._threads if t.is_alive()]
+                self._threads.append(thread)
+        if closed:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "world (%s, %d): thread %r registered after shutdown — "
+                "the scope cannot join it", self.run_id, self.rank,
+                thread.name,
+            )
+        return thread
+
+    def register_timer(self, timer: threading.Timer) -> threading.Timer:
+        """Tether a one-shot timer: :meth:`shutdown` cancels anything
+        still pending. Fired timers are pruned on each registration. A
+        timer registered after shutdown (a callback racing the teardown
+        and re-arming) is cancelled immediately — the scope's contract is
+        that nothing it owns fires past :meth:`shutdown`."""
+        with self._lock:
+            if self._closed:
+                closed = True
+            else:
+                closed = False
+                self._timers = [t for t in self._timers if t.is_alive()]
+                self._timers.append(timer)
+        if closed:
+            timer.cancel()
+        return timer
+
+    def add_shutdown(self, hook: Callable[[], None]) -> None:
+        """Run ``hook`` during :meth:`shutdown` (before joining threads) —
+        the place for Event.set / queue-poison steps that unblock workers."""
+        with self._lock:
+            self._hooks.append(hook)
+
+    def shutdown(self, timeout_s: float = 5.0) -> None:
+        """Cancel registered timers, run shutdown hooks, join registered
+        threads (skipping the calling thread — a worker may drive its own
+        world's shutdown), and drop this scope from the process index so
+        a long-lived multi-run process never accumulates closed scopes.
+        Idempotent; never raises."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            timers, self._timers = self._timers, []
+            hooks, self._hooks = self._hooks, []
+            threads, self._threads = self._threads, []
+        for t in timers:
+            t.cancel()
+        for hook in hooks:
+            try:
+                hook()
+            except Exception:  # pragma: no cover - shutdown must not raise
+                pass
+        me = threading.current_thread()
+        for t in threads:
+            if t is me:
+                continue
+            t.join(timeout_s)
+        with type(self)._scopes_lock:
+            if type(self)._scopes.get((self.run_id, self.rank)) is self:
+                type(self)._scopes.pop((self.run_id, self.rank))
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+
+# ---------------------------------------------------------------------------
+# Thread-leak witnesses (the runtime half of graftiso I005): the swarm and
+# chaos soaks snapshot the process's threads at start and fail if a
+# non-daemon thread outlives world shutdown.
+# ---------------------------------------------------------------------------
+
+
+def thread_snapshot() -> Set[threading.Thread]:
+    """The Thread objects alive right now (object identity, NOT idents —
+    CPython recycles thread idents, which would let a leaked thread
+    silently reuse a snapshot-era id and evade the gate)."""
+    return set(threading.enumerate())
+
+
+def leaked_threads(snapshot: Set[threading.Thread],
+                   join_grace_s: float = 2.0) -> List[str]:
+    """Names of NON-DAEMON threads alive now that were not in ``snapshot``.
+
+    Daemon threads die with the process and are the world registry's
+    business (joined by :meth:`WorldScope.shutdown`); a leaked non-daemon
+    thread wedges interpreter exit — the soak harnesses fail on it. A
+    short SHARED grace deadline absorbs workers that are mid-exit."""
+    import time
+
+    leaked = [t for t in threading.enumerate()
+              if t not in snapshot and not t.daemon and t.is_alive()
+              and t is not threading.current_thread()]
+    deadline = time.monotonic() + join_grace_s
+    for t in leaked:
+        t.join(max(deadline - time.monotonic(), 0.0))
+    return [t.name for t in leaked if t.is_alive()]

@@ -22,7 +22,6 @@ import numpy as np
 from .. import constants
 from ..core.distributed import FedMLCommManager, Message
 from ..core.dp import FedPrivacyMechanism
-from ..core.mlops import telemetry
 from ..delivery import VersionedModelStore, flatten_leaves
 from ..delivery.delta_codec import DELTA_KEY, DeltaCodec, payload_nbytes
 from ..delivery.payload_filter import filter_from_args
@@ -150,7 +149,8 @@ class ClientMasterManager(FedMLCommManager):
             base = (self._base_store.get(int(dmeta["base_version"]))
                     if self._base_store is not None else None)
             if base is None:
-                telemetry.counter_inc("comm.delta.client_base_missing")
+                self.world.telemetry.counter_inc(
+                    "comm.delta.client_base_missing")
                 logger.error(
                     "client %d: S2C delta references version %s which this "
                     "client no longer holds — dropping the frame and "
@@ -233,15 +233,13 @@ class ClientMasterManager(FedMLCommManager):
         AFTER the server's dedup window recorded the original seq, so a
         verbatim re-send of the cached message would be dropped as a wire
         duplicate and the contribution lost for good."""
-        from ..core.mlops import telemetry
-
         shed_round = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, -1))
         if shed_round != self._last_trained_round \
                 or self._last_model_msg is None:
             return  # a newer round superseded the shed update
         delay = max(
             float(msg.get(MyMessage.MSG_ARG_KEY_RETRY_AFTER_S, 0.1)), 0.01)
-        telemetry.counter_inc("traffic.client_retries")
+        self.world.telemetry.counter_inc("traffic.client_retries")
         logger.info(
             "client %d: round %d update shed (%s) — re-offering in %.3fs",
             self.rank, shed_round,
@@ -249,6 +247,9 @@ class ClientMasterManager(FedMLCommManager):
         )
         t = threading.Timer(delay, self._reoffer_model, args=(shed_round,))
         t.daemon = True
+        # tethered (graftiso I005): finish() -> world.shutdown() cancels a
+        # backoff still pending when the federation ends
+        self.world.register_timer(t)
         t.start()
 
     def _reoffer_model(self, shed_round: int) -> None:
@@ -321,8 +322,8 @@ class ClientMasterManager(FedMLCommManager):
             msg.set_arrays([np.asarray(l) for l in leaves])
         if self.codec.enabled() or self._filter is not None:
             sent = payload_nbytes(msg.get_arrays())
-            telemetry.counter_inc("comm.delta.c2s_bytes_saved",
-                                  max(raw_nbytes - sent, 0))
+            self.world.telemetry.counter_inc(
+                "comm.delta.c2s_bytes_saved", max(raw_nbytes - sent, 0))
         self._last_model_msg = msg
         self.send_message(msg)
         if self._client_pull:

@@ -51,7 +51,6 @@ from .. import constants
 from ..core.aggregate import stack_trees, weighted_average
 from ..core.distributed import FedMLCommManager, Message
 from ..core.dp import FedPrivacyMechanism
-from ..core.mlops import telemetry
 from ..core.security.defender import FedMLDefender
 from ..delivery import VersionedModelStore, delivery_identity, flatten_leaves
 from ..delivery.delta_codec import DELTA_KEY, DeltaCodec, payload_nbytes
@@ -160,6 +159,9 @@ class FedMLServerManager(FedMLCommManager):
                 target=self._async_worker_loop, daemon=True,
                 name="async-aggregator",
             )
+            # tethered (graftiso I005): _close_and_finish -> finish() ->
+            # world.shutdown() joins it after done.set() stops the loop
+            self.world.register_thread(self._async_worker)
         # per-round contribution counters: how many times each client's
         # model was ACCEPTED into a round's aggregation. The delivery-layer
         # dedup keeps every count at 1 even under retries/duplication —
@@ -201,9 +203,7 @@ class FedMLServerManager(FedMLCommManager):
                 self.global_params = restored["global_params"]
                 self.aggregator.set_model_params(self.global_params)
                 self.round_idx = step + 1
-                from ..core.mlops import telemetry
-
-                telemetry.counter_inc("run.resumes")
+                self.world.telemetry.counter_inc("run.resumes")
                 logger.info(
                     "server: resumed federation at round %d from %s",
                     self.round_idx, ckpt_dir,
@@ -355,12 +355,18 @@ class FedMLServerManager(FedMLCommManager):
             self.round_timeout, self._on_round_timeout, args=(self.round_idx,)
         )
         self._round_timer.daemon = True
+        self.world.register_timer(self._round_timer)
         self._round_timer.start()
 
     def _on_round_timeout(self, round_idx: int) -> None:
         """Cohort deadline: aggregate the subset that answered; clients that
         missed the deadline are marked dead (they rejoin by re-sending
         ONLINE status)."""
+        if self.done.is_set():
+            # a callback that already started when _close_and_finish
+            # cancelled the timer: it must not re-arm into (or aggregate
+            # for) a finished federation
+            return
         with self._lock:
             if round_idx != self.round_idx:
                 return
@@ -495,7 +501,8 @@ class FedMLServerManager(FedMLCommManager):
         try:
             filt = self._filter_for(filter_meta)
         except ValueError as e:
-            telemetry.counter_inc("comm.delta.filter_mismatch_drops")
+            self.world.telemetry.counter_inc(
+                "comm.delta.filter_mismatch_drops")
             logger.error("server: dropping update from client %d: %s",
                          sender, e)
             return None
@@ -505,7 +512,8 @@ class FedMLServerManager(FedMLCommManager):
         if codec_meta:
             base_vec = self.store.get(client_version)
             if base_vec is None:
-                telemetry.counter_inc("comm.delta.c2s_base_missing")
+                self.world.telemetry.counter_inc(
+                    "comm.delta.c2s_base_missing")
                 logger.warning(
                     "server: client %d's compressed delta references "
                     "version %d, which the store evicted (capacity %d) — "
@@ -513,7 +521,8 @@ class FedMLServerManager(FedMLCommManager):
                     sender, client_version, self.store.capacity,
                 )
                 return None
-            telemetry.counter_inc("comm.delta.c2s_delta_decodes")
+            self.world.telemetry.counter_inc(
+                "comm.delta.c2s_delta_decodes")
             if filt is not None:
                 # the filtered base is a fixed set of slices of the stored
                 # flat vector — never materialize (or device-place) the
@@ -697,9 +706,7 @@ class FedMLServerManager(FedMLCommManager):
         """Preemption drain: round_r is aggregated + committed; stop HERE
         instead of dispatching round_r+1 — the restarted server resumes at
         exactly round_r+1 with the committed global."""
-        from ..core.mlops import telemetry
-
-        telemetry.counter_inc("run.preemptions")
+        self.world.telemetry.counter_inc("run.preemptions")
         logger.warning(
             "server: preempted after committing round %d — resumable "
             "with --resume auto", round_r,
@@ -720,6 +727,12 @@ class FedMLServerManager(FedMLCommManager):
     def _close_and_finish(self) -> None:
         if self._ckpt is not None:
             self._ckpt.close()
+        with self._lock:
+            # a round deadline armed for a round that will never close
+            # must not fire into a finished federation
+            if self._round_timer is not None:
+                self._round_timer.cancel()
+                self._round_timer = None
         self.done.set()
         self.finish()
 
@@ -801,9 +814,7 @@ class FedMLServerManager(FedMLCommManager):
                     # client, version skew) must cost ITSELF, not the
                     # aggregator thread — a dead worker would livelock the
                     # federation behind queue_full sheds with no error
-                    from ..core.mlops import telemetry
-
-                    telemetry.counter_inc("traffic.fold_errors")
+                    self.world.telemetry.counter_inc("traffic.fold_errors")
                     logger.exception(
                         "server: dropping malformed update from client %s",
                         item[1],
@@ -830,9 +841,7 @@ class FedMLServerManager(FedMLCommManager):
                 # a failed step already drained its buffer; surface the
                 # error loudly but keep serving — the next K updates get
                 # their step
-                from ..core.mlops import telemetry
-
-                telemetry.counter_inc("traffic.step_errors")
+                self.world.telemetry.counter_inc("traffic.step_errors")
                 logger.exception("server: async step failed")
                 stepped = True
             if stepped:
@@ -869,15 +878,13 @@ class FedMLServerManager(FedMLCommManager):
             self._send_model_to(
                 sender, MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
             return
-        telemetry.observe(
+        self.world.telemetry.observe(
             "traffic.dispatch_ready_s", time.monotonic() - t_enq)
 
     def _async_step(self) -> bool:
         """One FedBuff server step: drain the buffer, aggregate through the
         shared hook chain, bump the model version, commit/eval on cadence,
         and dispatch the new version to this step's contributors."""
-        from ..core.mlops import telemetry
-
         t0 = time.monotonic()
         entries = self.buffer.drain()
         if not entries:
@@ -893,12 +900,13 @@ class FedMLServerManager(FedMLCommManager):
             for e in entries:
                 per_round[e.sender] = per_round.get(e.sender, 0) + 1
         agg = self._aggregate_models(raw, senders, round_r)
-        telemetry.counter_inc("traffic.server_steps")
+        self.world.telemetry.counter_inc("traffic.server_steps")
         preempt = self._commit_and_eval(
             round_r, agg, senders, log_label="server step",
             mode="async", staleness=[e.staleness for e in entries],
         )
-        telemetry.observe("traffic.step_s", time.monotonic() - t0)
+        self.world.telemetry.observe("traffic.step_s",
+                                     time.monotonic() - t0)
         if preempt and self.round_idx < self.round_num:
             self._preempt_exit(round_r)
             return True
@@ -929,7 +937,8 @@ class FedMLServerManager(FedMLCommManager):
             targets = sorted(pulls - skip)
             # one answer fan-out per version bump: how many parked pulls
             # each bump batched (docs/telemetry.md traffic.* family)
-            telemetry.observe("traffic.pull_batch_size", float(len(targets)))
+            self.world.telemetry.observe("traffic.pull_batch_size",
+                                         float(len(targets)))
         else:
             targets = [r for r in sorted(set(senders)) if r not in skip]
         cache: Dict[int, tuple] = {}
@@ -947,7 +956,7 @@ class FedMLServerManager(FedMLCommManager):
         sender = msg.get_sender_id()
         client_version = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, -1))
         self._record_ack(msg)
-        telemetry.counter_inc("traffic.pull_requests")
+        self.world.telemetry.counter_inc("traffic.pull_requests")
         with self._lock:
             if client_version < self.round_idx:
                 defer = False
@@ -955,7 +964,7 @@ class FedMLServerManager(FedMLCommManager):
                 defer = True
                 self._pending_pulls.add(sender)
         if defer:
-            telemetry.counter_inc("traffic.pulls_deferred")
+            self.world.telemetry.counter_inc("traffic.pulls_deferred")
         elif not self.done.is_set():
             self._send_model_to(
                 sender, MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
@@ -1007,7 +1016,7 @@ class FedMLServerManager(FedMLCommManager):
             # nothing ACKed yet (fresh/restarted client, or a peer that
             # never advertised delta capability — swarm devices, pre-delta
             # clients): full frame, quietly
-            telemetry.counter_inc("comm.delta.s2c_full_frames")
+            self.world.telemetry.counter_inc("comm.delta.s2c_full_frames")
             return leaves, None
         entry = cache.get(acked) if cache is not None else None
         if entry is None:
@@ -1034,11 +1043,11 @@ class FedMLServerManager(FedMLCommManager):
                 cache[acked] = entry
         arrays, meta = entry
         if meta is None:
-            telemetry.counter_inc("comm.delta.s2c_full_frames")
+            self.world.telemetry.counter_inc("comm.delta.s2c_full_frames")
             return leaves, None
         raw = payload_nbytes(leaves)
-        telemetry.counter_inc("comm.delta.s2c_delta_frames")
-        telemetry.counter_inc(
+        self.world.telemetry.counter_inc("comm.delta.s2c_delta_frames")
+        self.world.telemetry.counter_inc(
             "comm.delta.s2c_bytes_saved",
             max(raw - payload_nbytes(arrays), 0),
         )

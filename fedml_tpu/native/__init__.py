@@ -15,6 +15,7 @@ import logging
 import os
 import subprocess
 import tempfile
+import threading
 from typing import Optional, Tuple
 
 import numpy as np
@@ -24,6 +25,9 @@ logger = logging.getLogger(__name__)
 _SRC = os.path.join(os.path.dirname(__file__), "host_pipeline.cpp")
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
+# guards the build-once latch (graftiso I001): two threads racing get_lib()
+# would otherwise both shell out to g++ against the same cache path
+_LIB_LOCK = threading.Lock()
 
 
 def _build_lib() -> Optional[str]:
@@ -52,42 +56,43 @@ def _build_lib() -> Optional[str]:
 
 def get_lib() -> Optional[ctypes.CDLL]:
     global _LIB, _TRIED
-    if _LIB is not None or _TRIED:
+    with _LIB_LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        so = _build_lib()
+        if so is None:
+            return None
+        lib = ctypes.CDLL(so)
+        lib.gather_rows_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int,
+        ]
+        lib.gather_rows_i32.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int,
+        ]
+        lib.gather_windows_i32.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int,
+        ]
+        lib.prefetcher_create.restype = ctypes.c_void_p
+        lib.prefetcher_create.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.prefetcher_next.restype = ctypes.c_int64
+        lib.prefetcher_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.prefetcher_destroy.argtypes = [ctypes.c_void_p]
+        _LIB = lib
         return _LIB
-    _TRIED = True
-    so = _build_lib()
-    if so is None:
-        return None
-    lib = ctypes.CDLL(so)
-    lib.gather_rows_f32.argtypes = [
-        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
-        ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
-        ctypes.c_int,
-    ]
-    lib.gather_rows_i32.argtypes = [
-        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
-        ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
-        ctypes.c_int,
-    ]
-    lib.gather_windows_i32.argtypes = [
-        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
-        ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
-        ctypes.c_int,
-    ]
-    lib.prefetcher_create.restype = ctypes.c_void_p
-    lib.prefetcher_create.argtypes = [
-        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
-        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-        ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
-    ]
-    lib.prefetcher_next.restype = ctypes.c_int64
-    lib.prefetcher_next.argtypes = [
-        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
-        ctypes.POINTER(ctypes.c_int32),
-    ]
-    lib.prefetcher_destroy.argtypes = [ctypes.c_void_p]
-    _LIB = lib
-    return _LIB
 
 
 def have_native() -> bool:

@@ -39,6 +39,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from .. import constants
+from ..core import world as world_mod
 from ..core.distributed import FedMLCommManager, Message
 from ..core.mlops import telemetry
 from ..cross_silo.message_define import MyMessage
@@ -226,12 +227,12 @@ class SwarmClientManager(FedMLCommManager):
             return list(arrays)
         base = self._store.get(int(dmeta["base_version"]))
         if base is None or self._leaf_meta is None:
-            telemetry.counter_inc("swarm.delta_base_missing")
+            self.world.telemetry.counter_inc("swarm.delta_base_missing")
             self._announce_online()
             return None
         vec = DeltaCodec.decode(base, arrays, dmeta)
         self._store.put(version, vec)
-        telemetry.counter_inc("swarm.delta_decodes")
+        self.world.telemetry.counter_inc("swarm.delta_decodes")
         out, off = [], 0
         for shape, dtype in self._leaf_meta:
             n = int(np.prod(shape, dtype=np.int64))
@@ -266,7 +267,7 @@ class SwarmClientManager(FedMLCommManager):
             return  # silent device: receives, never answers
         if self.schedule.drops_out():
             self._dropped = True
-            telemetry.counter_inc("swarm.dropouts")
+            self.world.telemetry.counter_inc("swarm.dropouts")
             return
         self.timers.call_later(
             self.schedule.next_think_s(),
@@ -288,7 +289,7 @@ class SwarmClientManager(FedMLCommManager):
             # ACK: this version becomes the server's S2C delta base for us
             out.add(MyMessage.MSG_ARG_KEY_DELTA_CAPABLE, 1)
         out.set_arrays(arrays)
-        telemetry.counter_inc("swarm.updates_sent")
+        self.world.telemetry.counter_inc("swarm.updates_sent")
         self._send_quiet(out)
 
     def _on_shed(self, msg: Message) -> None:
@@ -299,7 +300,7 @@ class SwarmClientManager(FedMLCommManager):
             return
         retry_s = max(
             float(msg.get(MyMessage.MSG_ARG_KEY_RETRY_AFTER_S, 0.1)), 0.01)
-        telemetry.counter_inc("swarm.retries")
+        self.world.telemetry.counter_inc("swarm.retries")
         self.timers.call_later(
             retry_s, lambda v=shed_version: self._send_update(v))
 
@@ -313,7 +314,7 @@ class SwarmClientManager(FedMLCommManager):
         except Exception:
             # the server is gone (soak teardown, chaos kill): a traffic
             # generator must absorb that, not crash the swarm
-            telemetry.counter_inc("swarm.send_failures")
+            self.world.telemetry.counter_inc("swarm.send_failures")
 
 
 # ---------------------------------------------------------------------------
@@ -522,6 +523,10 @@ def swarm_soak(a) -> Dict:
 
     backend = str(a.backend).upper()
     telemetry.registry().reset()
+    # thread-leak witness (graftiso I005's runtime half): every thread the
+    # soak starts must be gone — or at least daemonic and world-joined —
+    # after world shutdown; a leaked non-daemon thread fails the soak
+    threads_before = world_mod.thread_snapshot()
     t0 = time.monotonic()
 
     server_over = dict(_server_overrides(a), backend=backend)
@@ -538,6 +543,7 @@ def swarm_soak(a) -> Dict:
     pump: Optional[LoopbackPump] = None
     spawner: Optional[ProcSpawner] = None
     devices: List[SwarmClientManager] = []
+    server_thread: Optional[threading.Thread] = None
     try:
         if backend == constants.COMM_BACKEND_LOOPBACK:
             from ..core.distributed.loopback import LoopbackCommManager
@@ -601,6 +607,10 @@ def swarm_soak(a) -> Dict:
             spawner.kill_all()
         server.manager.done.set()  # unblock the worker on a timed-out soak
         server.manager.finish()
+        if server_thread is not None:
+            server_thread.join(timeout=10.0)
+
+    leaked = world_mod.leaked_threads(threads_before)
 
     wall = time.monotonic() - t0
     snap = telemetry.registry().snapshot()
@@ -609,8 +619,11 @@ def swarm_soak(a) -> Dict:
     grpc_mode = backend == constants.COMM_BACKEND_GRPC
     report = {
         # grpc mode: every device-host process must ALSO have exited 0
-        # (all its devices reached FINISH)
-        "ok": bool(completed) and all(rc == 0 for rc in worker_rcs),
+        # (all its devices reached FINISH); a leaked non-daemon thread
+        # after world shutdown fails the soak outright
+        "ok": (bool(completed) and all(rc == 0 for rc in worker_rcs)
+               and not leaked),
+        "leaked_threads": leaked,
         "backend": backend,
         "clients": int(a.clients),
         "steps_requested": int(a.steps),
@@ -661,6 +674,7 @@ def run_device_worker(a) -> int:
     n = int(a.clients)
     world_size = n + 1
     devices = []
+    threads_before = world_mod.thread_snapshot()
     timers = TimerWheel()
     try:
         for rank in range(int(a.rank_base),
@@ -683,4 +697,6 @@ def run_device_worker(a) -> int:
         timers.stop()
         for dev in devices:
             dev.finish()
+    if world_mod.leaked_threads(threads_before):
+        return 1
     return 0 if all(d.done.is_set() for d in devices) else 1

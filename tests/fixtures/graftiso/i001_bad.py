@@ -1,0 +1,24 @@
+"""I001 bad: module-global mutable state written from handler code, and
+an unlocked install-once latch."""
+
+_ROUND_CACHE = {}
+_INSTALLED = False
+
+
+class BadServerManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler("sync", self._on_sync)
+
+    def register_message_receive_handler(self, msg_type, handler):
+        pass
+
+    def _on_sync(self, msg):
+        _ROUND_CACHE[msg.round] = msg.params
+        _ROUND_CACHE.update(msg.extras)
+
+
+def install_listeners():
+    global _INSTALLED
+    if _INSTALLED:
+        return
+    _INSTALLED = True

@@ -1,0 +1,29 @@
+"""I001 good: state owned by the instance; the process-wide latch is
+checked-and-set under a module-level lock."""
+
+import threading
+
+_INSTALLED = False
+_INSTALL_LOCK = threading.Lock()
+
+
+class GoodServerManager:
+    def __init__(self):
+        self._round_cache = {}
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler("sync", self._on_sync)
+
+    def register_message_receive_handler(self, msg_type, handler):
+        pass
+
+    def _on_sync(self, msg):
+        self._round_cache[msg.round] = msg.params
+
+
+def install_listeners():
+    global _INSTALLED
+    with _INSTALL_LOCK:
+        if _INSTALLED:
+            return
+        _INSTALLED = True

@@ -1,0 +1,14 @@
+"""I001 pragma: the handler write is suppressed on its own line."""
+
+_ROUND_CACHE = {}
+
+
+class PragmaServerManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler("sync", self._on_sync)
+
+    def register_message_receive_handler(self, msg_type, handler):
+        pass
+
+    def _on_sync(self, msg):
+        _ROUND_CACHE[msg.round] = msg.params  # graftiso: disable=I001

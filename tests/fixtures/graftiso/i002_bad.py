@@ -1,0 +1,40 @@
+"""I002 bad: handler code reaches process-wide singletons with no
+run/world discriminator — one resolved hop through a module helper, and a
+foreign class registry touched directly."""
+
+import threading
+
+
+class MetricsRegistry:
+    def inc(self, name):
+        pass
+
+
+_REG = MetricsRegistry()
+
+
+def counter_inc(name):
+    _REG.inc(name)
+
+
+class ServerRegistry:
+    _servers = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def acquire(cls, run_id):
+        with cls._lock:
+            return cls._servers.get(run_id)
+
+
+class BadManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler("sync", self._on_sync)
+
+    def register_message_receive_handler(self, msg_type, handler):
+        pass
+
+    def _on_sync(self, msg):
+        counter_inc("rounds")
+        srv = ServerRegistry._servers.get("main")
+        srv.route(msg)

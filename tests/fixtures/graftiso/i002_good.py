@@ -1,0 +1,36 @@
+"""I002 good: the same reaches, but every access path carries a
+run/world discriminator — the world scope on the receiver chain, or the
+scoping key in the call itself."""
+
+import threading
+
+
+class MetricsRegistry:
+    def inc(self, name):
+        pass
+
+
+_REG = MetricsRegistry()
+
+
+class ServerRegistry:
+    _servers = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def acquire(cls, run_id):
+        with cls._lock:
+            return cls._servers.get(run_id)
+
+
+class GoodManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler("sync", self._on_sync)
+
+    def register_message_receive_handler(self, msg_type, handler):
+        pass
+
+    def _on_sync(self, msg):
+        self.world.telemetry.counter_inc("rounds")
+        srv = ServerRegistry.acquire(self.world.run_id)
+        srv.route(msg)

@@ -1,0 +1,24 @@
+"""I003 bad: a class-level mutable default (one object shared by every
+instance), and a mutable attr escaping its owner — into another class's
+constructor and onto a foreign object."""
+
+
+class BadCache:
+    shared = {}
+
+    def put(self, key, value):
+        self.shared[key] = value
+
+
+class Holder:
+    def __init__(self, models):
+        self.models = models
+
+
+class BadOwner:
+    def __init__(self, sink):
+        self._models = {}
+        sink.stash = self._models
+
+    def hand_off(self):
+        return Holder(self._models)

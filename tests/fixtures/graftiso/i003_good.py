@@ -1,0 +1,28 @@
+"""I003 good: the intentional registry carries a class-level Lock
+companion (keyed access is I002's business), instance state lives in
+__init__, and the only hand-off target is the world root."""
+
+import threading
+
+
+class GoodRegistry:
+    _instances = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def get(cls, run_id):
+        with cls._lock:
+            return cls._instances.get(run_id)
+
+
+class WorldScope:
+    def __init__(self, store):
+        self.store = store
+
+
+class GoodOwner:
+    def __init__(self):
+        self._models = {}
+
+    def export(self):
+        return WorldScope(self._models)

@@ -1,0 +1,31 @@
+"""I004 bad: ambient configuration — a module global captured from the
+environment at import time, an environment read inside a handler, and the
+ambient process args pulled from inside the serving path."""
+
+import os
+
+DEBUG_MODE = os.environ.get("FEDML_DEBUG", "")
+
+
+def get_args():
+    return None
+
+
+class BadManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler("sync", self._on_sync)
+        self.register_message_receive_handler("pull", self._on_pull)
+
+    def register_message_receive_handler(self, msg_type, handler):
+        pass
+
+    def _on_sync(self, msg):
+        root = os.environ.get("FEDML_STORE", "/tmp")
+        self.save(root, msg)
+
+    def _on_pull(self, msg):
+        args = get_args()
+        self.save(args.store_dir, msg)
+
+    def save(self, root, msg):
+        pass

@@ -1,0 +1,26 @@
+"""I004 good: configuration resolved once at construction from the args
+the manager was built with; handlers only read their own state."""
+
+import os
+
+
+def store_root_from_args(args):
+    return getattr(args, "store_dir", "") or os.environ.get(
+        "FEDML_STORE", "/tmp")
+
+
+class GoodManager:
+    def __init__(self, args):
+        self._store_root = store_root_from_args(args)
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler("sync", self._on_sync)
+
+    def register_message_receive_handler(self, msg_type, handler):
+        pass
+
+    def _on_sync(self, msg):
+        self.save(self._store_root, msg)
+
+    def save(self, root, msg):
+        pass

@@ -1,0 +1,21 @@
+"""I005 bad: untethered thread lifecycle — an attr worker no shutdown
+path ever joins, a chained-start thread nothing can ever join, and a
+local timer that is never cancelled or registered."""
+
+import threading
+
+
+class BadWorkerHost:
+    def __init__(self):
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        pass
+
+    def kick(self):
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def delay(self, fn):
+        t = threading.Timer(0.1, fn)
+        t.start()

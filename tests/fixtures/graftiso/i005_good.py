@@ -1,0 +1,40 @@
+"""I005 good: every thread/timer tethered — world registration, a join
+reachable from the shutdown path, and a joined comprehension batch."""
+
+import threading
+
+
+class GoodWorkerHost:
+    def __init__(self, world):
+        self.world = world
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self.world.register_thread(self._worker)
+        self._worker.start()
+
+    def _run(self):
+        pass
+
+    def delay(self, fn):
+        t = threading.Timer(0.1, fn)
+        self.world.register_timer(t)
+        t.start()
+
+
+class JoinedWorkerHost:
+    def __init__(self):
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        self._worker.join(timeout=5.0)
+
+
+def launch_and_wait(jobs):
+    workers = [threading.Thread(target=job) for job in jobs]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join()

@@ -1,0 +1,18 @@
+"""S004 delivery-plane prong bad: codec inputs materialized on host
+inside a delivery-plane encode/decode path (module name carries
+"delivery", so the prong is in scope)."""
+
+import numpy as np
+
+
+class HostDeltaCodec:
+    @staticmethod
+    def encode(base_vec, new_vec):
+        base = np.asarray(base_vec)
+        new = np.asarray(new_vec)
+        return [new - base], {"dim": int(new.shape[0])}
+
+    @staticmethod
+    def decode(base_vec, arrays, meta):
+        base = np.asarray(base_vec)
+        return base + np.asarray(arrays[0])

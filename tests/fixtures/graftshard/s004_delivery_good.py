@@ -1,0 +1,17 @@
+"""S004 delivery-plane prong good: the pragma'd allowance keeps the host
+codec visible without firing, and non-codec helpers in a delivery module
+stay out of scope."""
+
+import numpy as np
+
+
+class AllowedHostCodec:
+    @staticmethod
+    def encode(base_vec, new_vec):
+        base = np.asarray(base_vec)  # graftshard: disable=S004
+        new = np.asarray(new_vec)  # graftshard: disable=S004
+        return [new - base], {"dim": int(new.shape[0])}
+
+
+def flatten_frames(frames):
+    return np.concatenate([np.asarray(f).ravel() for f in frames])

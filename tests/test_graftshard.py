@@ -97,6 +97,30 @@ class TestRuleFixtures:
     def test_s004_good(self):
         assert _findings("s004_good.py") == []
 
+    def test_s004_delivery_prong_bad(self):
+        """Delivery-plane prong (ISSUE 11 satellite): host materialization
+        of codec inputs inside delivery-module encode/decode paths."""
+        fs = _findings("s004_delivery_bad.py")
+        assert {f.rule for f in fs} == {"S004"}
+        # 11/12: encode inputs; 17/18: decode base + frame
+        assert _rule_lines(fs, "S004") == [11, 12, 17, 18]
+        assert all("delivery-plane" in f.message for f in fs)
+
+    def test_s004_delivery_prong_good(self):
+        """Pragma'd allowance + non-codec helpers stay silent."""
+        assert _findings("s004_delivery_good.py") == []
+
+    def test_delta_codec_allowances_visible(self):
+        """The real host codec ships pragma'd S004 allowances — visible
+        inventory for the device-direct wire path, not silent debt."""
+        src = open(os.path.join(
+            REPO_ROOT, "fedml_tpu", "delivery", "delta_codec.py")).read()
+        assert src.count("graftshard: disable=S004") >= 7
+        fs = analyze_paths([os.path.join(REPO_ROOT, "fedml_tpu",
+                                         "delivery", "delta_codec.py")],
+                           repo_root=REPO_ROOT)
+        assert fs == [], [f.render() for f in fs]
+
 
 class TestSuppression:
     def test_inline_pragma(self):

@@ -364,6 +364,9 @@ class TestSwarmSoak:
     def test_light_load_zero_shed(self):
         report = swarm_soak(swarm_cfg(run_id="light"))
         assert report["ok"], report
+        # thread-leak witness (graftiso I005's runtime half): no non-daemon
+        # thread survives world shutdown
+        assert report["leaked_threads"] == [], report
         assert report["steps_completed"] == 4
         assert report["shed_updates"] == 0
         assert report["accepted_updates"] >= 4 * 4  # steps x buffer

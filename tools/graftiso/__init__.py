@@ -1,0 +1,6 @@
+"""graftiso — static state-ownership, tenant-isolation & thread-lifecycle
+verification of the serving plane (FIFTH suite on the shared
+tools/graftlint/clikit.py driver; docs/graftiso.md)."""
+
+from .analyzer import analyze_paths, analyze_paths_with_model  # noqa: F401
+from .findings import ISO_RULES, Finding  # noqa: F401

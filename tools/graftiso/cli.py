@@ -1,0 +1,57 @@
+"""graftiso CLI: ``python -m tools.graftiso [paths...]``.
+
+Thin suite definition over the shared driver
+(:mod:`tools.graftlint.clikit` — flags, baseline handling, rendering, and
+the exit-code contract live there, shared with the four sibling suites).
+Exit codes: 0 clean (after baseline + pragmas), 1 findings, 2 usage error
+OR analyzer crash.
+
+The default (and only) pass is pure AST — graftiso has no runtime/jax
+mode: the runtime witness for its I005 contract is the swarm/chaos
+thread-leak assertion (docs/graftiso.md), not a trace.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Dict, List, Optional, Tuple
+
+from ..graftlint import clikit
+from ..graftlint.findings import Finding
+from .analyzer import DEFAULT_BASELINE_RELPATH, analyze_paths_with_model
+from .findings import ISO_RULES
+
+
+def _analyze(args: argparse.Namespace,
+             repo_root: str) -> Tuple[List[Finding], Dict]:
+    findings, model = analyze_paths_with_model(args.paths,
+                                               repo_root=repo_root)
+    extra: Dict = {
+        "serving": {
+            "classes": sorted(f"{m}.{c}"
+                              for m, c in model.serving_classes),
+            "closure_size": len(model.closure),
+            "singletons": sorted(f"{m}:{n}" for m, n in model.singletons),
+            "thread_sites": len(model.thread_sites),
+        },
+    }
+    return findings, extra
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    return clikit.run_suite(
+        argv,
+        tool="graftiso",
+        description="static state-ownership, tenant-isolation & "
+                    "thread-lifecycle verification of the serving plane: "
+                    "module-global state in handlers, unscoped singleton "
+                    "access, class-level defaults & cross-instance "
+                    "aliasing, ambient config, untethered threads",
+        rules=ISO_RULES,
+        analyze=_analyze,
+        baseline_relpath=DEFAULT_BASELINE_RELPATH,
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,63 @@
+"""graftiso rule registry (I001–I005), merged into the shared graftlint
+Finding infrastructure so all five suites render/baseline/JSON identically.
+
+The I-rules statically enforce the serving plane's state-ownership
+contract — the precondition for multi-tenant federation serving (ROADMAP
+"many worlds, one process, one mesh"): no mutable run state reachable from
+a message handler except through an explicitly-scoped world object
+(:class:`fedml_tpu.core.world.WorldScope`), and no federation thread whose
+lifecycle its own scope cannot end.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from ..graftlint.findings import Finding, register_rules
+
+# rule id -> (title, autofix hint)
+ISO_RULES: Dict[str, Tuple[str, str]] = {
+    "I001": (
+        "module-global-state-in-handler",
+        "move the state onto the owning object (self.*) or the world scope "
+        "(world.*): module globals are shared by every federation in the "
+        "process — a handler writing one leaks state across tenants; for a "
+        "genuine process-wide latch, guard the write with a module-level "
+        "lock (`with _LOCK:`) so the install-once contract is real",
+    ),
+    "I002": (
+        "unscoped-singleton-access",
+        "reach process-wide registries only through a run/world/tenant "
+        "discriminator: carry the scope on the world object "
+        "(self.world.telemetry.counter_inc(...), WorldScope.get(run_id, "
+        "rank)) or pass the scoping key in the access itself "
+        "(_Broker.get(world), acquire(host, port, rank, q))",
+    ),
+    "I003": (
+        "cross-instance-state-aliasing",
+        "class-level mutable defaults are one object shared by every "
+        "instance — move them into __init__ (or pair an intentional "
+        "registry with a class-level Lock and key all access); never hand "
+        "a mutable attr to another object directly — route shared state "
+        "through the world scope that owns it",
+    ),
+    "I004": (
+        "ambient-config-read",
+        "thread configuration through args at construction time: a module "
+        "global captured from the environment at import, or an os.environ/"
+        "get_args() read inside a handler, binds every tenant in the "
+        "process to one ambient value nobody can scope or replay",
+    ),
+    "I005": (
+        "untethered-thread-lifecycle",
+        "tether every thread/timer/executor to its scope's shutdown path: "
+        "world.register_thread(t) / world.register_timer(t), or join/"
+        "cancel/shutdown it from a stop/close/finish method — an "
+        "untethered worker outlives its federation and keeps touching "
+        "state the next tenant now owns",
+    ),
+}
+
+register_rules(ISO_RULES)
+
+__all__ = ["Finding", "ISO_RULES"]

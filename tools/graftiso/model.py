@@ -1,0 +1,644 @@
+"""Serving-plane model extraction for the I-rules.
+
+Everything is syntactic (no import of analyzed code), built on graftlint's
+module index. Four facts feed the rules:
+
+1. **Serving classes + the handler/worker closure.** A class is *serving*
+   when any of its methods registers a message handler
+   (``self.register_message_receive_handler(...)``) or a flow callback
+   (``add_flow(...)``); its resolvable base classes join the family (the
+   ``FedMLCommManager`` base's ``send_message``/``receive_message`` run on
+   behalf of every subclass's handlers). The **closure** is the
+   reachable-from-a-handler set: registered callbacks, the dispatch entry
+   (``receive_message``) and send path (``send_message``), thread/timer
+   targets started by serving code, then BFS over ``self.*`` calls
+   (family-resolved), module-local calls, nested defs/lambdas, and bare
+   ``self._x`` method references scheduled as callbacks. Deliberately NO
+   class-hierarchy matching — the closure stays inside the serving family
+   plus module helpers, so findings never sprawl into library code.
+2. **Process-wide singletons.** Module-level instances
+   (``_REG = MetricsRegistry()``; synchronization primitives exempt —
+   locks are the guards, not the state), module-level mutable containers
+   that some function actually writes (a never-written constant map is
+   config, not a registry), and class-level registry containers
+   (``_registry: Dict = {}``).
+3. **Thread sites.** Every ``threading.Thread``/``Timer``/
+   ``ThreadPoolExecutor`` construction with its binding shape (chained
+   ``.start()``, local, ``self.attr``, comprehension, argument-owned).
+4. **Ownership graph.** Per class: mutable container attrs (assigned
+   ``{}``/``[]``/``set()``/… on ``self``) and their *escape edges* — the
+   attr passed into another scanned class's constructor or assigned onto
+   a foreign object. An attr with no escapes is **dominated** by its
+   owner; escaping attrs are I003 findings unless the receiver is a world
+   root (class named ``*World*``/``*Scope``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..graftlint.analyzer import (
+    Analyzer,
+    FuncInfo,
+    ModuleInfo,
+    _walk_shallow,
+    dotted,
+)
+
+REGISTER_CALLS = ("register_message_receive_handler",)
+FLOW_CALLS = ("add_flow",)
+
+# synchronization primitives: module-level instances of these are guards,
+# not shared state
+SYNC_PRIM_CTORS = {
+    "Lock", "RLock", "Condition", "Event", "Semaphore", "BoundedSemaphore",
+    "Barrier", "local",
+}
+
+CONTAINER_CTORS = {"dict", "list", "set", "defaultdict", "OrderedDict",
+                   "Counter", "deque"}
+
+MUTATOR_METHODS = {
+    "append", "extend", "insert", "pop", "popitem", "clear", "update",
+    "setdefault", "remove", "discard", "add",
+}
+
+THREAD_CTORS = {"Thread", "Timer"}
+EXECUTOR_CTORS = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+
+# world-root classes: receivers that legitimately take ownership of state
+WORLD_ROOT_TOKENS = ("World", "Scope")
+
+# method-name tokens marking a scope's shutdown path
+SHUTDOWN_TOKENS = ("stop", "close", "finish", "shutdown", "join", "release",
+                   "cancel", "teardown", "exit", "__del__")
+
+
+def _is_container_value(v: ast.expr) -> bool:
+    if isinstance(v, (ast.Dict, ast.List, ast.Set)):
+        return True
+    if isinstance(v, ast.Call):
+        ds = dotted(v.func)
+        return bool(ds and ds.split(".")[-1] in CONTAINER_CTORS
+                    and not v.args and not v.keywords)
+    return False
+
+
+def _is_sync_prim(v: ast.expr) -> bool:
+    if not isinstance(v, ast.Call):
+        return False
+    ds = dotted(v.func)
+    return bool(ds and ds.split(".")[-1] in SYNC_PRIM_CTORS)
+
+
+def _is_instance_ctor(v: ast.expr) -> bool:
+    """``Ctor(...)`` whose last name segment is class-cased."""
+    if not isinstance(v, ast.Call):
+        return False
+    ds = dotted(v.func)
+    if not ds:
+        return False
+    last = ds.split(".")[-1]
+    return bool(last[:1].isupper())
+
+
+@dataclasses.dataclass
+class Singleton:
+    module: str        # defining module name
+    name: str          # module-level (or Class.attr) name
+    line: int
+    kind: str          # "instance" | "container" | "class-registry"
+    cls: Optional[str] = None  # for class registries
+
+    def label(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+@dataclasses.dataclass
+class ThreadSite:
+    mod: ModuleInfo
+    fi: FuncInfo
+    node: ast.Call
+    kind: str          # "thread" | "timer" | "executor"
+    binding: str       # "chained" | "local" | "attr" | "comp" | "arg" |
+    #                    "returned" | "unbound"
+    name: Optional[str] = None  # local name or attr name
+
+
+@dataclasses.dataclass
+class Escape:
+    cls: str
+    attr: str
+    line: int
+    via: str           # description of the escape edge
+    receiver: str      # receiving class or object expression
+
+
+class OwnershipGraph:
+    """Per-module ownership of mutable attrs: owner class → attrs, plus
+    the escape edges that break domination."""
+
+    def __init__(self):
+        self.mutable_attrs: Dict[str, Dict[str, int]] = {}  # cls -> attr -> line
+        self.escapes: List[Escape] = []
+
+    def dominated(self, cls: str, attr: str) -> bool:
+        """True when ``attr`` is a known mutable attr of ``cls`` with no
+        escape edge — reachable only through its owner (or a world root)."""
+        if attr not in self.mutable_attrs.get(cls, {}):
+            return False
+        return not any(e.cls == cls and e.attr == attr for e in self.escapes)
+
+
+class ServingModel:
+    def __init__(self, modules: Dict[str, ModuleInfo], lint: Analyzer):
+        self.modules = modules
+        self.lint = lint
+        # (module_name, class_name) of serving classes incl. base families
+        self.serving_classes: Set[Tuple[str, str]] = set()
+        # class -> resolved base classes (scan-local)
+        self._bases: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
+        self.closure: Set[FuncInfo] = set()
+        self.singletons: Dict[Tuple[str, str], Singleton] = {}
+        self.module_mutables: Dict[str, Dict[str, int]] = {}
+        self.module_locks: Dict[str, Set[str]] = {}
+        self.thread_sites: List[ThreadSite] = []
+        self.ownership: Dict[str, OwnershipGraph] = {}  # module -> graph
+        self._build()
+
+    # -- class family --------------------------------------------------------
+
+    def _resolve_base(self, mod: ModuleInfo, base: str
+                      ) -> Optional[Tuple[str, str]]:
+        parts = base.split(".")
+        name = parts[-1]
+        if len(parts) == 1:
+            if name in mod.classes:
+                return (mod.name, name)
+            fi = mod.from_imports.get(name)
+            if fi:
+                return self._follow_export(fi[0], fi[1])
+            return None
+        head = parts[0]
+        tgt = mod.imports.get(head)
+        if tgt and tgt in self.modules:
+            return self._follow_export(tgt, name)
+        return None
+
+    def _follow_export(self, mod_name: str, cls: str,
+                       hops: int = 3) -> Optional[Tuple[str, str]]:
+        """Resolve (module, class) through package re-export chains
+        (``from .comm_manager import FedMLCommManager`` in __init__).
+        When the chain leaves the scanned set (partial scans skip the
+        package __init__), fall back to a unique-name match over the
+        loaded modules."""
+        for _ in range(hops):
+            target = self.modules.get(mod_name)
+            if target is None:
+                break
+            if cls in target.classes:
+                return (target.name, cls)
+            fi = target.from_imports.get(cls)
+            if fi is None:
+                return None
+            mod_name, cls = fi
+        owners = [m.name for m in self.modules.values() if cls in m.classes]
+        if len(owners) == 1:
+            return (owners[0], cls)
+        return None
+
+    def family(self, mod_name: str, cls: str) -> List[Tuple[str, str]]:
+        """The class plus its resolvable ancestors (scan-local), MRO-ish."""
+        out: List[Tuple[str, str]] = []
+        seen: Set[Tuple[str, str]] = set()
+        work = [(mod_name, cls)]
+        while work:
+            key = work.pop(0)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(key)
+            mod = self.modules.get(key[0])
+            if mod is None:
+                continue
+            for b in mod.class_bases.get(key[1], []):
+                rb = self._resolve_base(mod, b)
+                if rb is not None:
+                    work.append(rb)
+        return out
+
+    def family_method(self, mod_name: str, cls: str,
+                      name: str) -> Optional[FuncInfo]:
+        for m, c in self.family(mod_name, cls):
+            mod = self.modules.get(m)
+            if mod is None:
+                continue
+            fi = mod.classes.get(c, {}).get(name)
+            if fi is not None:
+                return fi
+        return None
+
+    def is_serving(self, fi: FuncInfo) -> bool:
+        return (fi.class_name is not None
+                and (fi.module.name, fi.class_name) in self.serving_classes)
+
+    # -- build ---------------------------------------------------------------
+
+    def _build(self) -> None:
+        self._find_serving_classes()
+        self._find_singletons()
+        self._find_thread_sites()
+        self._build_closure()
+        self._build_ownership()
+
+    def _find_serving_classes(self) -> None:
+        direct: Set[Tuple[str, str]] = set()
+        for mod in self.modules.values():
+            for cls, methods in mod.classes.items():
+                for fi in methods.values():
+                    for node in _walk_shallow(fi.node):
+                        if not isinstance(node, ast.Call):
+                            continue
+                        ds = dotted(node.func) or ""
+                        tail = ds.split(".")[-1]
+                        if tail in REGISTER_CALLS or tail in FLOW_CALLS:
+                            direct.add((mod.name, cls))
+        for key in direct:
+            for fam in self.family(*key):
+                self.serving_classes.add(fam)
+
+    # -- singletons ----------------------------------------------------------
+
+    def _find_singletons(self) -> None:
+        for mod in self.modules.values():
+            locks: Set[str] = set()
+            containers: Dict[str, int] = {}
+            for node in ast.iter_child_nodes(mod.tree):
+                targets: List[ast.expr] = []
+                value: Optional[ast.expr] = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets, value = [node.target], node.value
+                if value is None:
+                    continue
+                for t in targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    name = t.id
+                    if _is_sync_prim(value):
+                        locks.add(name)
+                        continue
+                    if _is_container_value(value):
+                        containers[name] = node.lineno
+                        continue
+                    if ((name.startswith("_") or name.isupper())
+                            and _is_instance_ctor(value)):
+                        self.singletons[(mod.name, name)] = Singleton(
+                            mod.name, name, node.lineno, "instance")
+            self.module_locks[mod.name] = locks
+            # a module container is a singleton only when some function
+            # body actually WRITES it (a registry/cache); constant lookup
+            # tables stay out
+            written = self._written_module_names(mod)
+            self.module_mutables[mod.name] = dict(containers)
+            for name, line in containers.items():
+                if name in written:
+                    self.singletons[(mod.name, name)] = Singleton(
+                        mod.name, name, line, "container")
+            # class-level registries
+            for clsnode in ast.iter_child_nodes(mod.tree):
+                if not isinstance(clsnode, ast.ClassDef):
+                    continue
+                for stmt in clsnode.body:
+                    tgt, val = None, None
+                    if (isinstance(stmt, ast.Assign)
+                            and len(stmt.targets) == 1
+                            and isinstance(stmt.targets[0], ast.Name)):
+                        tgt, val = stmt.targets[0].id, stmt.value
+                    elif (isinstance(stmt, ast.AnnAssign)
+                          and isinstance(stmt.target, ast.Name)
+                          and stmt.value is not None):
+                        tgt, val = stmt.target.id, stmt.value
+                    if tgt is None or val is None:
+                        continue
+                    if _is_container_value(val):
+                        self.singletons[(mod.name, f"{clsnode.name}.{tgt}")] \
+                            = Singleton(mod.name, tgt, stmt.lineno,
+                                        "class-registry", cls=clsnode.name)
+
+    def _written_module_names(self, mod: ModuleInfo) -> Set[str]:
+        written: Set[str] = set()
+        for fi in mod.funcs_by_node.values():
+            for node in _walk_shallow(fi.node):
+                if isinstance(node, ast.Global):
+                    written.update(node.names)
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (node.targets if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for t in targets:
+                        base = t
+                        while isinstance(base, ast.Subscript):
+                            base = base.value
+                        if isinstance(base, ast.Name):
+                            if isinstance(t, ast.Subscript):
+                                written.add(base.id)
+                elif isinstance(node, ast.Call):
+                    f = node.func
+                    if (isinstance(f, ast.Attribute)
+                            and f.attr in MUTATOR_METHODS
+                            and isinstance(f.value, ast.Name)):
+                        written.add(f.value.id)
+                elif isinstance(node, ast.Delete):
+                    for t in node.targets:
+                        if isinstance(t, ast.Subscript) and isinstance(
+                                t.value, ast.Name):
+                            written.add(t.value.id)
+        return written
+
+    # -- thread sites --------------------------------------------------------
+
+    def _thread_kind(self, mod: ModuleInfo, call: ast.Call) -> Optional[str]:
+        ds = dotted(call.func)
+        if not ds:
+            return None
+        parts = ds.split(".")
+        last = parts[-1]
+        if last in THREAD_CTORS:
+            ok = False
+            if len(parts) > 1:
+                head = parts[0]
+                ok = (head == "threading"
+                      or mod.imports.get(head, "") == "threading")
+            else:
+                fi = mod.from_imports.get(last)
+                ok = bool(fi and fi[0] == "threading")
+            if ok:
+                return "timer" if last == "Timer" else "thread"
+            return None
+        if last in EXECUTOR_CTORS:
+            return "executor"
+        return None
+
+    def _find_thread_sites(self) -> None:
+        for mod in self.modules.values():
+            for fi in mod.funcs_by_node.values():
+                self._scan_thread_sites(mod, fi)
+
+    def _scan_thread_sites(self, mod: ModuleInfo, fi: FuncInfo) -> None:
+        claimed: Set[int] = set()
+
+        def record(call: ast.Call, kind: str, binding: str,
+                   name: Optional[str] = None) -> None:
+            if id(call) in claimed:
+                return
+            claimed.add(id(call))
+            self.thread_sites.append(
+                ThreadSite(mod, fi, call, kind, binding, name))
+
+        for node in _walk_shallow(fi.node):
+            # bindings first, so the generic pass below sees them claimed
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t, v = node.targets[0], node.value
+                kind = isinstance(v, ast.Call) and self._thread_kind(mod, v)
+                if kind:
+                    if isinstance(t, ast.Name):
+                        record(v, kind, "local", t.id)
+                    elif (isinstance(t, ast.Attribute)
+                          and isinstance(t.value, ast.Name)
+                          and t.value.id == "self"):
+                        record(v, kind, "attr", t.attr)
+                    continue
+                if isinstance(v, (ast.ListComp, ast.GeneratorExp)) \
+                        and isinstance(t, ast.Name):
+                    for sub in ast.walk(v):
+                        if isinstance(sub, ast.Call):
+                            k = self._thread_kind(mod, sub)
+                            if k:
+                                record(sub, k, "comp", t.id)
+            elif isinstance(node, ast.Return) and isinstance(
+                    node.value, ast.Call):
+                k = self._thread_kind(mod, node.value)
+                if k:
+                    record(node.value, k, "returned")
+            elif isinstance(node, ast.Call):
+                # Thread(...).start() chained — never joinable
+                f = node.func
+                if (isinstance(f, ast.Attribute) and f.attr == "start"
+                        and isinstance(f.value, ast.Call)):
+                    k = self._thread_kind(mod, f.value)
+                    if k:
+                        record(f.value, k, "chained")
+                # ctor directly as an argument: ownership transferred
+                for arg in list(node.args) + [kw.value for kw in
+                                              node.keywords]:
+                    if isinstance(arg, ast.Call):
+                        k = self._thread_kind(mod, arg)
+                        if k:
+                            record(arg, k, "arg")
+        # anything not claimed by a shape above
+        for node in _walk_shallow(fi.node):
+            if isinstance(node, ast.Call):
+                k = self._thread_kind(mod, node)
+                if k and id(node) not in claimed:
+                    record(node, k, "unbound")
+
+    # -- closure -------------------------------------------------------------
+
+    def _callback_target(self, fi: FuncInfo,
+                         expr: ast.expr) -> Optional[FuncInfo]:
+        """Resolve a callback expression (self._x, bare name, lambda)."""
+        mod = fi.module
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name) and expr.value.id == "self":
+            if fi.class_name:
+                return self.family_method(mod.name, fi.class_name, expr.attr)
+            return None
+        if isinstance(expr, ast.Name):
+            targets = self.lint.resolve_name(mod, fi, expr.id)
+            return targets[0] if targets else None
+        if isinstance(expr, ast.Lambda):
+            return mod.funcs_by_node.get(id(expr))
+        return None
+
+    def _build_closure(self) -> None:
+        roots: List[FuncInfo] = []
+        for mod in self.modules.values():
+            for cls, methods in mod.classes.items():
+                if (mod.name, cls) not in self.serving_classes:
+                    continue
+                for mname in ("receive_message", "send_message"):
+                    fi = methods.get(mname)
+                    if fi is not None:
+                        roots.append(fi)
+                for fi in methods.values():
+                    for node in _walk_shallow(fi.node):
+                        if not isinstance(node, ast.Call):
+                            continue
+                        ds = dotted(node.func) or ""
+                        tail = ds.split(".")[-1]
+                        if tail in REGISTER_CALLS and len(node.args) >= 2:
+                            t = self._callback_target(fi, node.args[1])
+                            if t is not None:
+                                roots.append(t)
+                        elif tail in FLOW_CALLS:
+                            cb = None
+                            if len(node.args) >= 2:
+                                cb = node.args[1]
+                            for kw in node.keywords:
+                                if kw.arg in ("callback", "executor_task"):
+                                    cb = kw.value
+                            if cb is not None:
+                                t = self._callback_target(fi, cb)
+                                if t is not None:
+                                    roots.append(t)
+                        else:
+                            # worker roots: thread/timer targets started
+                            # from serving code
+                            kind = self._thread_kind(mod, node)
+                            if kind:
+                                for kw in node.keywords:
+                                    if kw.arg == "target":
+                                        t = self._callback_target(
+                                            fi, kw.value)
+                                        if t is not None:
+                                            roots.append(t)
+                                if kind == "timer" and len(node.args) >= 2:
+                                    t = self._callback_target(
+                                        fi, node.args[1])
+                                    if t is not None:
+                                        roots.append(t)
+        work = list(roots)
+        while work:
+            fi = work.pop()
+            if fi in self.closure:
+                continue
+            self.closure.add(fi)
+            work.extend(self._closure_edges(fi))
+
+    def _closure_edges(self, fi: FuncInfo) -> List[FuncInfo]:
+        mod = fi.module
+        out: List[FuncInfo] = []
+        out.extend(fi.nested.values())
+        for node in _walk_shallow(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            # self.method(...) — family-resolved (covers base classes)
+            if (isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "self" and fi.class_name):
+                t = self.family_method(mod.name, fi.class_name, func.attr)
+                if t is not None:
+                    out.append(t)
+            elif isinstance(func, ast.Name):
+                out.extend(self.lint.resolve_name(mod, fi, func.id))
+            elif isinstance(func, ast.Attribute) and isinstance(
+                    func.value, ast.Name):
+                # module-qualified package call: modalias.fn(...)
+                base = func.value.id
+                tgt = mod.imports.get(base)
+                if tgt is None and base in mod.from_imports:
+                    b, orig = mod.from_imports[base]
+                    full = f"{b}.{orig}" if b else orig
+                    tgt = full if full in self.modules else None
+                if tgt and tgt in self.modules:
+                    target = self.modules[tgt]
+                    if func.attr in target.toplevel:
+                        out.append(target.toplevel[func.attr])
+            # scheduled callbacks: bare self._x / lambda passed as an arg
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Lambda):
+                    t = mod.funcs_by_node.get(id(arg))
+                    if t is not None:
+                        out.append(t)
+                elif (isinstance(arg, ast.Attribute)
+                      and isinstance(arg.value, ast.Name)
+                      and arg.value.id == "self" and fi.class_name):
+                    t = self.family_method(mod.name, fi.class_name, arg.attr)
+                    if t is not None:
+                        out.append(t)
+        return out
+
+    # -- ownership graph -----------------------------------------------------
+
+    def _build_ownership(self) -> None:
+        for mod in self.modules.values():
+            graph = OwnershipGraph()
+            self.ownership[mod.name] = graph
+            for cls, methods in mod.classes.items():
+                attrs: Dict[str, int] = {}
+                for fi in methods.values():
+                    for node in _walk_shallow(fi.node):
+                        if (isinstance(node, ast.Assign)
+                                and len(node.targets) == 1):
+                            t = node.targets[0]
+                            if (isinstance(t, ast.Attribute)
+                                    and isinstance(t.value, ast.Name)
+                                    and t.value.id == "self"
+                                    and _is_container_value(node.value)):
+                                attrs.setdefault(t.attr, node.lineno)
+                if attrs:
+                    graph.mutable_attrs[cls] = attrs
+            for cls, methods in mod.classes.items():
+                attrs = graph.mutable_attrs.get(cls, {})
+                if not attrs:
+                    continue
+                for fi in methods.values():
+                    self._scan_escapes(mod, cls, fi, attrs, graph)
+
+    def _is_scanned_class_ctor(self, mod: ModuleInfo,
+                               call: ast.Call) -> Optional[str]:
+        if not isinstance(call.func, ast.Name):
+            return None
+        name = call.func.id
+        if name in mod.classes:
+            return name
+        fi = mod.from_imports.get(name)
+        if fi:
+            target = self.modules.get(fi[0])
+            if target and fi[1] in target.classes:
+                return fi[1]
+        return None
+
+    def _scan_escapes(self, mod: ModuleInfo, cls: str, fi: FuncInfo,
+                      attrs: Dict[str, int], graph: OwnershipGraph) -> None:
+        def self_attr(e: ast.expr) -> Optional[str]:
+            if (isinstance(e, ast.Attribute)
+                    and isinstance(e.value, ast.Name)
+                    and e.value.id == "self" and e.attr in attrs):
+                return e.attr
+            return None
+
+        for node in _walk_shallow(fi.node):
+            if isinstance(node, ast.Call):
+                target_cls = self._is_scanned_class_ctor(mod, node)
+                if target_cls is None:
+                    continue
+                if any(tok in target_cls for tok in WORLD_ROOT_TOKENS):
+                    continue  # the world root is the sanctioned owner
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    a = self_attr(arg)
+                    if a is not None:
+                        graph.escapes.append(Escape(
+                            cls, a, node.lineno,
+                            f"passed into {target_cls}(...)", target_cls))
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                a = self_attr(node.value)
+                if (a is not None and isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id not in ("self", "cls")
+                        and not any(tok.lower() in t.value.id.lower()
+                                    for tok in WORLD_ROOT_TOKENS)):
+                    graph.escapes.append(Escape(
+                        cls, a, node.lineno,
+                        f"assigned onto {t.value.id}.{t.attr}", t.value.id))
+
+
+def build_model(modules: Dict[str, ModuleInfo],
+                lint: Analyzer) -> ServingModel:
+    return ServingModel(modules, lint)

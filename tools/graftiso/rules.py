@@ -1,0 +1,801 @@
+"""Rule checkers I001–I005 over the :class:`~tools.graftiso.model.ServingModel`.
+
+The I-rules statically enforce the world-scoping contract underneath
+multi-tenant serving (docs/graftiso.md):
+
+- **I001** module-global mutable state written from handler/round/worker
+  code (the closure) — the direct cross-tenant leak; plus the install-once
+  latch prong: a ``global`` rebind anywhere that is not guarded by a
+  module-level lock is a racy process-wide latch.
+- **I002** process-wide singleton access without a run/world/tenant
+  discriminator: direct reads/writes of module instances
+  (``telemetry._REG``), written module containers, or class registries
+  from closure code — and closure calls into functions whose bodies touch
+  one (one resolved hop) — unless the access path carries a scope
+  (``self.world.…``, an argument named ``run_id``/``rank``/``world``/…).
+- **I003** class-level mutable defaults (one object shared by every
+  instance; the guarded-registry idiom — a class-level Lock companion —
+  is exempt and policed by I002 instead) and cross-instance mutable-attr
+  aliasing from the per-module ownership graph (an attr passed into
+  another class's constructor or assigned onto a foreign object escapes
+  its owner; world roots are the sanctioned receivers).
+- **I004** ambient configuration: module globals captured from
+  ``os.environ``/``sys.argv`` at import time, and environment /
+  ``get_args()`` reads inside handler/worker code.
+- **I005** untethered thread/executor lifecycle: every
+  ``threading.Thread``/``Timer``/``ThreadPoolExecutor`` must be joinable
+  from its scope's shutdown path — joined/cancelled/shut down in a
+  stop/close/finish-reachable method, registered with the world
+  (``world.register_thread``/``register_timer``), or ownership-transferred
+  (constructor passed directly as an argument / returned to the caller).
+
+Scope notes (documented limits, mirrored in docs/graftiso.md): the
+closure stays inside the serving class family plus module-local helpers
+(no class-hierarchy guessing); a singleton module's own functions are its
+sanctioned accessor API (the call SITE in serving code is what must carry
+the scope); transport backends (gRPC/MQTT/loopback) register no handlers
+and are policed by graftlint G005/graftproto P-rules instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..graftlint.analyzer import (
+    Analyzer,
+    FuncInfo,
+    ModuleInfo,
+    _walk_shallow,
+    dotted,
+)
+from .findings import Finding
+from .model import (
+    MUTATOR_METHODS,
+    SHUTDOWN_TOKENS,
+    ServingModel,
+    Singleton,
+    ThreadSite,
+    _is_sync_prim,
+)
+
+# tokens that mark an access path as scope-discriminated
+SCOPE_RECEIVER_TOKENS = ("world", "scope")
+SCOPE_ARG_TOKENS = ("world", "run_id", "run", "tenant", "rank", "scope")
+
+# call-name tokens that tether a thread to a scope's lifecycle
+REGISTER_TOKENS = ("register_thread", "register_timer")
+
+# ambient-config sources
+ENV_PATHS = ("os.environ", "sys.argv")
+ENV_CALLS = ("os.getenv", "environ.get", "os.environ.get")
+AMBIENT_FNS = ("get_args", "load_arguments")
+
+TETHER_METHODS = {"join", "cancel", "shutdown"}
+
+
+def _mk(mod: ModuleInfo, rule: str, node: ast.AST, message: str) -> Finding:
+    line = getattr(node, "lineno", 1)
+    col = getattr(node, "col_offset", 0)
+    return Finding(rule=rule, path=mod.rel, line=line, col=col,
+                   message=message, line_text=mod.line_text(line))
+
+
+def _attr_chain(e: ast.expr) -> List[str]:
+    """``a.b.c`` → ["a", "b", "c"]; [] when the base is not a Name."""
+    parts: List[str] = []
+    while isinstance(e, ast.Attribute):
+        parts.append(e.attr)
+        e = e.value
+    if isinstance(e, ast.Name):
+        parts.append(e.id)
+        return list(reversed(parts))
+    return []
+
+
+def _has_scope_token(e: ast.expr) -> bool:
+    for node in ast.walk(e):
+        if isinstance(node, ast.Name) and any(
+                tok in node.id.lower() for tok in SCOPE_ARG_TOKENS):
+            return True
+        if isinstance(node, ast.Attribute) and any(
+                tok in node.attr.lower() for tok in SCOPE_ARG_TOKENS):
+            return True
+    return False
+
+
+def _call_is_scoped(call: ast.Call) -> bool:
+    """The call carries a run/world/tenant discriminator: a scoped
+    receiver chain (``self.world.…``) or a scope-named argument."""
+    chain = _attr_chain(call.func)
+    if any(any(tok in seg.lower() for tok in SCOPE_RECEIVER_TOKENS)
+           for seg in chain[:-1]):
+        return True
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        if _has_scope_token(arg):
+            return True
+    for kw in call.keywords:
+        if kw.arg and any(tok in kw.arg.lower()
+                          for tok in SCOPE_ARG_TOKENS):
+            return True
+    return False
+
+
+def _function_locals(fi: FuncInfo) -> Set[str]:
+    out: Set[str] = set(fi.params())
+    for node in _walk_shallow(fi.node):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    for sub in ast.walk(item.optional_vars):
+                        if isinstance(sub, ast.Name):
+                            out.add(sub.id)
+    # names declared global are NOT locals
+    for node in _walk_shallow(fi.node):
+        if isinstance(node, ast.Global):
+            out -= set(node.names)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# I001 — module-global mutable state written from handler/worker code
+# ---------------------------------------------------------------------------
+
+
+class _I001Checker:
+    def __init__(self, model: ServingModel, mod: ModuleInfo, fi: FuncInfo):
+        self.model = model
+        self.mod = mod
+        self.fi = fi
+        self.findings: List[Finding] = []
+        self.globals_declared: Set[str] = set()
+        for node in _walk_shallow(fi.node):
+            if isinstance(node, ast.Global):
+                self.globals_declared.update(node.names)
+
+    def run(self) -> List[Finding]:
+        in_closure = self.fi in self.model.closure
+        if in_closure:
+            self._check_closure_writes()
+        if self.globals_declared and not in_closure:
+            self._check_latch_writes()
+        return self.findings
+
+    # -- closure prong -------------------------------------------------------
+
+    def _check_closure_writes(self) -> None:
+        mutables = self.model.module_mutables.get(self.mod.name, {})
+        locals_ = _function_locals(self.fi)
+
+        def module_mutable(name: str) -> bool:
+            return name in mutables and name not in locals_
+
+        for node in _walk_shallow(self.fi.node):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Name) and \
+                            t.id in self.globals_declared:
+                        self.findings.append(_mk(
+                            self.mod, "I001", node,
+                            f"handler/worker code rebinds module global "
+                            f"`{t.id}` — every federation in the process "
+                            "shares it; move it onto the world scope"))
+                    base = t
+                    while isinstance(base, ast.Subscript):
+                        base = base.value
+                    if (isinstance(t, ast.Subscript)
+                            and isinstance(base, ast.Name)
+                            and module_mutable(base.id)):
+                        self.findings.append(_mk(
+                            self.mod, "I001", node,
+                            f"handler/worker code writes module-level "
+                            f"container `{base.id}` — cross-tenant shared "
+                            "state; key it by run identity on the world "
+                            "scope"))
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if (isinstance(f, ast.Attribute)
+                        and f.attr in MUTATOR_METHODS
+                        and isinstance(f.value, ast.Name)
+                        and module_mutable(f.value.id)):
+                    self.findings.append(_mk(
+                        self.mod, "I001", node,
+                        f"handler/worker code mutates module-level "
+                        f"container `{f.value.id}` via .{f.attr}(...) — "
+                        "cross-tenant shared state; move it onto the "
+                        "world scope"))
+
+    # -- latch prong ---------------------------------------------------------
+
+    def _check_latch_writes(self) -> None:
+        locks = self.model.module_locks.get(self.mod.name, set())
+
+        def visit(node: ast.AST, lock_depth: int) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                depth = lock_depth
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    for item in child.items:
+                        ctx = item.context_expr
+                        name = None
+                        if isinstance(ctx, ast.Name):
+                            name = ctx.id
+                        elif isinstance(ctx, ast.Attribute):
+                            name = ctx.attr
+                        if name is not None and (
+                                name in locks
+                                or name.lower().endswith("lock")):
+                            depth += 1
+                if isinstance(child, (ast.Assign, ast.AugAssign)):
+                    targets = (child.targets
+                               if isinstance(child, ast.Assign)
+                               else [child.target])
+                    for t in targets:
+                        if (isinstance(t, ast.Name)
+                                and t.id in self.globals_declared
+                                and lock_depth == 0):
+                            self.findings.append(_mk(
+                                self.mod, "I001", child,
+                                f"`global {t.id}` is rebound without a "
+                                "module-level lock held — an install-once "
+                                "latch that two threads can both pass; "
+                                "wrap the check-and-set in `with _LOCK:`"))
+                visit(child, depth)
+
+        visit(self.fi.node, 0)
+
+
+# ---------------------------------------------------------------------------
+# I002 — process-wide singleton access without a scoping key
+# ---------------------------------------------------------------------------
+
+
+class _I002Checker:
+    def __init__(self, model: ServingModel, mod: ModuleInfo, fi: FuncInfo):
+        self.model = model
+        self.mod = mod
+        self.fi = fi
+        self.findings: List[Finding] = []
+
+    # -- resolution ----------------------------------------------------------
+
+    def _singleton_at(self, mod: ModuleInfo,
+                      e: ast.expr) -> Optional[Singleton]:
+        """The singleton a Name/Attribute path denotes, if any."""
+        chain = _attr_chain(e)
+        if not chain:
+            return None
+        head = chain[0]
+        # bare name: same-module singleton or from-import
+        if len(chain) == 1:
+            s = self.model.singletons.get((mod.name, head))
+            if s is not None and s.cls is None:
+                return s
+            fi = mod.from_imports.get(head)
+            if fi:
+                return self.model.singletons.get((fi[0], fi[1]))
+            return None
+        # modalias.NAME
+        tgt = mod.imports.get(head)
+        if tgt is None and head in mod.from_imports:
+            b, orig = mod.from_imports[head]
+            full = f"{b}.{orig}" if b else orig
+            tgt = full
+        if tgt is not None:
+            s = self.model.singletons.get((tgt, chain[1]))
+            if s is not None and s.cls is None:
+                return s
+        # ClassName.attr (class registry), local or imported class
+        cls_mod: Optional[str] = None
+        cls_name = head
+        if head in mod.classes:
+            cls_mod = mod.name
+        else:
+            fi2 = mod.from_imports.get(head)
+            if fi2:
+                cls_mod, cls_name = fi2[0], fi2[1]
+        if cls_mod is not None:
+            s = self.model.singletons.get(
+                (cls_mod, f"{cls_name}.{chain[1]}"))
+            if s is not None:
+                return s
+        # self.attr / cls.attr → registry of the function's own family is
+        # sanctioned (its accessor API); other attrs are instance state
+        return None
+
+    def _foreign_registry(self, fi: FuncInfo, s: Singleton) -> bool:
+        """A class registry accessed from outside its defining family."""
+        if s.cls is None:
+            return True
+        if fi.class_name is None:
+            return True
+        family = {c for _, c in self.model.family(fi.module.name,
+                                                  fi.class_name)}
+        return s.cls not in family
+
+    def _body_touches_singleton(self, tf: FuncInfo) -> Optional[Singleton]:
+        """A direct singleton access in ``tf``'s body (one resolved hop)."""
+        tmod = tf.module
+        for node in _walk_shallow(tf.node):
+            if isinstance(node, (ast.Attribute, ast.Name)):
+                s = self._singleton_at(tmod, node)
+                if s is not None and self._foreign_registry(tf, s):
+                    return s
+            elif isinstance(node, ast.Call):
+                # receiver of a method call: _REG.inc(...)
+                if isinstance(node.func, ast.Attribute):
+                    s = self._singleton_at(tmod, node.func.value)
+                    if s is not None and self._foreign_registry(tf, s):
+                        return s
+        return None
+
+    def _resolve_call(self, call: ast.Call) -> List[FuncInfo]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            return self.model.lint.resolve_name(self.mod, self.fi, func.id)
+        if isinstance(func, ast.Attribute) and isinstance(
+                func.value, ast.Name):
+            base = func.value.id
+            tgt = self.mod.imports.get(base)
+            if tgt is None and base in self.mod.from_imports:
+                b, orig = self.mod.from_imports[base]
+                full = f"{b}.{orig}" if b else orig
+                tgt = full if full in self.model.modules else None
+            if tgt and tgt in self.model.modules:
+                target = self.model.modules[tgt]
+                if func.attr in target.toplevel:
+                    return [target.toplevel[func.attr]]
+        return []
+
+    # -- entry ---------------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        if self.fi not in self.model.closure:
+            return []
+        claimed: Set[int] = set()
+        for node in _walk_shallow(self.fi.node):
+            if isinstance(node, ast.Call):
+                self._check_call(node, claimed)
+        for node in _walk_shallow(self.fi.node):
+            if isinstance(node, (ast.Attribute, ast.Name)) \
+                    and id(node) not in claimed:
+                self._check_direct(node, claimed)
+        return self.findings
+
+    def _check_call(self, call: ast.Call, claimed: Set[int]) -> None:
+        # claim the callee path so the direct pass doesn't re-report it
+        for sub in ast.walk(call.func):
+            claimed.add(id(sub))
+        if _call_is_scoped(call):
+            # scoped access: also claim argument paths (the key IS the
+            # discriminator)
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                for sub in ast.walk(arg):
+                    claimed.add(id(sub))
+            return
+        # receiver itself a singleton: _REG.inc(...) — module instances are
+        # exempt inside their own module (accessor API), class registries
+        # only inside their own class family
+        if isinstance(call.func, ast.Attribute):
+            s = self._singleton_at(self.mod, call.func.value)
+            if s is not None and self._foreign_registry(self.fi, s) \
+                    and (s.cls is not None or s.module != self.mod.name):
+                self.findings.append(_mk(
+                    self.mod, "I002", call,
+                    f"handler/worker code calls `.{call.func.attr}(...)` "
+                    f"on process-wide singleton `{s.label()}` "
+                    f"({s.module}) with no run/world discriminator — "
+                    "route it through the world scope"))
+                return
+        # one resolved hop into a singleton-touching function
+        for tf in self._resolve_call(call):
+            if tf.class_name is not None or tf.parent is not None:
+                continue  # methods/nested fns: covered by closure itself
+            s = self._body_touches_singleton(tf)
+            if s is not None:
+                label = dotted(call.func) or tf.name
+                self.findings.append(_mk(
+                    self.mod, "I002", call,
+                    f"handler/worker code reaches process-wide singleton "
+                    f"`{s.label()}` ({s.module}) through `{label}(...)` "
+                    "with no run/world discriminator — use the world "
+                    "scope (self.world.telemetry.…) or pass the scoping "
+                    "key explicitly"))
+                return
+
+    def _check_direct(self, node: ast.expr, claimed: Set[int]) -> None:
+        s = self._singleton_at(self.mod, node)
+        if s is None:
+            return
+        for sub in ast.walk(node):
+            claimed.add(id(sub))
+        if s.module == self.mod.name and s.cls is None:
+            return  # a module's own functions are its accessor API
+        if not self._foreign_registry(self.fi, s):
+            return
+        self.findings.append(_mk(
+            self.mod, "I002", node,
+            f"handler/worker code touches process-wide singleton "
+            f"`{s.label()}` ({s.module}) directly — cross-tenant state; "
+            "access it through a run/world-keyed path"))
+
+
+# ---------------------------------------------------------------------------
+# I003 — class-level mutable defaults + cross-instance aliasing
+# ---------------------------------------------------------------------------
+
+
+def _class_locks(mod: ModuleInfo) -> Dict[str, bool]:
+    """class name → has a class-level synchronization primitive."""
+    out: Dict[str, bool] = {}
+    for clsnode in ast.iter_child_nodes(mod.tree):
+        if not isinstance(clsnode, ast.ClassDef):
+            continue
+        has = False
+        for stmt in clsnode.body:
+            value = getattr(stmt, "value", None)
+            if value is not None and _is_sync_prim(value):
+                has = True
+        out[clsnode.name] = has
+    return out
+
+
+def check_i003(model: ServingModel, mod: ModuleInfo) -> List[Finding]:
+    findings: List[Finding] = []
+    locks = _class_locks(mod)
+    for key, s in model.singletons.items():
+        if key[0] != mod.name or s.cls is None:
+            continue
+        if locks.get(s.cls):
+            # guarded-registry idiom: intentional, lock-companioned —
+            # scoped access is I002's business
+            continue
+        findings.append(_mk(
+            mod, "I003", _line_node(s.line),
+            f"class-level mutable default `{s.cls}.{s.name}` is ONE "
+            "object shared by every instance (and every federation) — "
+            "assign it in __init__, or pair it with a class-level Lock "
+            "if it is an intentional keyed registry"))
+    graph = model.ownership.get(mod.name)
+    if graph is not None:
+        for e in graph.escapes:
+            findings.append(_mk(
+                mod, "I003", _line_node(e.line),
+                f"mutable attr `{e.cls}.{e.attr}` escapes its owner — "
+                f"{e.via}: state written on one instance becomes readable "
+                "from another object without passing through the world "
+                "scope; hand over a world-owned handle instead"))
+    return findings
+
+
+class _line_node:
+    def __init__(self, line: int):
+        self.lineno = line
+        self.col_offset = 0
+
+
+# ---------------------------------------------------------------------------
+# I004 — ambient-config reads
+# ---------------------------------------------------------------------------
+
+
+def _env_source(mod: ModuleInfo, e: ast.expr) -> Optional[str]:
+    for node in ast.walk(e):
+        ds = dotted(node) if isinstance(node, (ast.Attribute,
+                                               ast.Name)) else None
+        if ds in ENV_PATHS:
+            return ds
+        if isinstance(node, ast.Call):
+            cds = dotted(node.func)
+            if cds and (cds in ENV_CALLS
+                        or any(cds.endswith(c) for c in ENV_CALLS)):
+                return cds
+    return None
+
+
+def check_i004_module(model: ServingModel, mod: ModuleInfo) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.iter_child_nodes(mod.tree):
+        value = None
+        if isinstance(node, ast.Assign):
+            value = node.value
+        elif isinstance(node, ast.AnnAssign):
+            value = node.value
+        if value is None:
+            continue
+        src = _env_source(mod, value)
+        if src is not None:
+            findings.append(_mk(
+                mod, "I004", node,
+                f"module global captured from `{src}` at import time — "
+                "ambient configuration every tenant in the process "
+                "inherits; read it at construction and thread it through "
+                "args/the world scope"))
+    return findings
+
+
+def check_i004_closure(model: ServingModel, mod: ModuleInfo,
+                       fi: FuncInfo) -> List[Finding]:
+    if fi not in model.closure:
+        return []
+    findings: List[Finding] = []
+    env_seen = ambient_seen = False
+    for node in _walk_shallow(fi.node):
+        if not env_seen and isinstance(node, (ast.Attribute, ast.Subscript,
+                                              ast.Call)):
+            src = _env_source(mod, node)
+            if src is not None:
+                env_seen = True
+                findings.append(_mk(
+                    mod, "I004", node,
+                    f"handler/worker code reads `{src}` — ambient config "
+                    "inside the serving path; resolve it once at "
+                    "construction and carry it on the world scope"))
+        if not ambient_seen and isinstance(node, ast.Call):
+            ds = dotted(node.func) or ""
+            if ds.split(".")[-1] in AMBIENT_FNS:
+                ambient_seen = True
+                findings.append(_mk(
+                    mod, "I004", node,
+                    f"handler/worker code calls `{ds}()` — the ambient "
+                    "process args are single-tenant by construction; use "
+                    "the args/world the manager was built with"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# I005 — untethered thread/executor lifecycle
+# ---------------------------------------------------------------------------
+
+
+class _I005Checker:
+    def __init__(self, model: ServingModel):
+        self.model = model
+        self.findings: List[Finding] = []
+        self._shutdown_cache: Dict[Tuple[str, str], List[FuncInfo]] = {}
+
+    def run(self) -> List[Finding]:
+        for site in self.model.thread_sites:
+            self._check_site(site)
+        return self.findings
+
+    # -- shutdown-path methods ----------------------------------------------
+
+    def _shutdown_methods(self, mod_name: str,
+                          cls: str) -> List[FuncInfo]:
+        key = (mod_name, cls)
+        cached = self._shutdown_cache.get(key)
+        if cached is not None:
+            return cached
+        seeds: List[FuncInfo] = []
+        for m, c in self.model.family(mod_name, cls):
+            mod = self.model.modules.get(m)
+            if mod is None:
+                continue
+            for name, fi in mod.classes.get(c, {}).items():
+                if any(tok in name.lower() for tok in SHUTDOWN_TOKENS):
+                    seeds.append(fi)
+        out: List[FuncInfo] = []
+        seen: Set[FuncInfo] = set()
+        work = list(seeds)
+        while work:
+            fi = work.pop()
+            if fi in seen:
+                continue
+            seen.add(fi)
+            out.append(fi)
+            for node in _walk_shallow(fi.node):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"):
+                    t = self.model.family_method(mod_name, cls,
+                                                 node.func.attr)
+                    if t is not None:
+                        work.append(t)
+        self._shutdown_cache[key] = out
+        return out
+
+    # -- tether predicates ---------------------------------------------------
+
+    @staticmethod
+    def _registers(node: ast.Call, ref_pred) -> bool:
+        ds = dotted(node.func) or ""
+        if not any(tok in ds for tok in REGISTER_TOKENS):
+            return False
+        return any(ref_pred(a) for a in
+                   list(node.args) + [kw.value for kw in node.keywords])
+
+    def _local_tethered(self, site: ThreadSite) -> bool:
+        name = site.name
+
+        def is_ref(e: ast.expr) -> bool:
+            return isinstance(e, ast.Name) and e.id == name
+
+        for node in _walk_shallow(site.fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr in TETHER_METHODS
+                    and is_ref(f.value)):
+                return True
+            if self._registers(node, is_ref):
+                return True
+        # stored onto self or appended into a self container: defer to the
+        # attr/container tether analysis
+        for node in _walk_shallow(site.fi.node):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    for t in node.targets) and is_ref(node.value):
+                attr = next(t.attr for t in node.targets
+                            if isinstance(t, ast.Attribute))
+                return self._attr_tethered(site, attr)
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "append"
+                    and isinstance(node.func.value, ast.Attribute)
+                    and isinstance(node.func.value.value, ast.Name)
+                    and node.func.value.value.id == "self"
+                    and any(is_ref(a) for a in node.args)):
+                return self._container_tethered(site,
+                                                node.func.value.attr)
+        return False
+
+    def _attr_tethered(self, site: ThreadSite, attr: str) -> bool:
+        fi = site.fi
+        if fi.class_name is None:
+            return False
+        mod_name = fi.module.name
+
+        def attr_ref(e: ast.expr) -> bool:
+            return (isinstance(e, ast.Attribute)
+                    and isinstance(e.value, ast.Name)
+                    and e.value.id == "self" and e.attr == attr)
+
+        # world registration tethers from ANYWHERE in the class family
+        for m, c in self.model.family(mod_name, fi.class_name):
+            mod = self.model.modules.get(m)
+            if mod is None:
+                continue
+            for method in mod.classes.get(c, {}).values():
+                for node in _walk_shallow(method.node):
+                    if isinstance(node, ast.Call) and \
+                            self._registers(node, attr_ref):
+                        return True
+        # join/cancel/shutdown must be reachable from the shutdown path
+        for method in self._shutdown_methods(mod_name, fi.class_name):
+            aliases: Set[str] = set()
+            for node in ast.walk(method.node):
+                if (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and attr_ref(node.value)):
+                    aliases.add(node.targets[0].id)
+            for node in ast.walk(method.node):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in TETHER_METHODS):
+                    continue
+                recv = node.func.value
+                if attr_ref(recv):
+                    return True
+                if isinstance(recv, ast.Name) and recv.id in aliases:
+                    return True
+        return False
+
+    def _container_tethered(self, site: ThreadSite, attr: str) -> bool:
+        """``self.<attr>.append(t)``: tethered when a shutdown-path method
+        references the container AND joins/cancels elements."""
+        fi = site.fi
+        if fi.class_name is None:
+            return False
+        for method in self._shutdown_methods(fi.module.name,
+                                             fi.class_name):
+            touches = False
+            tethers = False
+            for node in ast.walk(method.node):
+                if (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"
+                        and node.attr == attr):
+                    touches = True
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in TETHER_METHODS):
+                    tethers = True
+            if touches and tethers:
+                return True
+        return False
+
+    def _comp_tethered(self, site: ThreadSite) -> bool:
+        for node in _walk_shallow(site.fi.node):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in TETHER_METHODS):
+                return True
+        return False
+
+    # -- entry ---------------------------------------------------------------
+
+    def _check_site(self, site: ThreadSite) -> None:
+        kind = {"thread": "thread", "timer": "timer",
+                "executor": "executor"}[site.kind]
+        where = site.fi.qualname
+        if site.binding in ("arg", "returned"):
+            return  # ownership transferred to the callee / caller
+        if site.binding == "chained":
+            self.findings.append(_mk(
+                site.mod, "I005", site.node,
+                f"{kind} started with a chained `.start()` in `{where}` — "
+                "no reference survives, so no shutdown path can ever "
+                "join/cancel it; bind it and tether it to the scope"))
+            return
+        if site.binding == "unbound":
+            self.findings.append(_mk(
+                site.mod, "I005", site.node,
+                f"{kind} constructed without a binding in `{where}` — "
+                "nothing can join/cancel it; bind it and tether it to "
+                "the scope's shutdown path"))
+            return
+        if site.binding == "local":
+            if not self._local_tethered(site):
+                self.findings.append(_mk(
+                    site.mod, "I005", site.node,
+                    f"{kind} `{site.name}` in `{where}` is never joined/"
+                    "cancelled or registered with a world scope — it "
+                    "outlives the federation that started it; "
+                    "world.register_thread/register_timer it or join it "
+                    "before returning"))
+            return
+        if site.binding == "comp":
+            if not self._comp_tethered(site):
+                self.findings.append(_mk(
+                    site.mod, "I005", site.node,
+                    f"{kind}s built in comprehension `{site.name}` in "
+                    f"`{where}` are never joined — a kill here orphans "
+                    "the whole batch; join them (or register each with "
+                    "the world scope)"))
+            return
+        if site.binding == "attr":
+            if not self._attr_tethered(site, site.name):
+                self.findings.append(_mk(
+                    site.mod, "I005", site.node,
+                    f"{kind} `self.{site.name}` in `{where}` has no join/"
+                    "cancel reachable from a stop/close/finish method and "
+                    "no world registration — tenant shutdown would orphan "
+                    "it; world.register_thread(self."
+                    f"{site.name}) or join it from the scope's shutdown "
+                    "path"))
+
+
+# ---------------------------------------------------------------------------
+# Entry
+# ---------------------------------------------------------------------------
+
+
+def check_isolation(modules: Dict[str, ModuleInfo], lint: Analyzer,
+                    model: ServingModel) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in modules.values():
+        findings += check_i003(model, mod)
+        findings += check_i004_module(model, mod)
+        for fi in mod.funcs_by_node.values():
+            findings += _I001Checker(model, mod, fi).run()
+            findings += _I002Checker(model, mod, fi).run()
+            findings += check_i004_closure(model, mod, fi)
+    findings += _I005Checker(model).run()
+    return findings

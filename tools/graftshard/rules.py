@@ -59,6 +59,7 @@ def check_shard(model: ShardModel, modules: Dict[str, ModuleInfo],
                 findings += _check_hot_path(mod, fi, model)
             else:
                 findings += _check_host_transfers(mod, fi)
+            findings += _check_delivery_codec(mod, fi)
     return findings
 
 
@@ -300,6 +301,46 @@ def _check_host_transfers(mod: ModuleInfo, fi: FuncInfo) -> List[Finding]:
                     "round loop — every iteration gathers all shards over "
                     "ICI to one host; keep the value on device and pull "
                     "one reduced scalar after the loop"))
+    return findings
+
+
+def _check_delivery_codec(mod: ModuleInfo, fi: FuncInfo) -> List[Finding]:
+    """S004, delivery-plane prong (ROADMAP device-direct wire path): the
+    delta plane's ``encode``/``decode`` stages every frame through host
+    memory — ``np.asarray``/``np.array``/``np.frombuffer`` on a codec
+    input is the host round-trip the device-direct item removes (jit'd
+    elementwise kernels + dlpack into the raw-frame writer). Scoped to
+    modules under the delivery plane (``delivery`` in the module path) so
+    the finding inventory is exactly the codec surface; the current host
+    codec carries per-line pragma'd allowances until it goes on-device."""
+    if "delivery" not in mod.name or fi.name not in ("encode", "decode"):
+        return []
+    params = set(fi.params())
+    findings: List[Finding] = []
+    seen_lines: Set[int] = set()
+    for node in _walk_shallow(fi.node):
+        if not isinstance(node, ast.Call):
+            continue
+        ds = dotted(node.func)
+        parts = ds.split(".") if ds else []
+        if not (len(parts) > 1
+                and parts[-1] in ("asarray", "array", "frombuffer")
+                and _is_numpy(mod, parts[0])):
+            continue
+        arg = node.args[0] if node.args else None
+        base = arg
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        if (isinstance(base, ast.Name) and base.id in params
+                and node.lineno not in seen_lines):
+            seen_lines.add(node.lineno)
+            findings.append(_mk(
+                "S004", mod, node.lineno,
+                f"`{ds}` materializes codec input `{base.id}` on host "
+                f"inside delivery-plane `{fi.qualname}` — every frame "
+                "rides device→host→encode→wire (and the reverse on "
+                "receive); the device-direct wire path jits this stage "
+                "and emits frames from the device buffer (ROADMAP)"))
     return findings
 
 

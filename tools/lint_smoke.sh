@@ -1,22 +1,25 @@
 #!/usr/bin/env bash
-# CI smoke for ALL FOUR static-analysis gates:
+# CI smoke for ALL FIVE static-analysis gates:
 #  - graftlint  (G001–G005, JAX trace/donation/recompile/thread safety)
 #  - graftproto (P001–P009, comm-plane protocol + lock-order verification)
 #  - graftshard (S001–S005, sharding/HBM verification of the TPU
 #                execution plane)
 #  - graftrep   (D001–D006, determinism discipline + fused/unfused round
 #                equivalence of the trust pipeline)
+#  - graftiso   (I001–I005, serving-plane state ownership, tenant
+#                isolation & thread lifecycle)
 # The shipped tree must have ZERO non-baselined findings in each suite
 # (tools/<suite>/baseline.json holds the suppressed-but-visible debt —
-# graftshard's and graftrep's ship EMPTY), the JSON reports must parse,
-# and each gate must bite on a known-bad fixture.
+# graftshard's, graftrep's and graftiso's ship EMPTY), the JSON reports
+# must parse, and each gate must bite on a known-bad fixture.
 #
 # Exit-code contract (all suites): 0 clean, 1 findings, 2 analyzer crash —
 # a CI failure here is diagnosable at a glance.
 #
-# This is the cheap half of the tier-1 lint gate (tests/test_graftlint.py +
-# tests/test_graftproto.py + tests/test_graftshard.py are the full ones):
-# pure-AST, no jax import, sub-second.
+# This is the cheap half of the tier-1 lint gate (tests/test_graftlint.py
+# + test_graftproto.py + test_graftshard.py + test_graftrep.py +
+# test_graftiso.py are the full ones): pure-AST, no jax import,
+# sub-second.
 #
 # Usage: tools/lint_smoke.sh          (CI: exits non-zero on any regression)
 set -uo pipefail
@@ -159,6 +162,47 @@ fi
 if python -m tools.graftrep tests/fixtures/graftrep/d001_bad.py \
         --no-baseline >/dev/null 2>&1; then
     echo "lint_smoke: FAIL — graftrep passed a known-bad fixture" >&2
+    exit 1
+fi
+
+# ---- graftiso: the isolation pass, machine-readable ------------------------
+iso_out=$(timeout -k 10 120 python -m tools.graftiso fedml_tpu/ --json)
+rc=$?
+
+if [ "$rc" -ne 0 ]; then
+    echo "lint_smoke: FAIL — graftiso exited rc=$rc" >&2
+    printf '%s\n' "$iso_out" >&2
+    exit 1
+fi
+
+python - "$iso_out" <<'EOF'
+import json
+import sys
+
+payload = json.loads(sys.argv[1])
+assert payload["exit_code"] == 0, payload
+assert payload["findings"] == [], payload["findings"]
+# graftiso's baseline must stay EMPTY: the serving plane's world-scoping
+# contract holds everywhere, debt is fixed not suppressed
+assert payload["baselined"] == 0, payload
+# the serving model must actually have seen the plane — an empty closure
+# would mean the gate silently stopped analyzing anything
+serving = payload["serving"]
+assert serving["classes"], "no serving classes found"
+assert serving["closure_size"] > 0, serving
+print(f"lint_smoke: graftiso OK — 0 findings (baseline empty, "
+      f"{len(serving['classes'])} serving classes, "
+      f"closure {serving['closure_size']})")
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "lint_smoke: FAIL — graftiso JSON output did not validate" >&2
+    exit 1
+fi
+
+if python -m tools.graftiso tests/fixtures/graftiso/i005_bad.py \
+        --no-baseline >/dev/null 2>&1; then
+    echo "lint_smoke: FAIL — graftiso passed a known-bad fixture" >&2
     exit 1
 fi
 
