@@ -222,6 +222,27 @@ _SCHEMA: Dict[str, tuple] = {
     # gRPC rank→port multiplexing: N ranks share one port/server process
     # (port = comm_port + ceil(rank / N)); 1 = legacy port-per-rank
     "grpc_ranks_per_port": (int, 1),
+    # survivable serving plane (docs/robustness.md). round_deadline_s
+    # closes a sync round after this many seconds with the K' <= K
+    # updates that arrived (reweighted exactly — bitwise-equal to
+    # full-cohort FedAvg when nobody straggles) and folds LATE arrivals
+    # into the current round through the async staleness path
+    # ((1+s)^-async_staleness_alpha) instead of discarding them; 0 = off
+    # (the legacy round_timeout knob keeps its drop-the-stragglers
+    # semantics). min_clients_per_round bounds how small a deadline
+    # cohort may get.
+    "round_deadline_s": (float, 0.0),
+    "min_clients_per_round": (int, 1),
+    # client liveness/resync FSM: heartbeat_s > 0 sends a heartbeat
+    # lease every interval; heartbeat_miss_limit missed intervals
+    # without ANY server traffic declare the connection lost and start
+    # the bounded-exponential resync loop (c2s_resync every
+    # resync_backoff_s * 2^k, capped, at most resync_max_attempts).
+    "heartbeat_s": (float, 0.0),
+    "heartbeat_miss_limit": (int, 3),
+    "resync_backoff_s": (float, 0.5),
+    "resync_backoff_max_s": (float, 10.0),
+    "resync_max_attempts": (int, 30),
 }
 
 COMPRESSION_SCHEMES = ("", "topk", "eftopk", "qsgd", "quantize")
@@ -346,7 +367,9 @@ class Arguments:
         for non_negative in ("async_buffer_size", "async_max_staleness",
                              "async_admit_rate", "async_queue_limit",
                              "async_staleness_alpha", "async_flush_s",
-                             "async_admit_burst"):
+                             "async_admit_burst", "round_deadline_s",
+                             "heartbeat_s", "resync_backoff_s",
+                             "resync_backoff_max_s", "resync_max_attempts"):
             if float(getattr(self, non_negative, 0) or 0) < 0:
                 raise ValueError(f"{non_negative} must be >= 0")
         # delta delivery plane (docs/delivery.md)
@@ -489,6 +512,23 @@ def add_args() -> argparse.Namespace:
         "--mesh_state_rules", type=str, default=None,
         help="regex=axes;... placement rules for the mesh round state "
         "(docs/scale.md)",
+    )
+    # survivable serving plane (docs/robustness.md)
+    parser.add_argument(
+        "--round_deadline_s", type=float, default=None, metavar="S",
+        help="close a sync round after S seconds with the K' <= K updates "
+        "that arrived (reweighted exactly); late stragglers fold into the "
+        "open round via the staleness path instead of being dropped",
+    )
+    parser.add_argument(
+        "--heartbeat_s", type=float, default=None, metavar="S",
+        help="client heartbeat/lease interval; silence past "
+        "heartbeat_miss_limit intervals enters the bounded-exponential "
+        "resync loop (0 = liveness plane off)",
+    )
+    parser.add_argument(
+        "--min_clients_per_round", type=int, default=None, metavar="K",
+        help="smallest cohort a round deadline may close with",
     )
     # async traffic plane (fedml_tpu/traffic/ — docs/traffic.md)
     parser.add_argument(

@@ -53,6 +53,22 @@ def _world_overrides(a) -> Dict:
         learning_rate=0.2, backend="LOOPBACK", frequency_of_the_test=1000,
         random_seed=int(a.seed),
     )
+    if _kill_phase(a) or float(getattr(a, "heartbeat_s", 0.0) or 0.0) > 0:
+        # server-kill legs need the client liveness/resync FSM: a fast
+        # lease so a dead server is detected within ~a second, and a
+        # patient reconnect budget that rides out the restart leg's
+        # process spawn + jax import (tens of seconds on a cold host)
+        over.update(
+            heartbeat_s=float(getattr(a, "heartbeat_s", 0.0) or 0.3),
+            heartbeat_miss_limit=2,
+            resync_backoff_s=0.3,
+            resync_backoff_max_s=2.0,
+            resync_max_attempts=90,
+        )
+    if _partition_window(a) is not None:
+        # a healed partition must cost backoff, not contributions: give
+        # the at-least-once layer enough retry budget to outlast the cut
+        over.update(comm_retry_max_attempts=10)
     scheme = str(getattr(a, "compression", "") or "")
     if scheme:
         # BOTH legs (reference and chaos) run compressed + delta-shipped:
@@ -72,11 +88,33 @@ def _world_overrides(a) -> Dict:
     return over
 
 
+def _kill_phase(a) -> str:
+    return str(getattr(a, "kill_phase", "") or "")
+
+
+def _partition_window(a):
+    """Parse ``--partition START:DURATION`` (seconds) into a (start,
+    duration) tuple, or None when the flag is unset."""
+    raw = str(getattr(a, "partition", "") or "")
+    if not raw:
+        return None
+    try:
+        start_s, dur_s = raw.split(":", 1)
+        return float(start_s), float(dur_s)
+    except ValueError as e:
+        raise ValueError(
+            f"--partition wants START:DURATION seconds, got {raw!r}"
+        ) from e
+
+
 def build_fault_plan(rank: int, seed: int, loss: float, duplicate: float,
-                     corrupt: float):
+                     corrupt: float, partition=None):
     """Seeded per-client fault matrix. Loss is VISIBLE (the sender sees the
     failure and retries — the at-least-once contract under test); rank
-    decorrelates the client streams while keeping each reproducible."""
+    decorrelates the client streams while keeping each reproducible.
+    ``partition`` = (start_s, duration_s) cuts this client off from the
+    server for the window — bidirectionally, since the server's own plan
+    carries the same rule."""
     from .core.distributed.faults import FaultPlan
 
     plan = FaultPlan()
@@ -86,21 +124,83 @@ def build_fault_plan(rank: int, seed: int, loss: float, duplicate: float,
         plan.duplicate(p=duplicate, seed=seed * 2000 + rank)
     if corrupt > 0:
         plan.corrupt(p=corrupt, seed=seed * 3000 + rank)
+    if partition is not None:
+        plan.partition({0}, start_s=partition[0], duration_s=partition[1])
+    return plan
+
+
+def _resolved_heartbeat_s(a, kill_context: bool) -> float:
+    """The heartbeat interval a leg actually runs with: the user's value,
+    or the fast-lease default on kill legs (where the FSM is the thing
+    under test). Resolving it HERE — once, for every leg — keeps the
+    reference, killed, restart and client-process legs on one config."""
+    hb = float(getattr(a, "heartbeat_s", 0.0) or 0.0)
+    if hb <= 0 and kill_context:
+        hb = 0.3
+    return hb
+
+
+def client_proc_cmd(a, rank: int, port: int,
+                    kill_phase: str = "") -> List[str]:
+    """The ONE spawn command for a real gRPC chaos client process — used
+    by both the worker-owned leg (run_world) and the orchestrator-owned
+    crash-failover leg, so their fault matrices can never decorrelate."""
+    from fedml_tpu.traffic.swarm import python_module_cmd
+
+    hb = _resolved_heartbeat_s(a, bool(kill_phase or _kill_phase(a)))
+    cmd = python_module_cmd(
+        "fedml_tpu.cli", "chaos", "--client",
+        "--client_rank", str(rank), "--port", str(port),
+        "--clients", str(a.clients), "--rounds", str(a.rounds),
+        "--epochs", str(a.epochs), "--seed", str(a.seed),
+        "--loss", str(a.loss), "--duplicate", str(a.duplicate),
+        "--corrupt", str(a.corrupt),
+        "--partition", str(getattr(a, "partition", "") or ""),
+        "--heartbeat_s", str(hb),
+        "--compression", str(getattr(a, "compression", "") or ""),
+        "--compression_ratio", str(getattr(a, "compression_ratio", 0.1)),
+    )
+    if kill_phase:
+        # turns the client liveness/resync FSM on (matching the
+        # _world_overrides the server legs run with)
+        cmd += ["--kill-phase", kill_phase]
+    return cmd
+
+
+def build_server_fault_plan(a):
+    """The SERVER side of the fault matrix: the kill switch (SIGKILL at a
+    protocol phase) and/or its half of a partition cut. None when the
+    server runs fault-free."""
+    from .core.distributed.faults import FaultPlan
+
+    plan = None
+    kp = _kill_phase(a)
+    if kp:
+        plan = FaultPlan().kill_server(kp, int(a.kill_round))
+    window = _partition_window(a)
+    if window is not None:
+        plan = plan or FaultPlan()
+        plan.partition({0}, start_s=window[0], duration_s=window[1])
     return plan
 
 
 def run_world(a, run_id: str, checkpoint_dir: str, faulty: bool,
-              kill_round: int = -1) -> Dict:
+              kill_round: int = -1, server_only: bool = False) -> Dict:
     """One cross-silo federation: server in THIS process; clients either as
     loopback threads (default) or — with ``--transport grpc`` on a faulty
     leg — as REAL client OS processes over multiprocess gRPC, spawned
     through the swarm harness's :class:`ProcSpawner` (ISSUE 7 satellite:
-    chaos matrices beyond loopback).
+    chaos matrices beyond loopback). ``server_only`` runs JUST the server
+    against ``a.port`` — the crash-failover flow, where the orchestrator
+    owns long-lived client processes that must survive (and resync across)
+    this server process's SIGKILL + restart.
 
     Returns {"params": leaves, "server": manager, "preempted": bool}. With
     ``kill_round >= 0`` a watcher thread SIGTERMs THIS process as soon as
     the run ledger commits that round — the real preemption path, timed
-    deterministically off the durable commit rather than a sleep.
+    deterministically off the durable commit rather than a sleep. With
+    ``--kill-phase`` the server's fault plan SIGKILLs instead, at the
+    armed protocol phase (faults.FaultPlan.kill_server).
     """
     import fedml_tpu as fedml
     from fedml_tpu import data as data_mod
@@ -111,9 +211,9 @@ def run_world(a, run_id: str, checkpoint_dir: str, faulty: bool,
 
     from fedml_tpu.parallel.multihost import free_port
 
-    grpc_leg = faulty and str(
-        getattr(a, "transport", "loopback")).lower() == "grpc"
-    port = free_port() if grpc_leg else 0
+    grpc_leg = (faulty and not server_only and str(
+        getattr(a, "transport", "loopback")).lower() == "grpc")
+    port = free_port() if grpc_leg else int(getattr(a, "port", 0) or 0)
 
     def mk(role, rank=0):
         overrides = dict(
@@ -121,45 +221,44 @@ def run_world(a, run_id: str, checkpoint_dir: str, faulty: bool,
             checkpoint_dir=checkpoint_dir,
             checkpoint_rounds=int(a.checkpoint_rounds),
         )
-        if grpc_leg:
+        if grpc_leg or server_only:
             overrides.update(backend="GRPC", comm_port=port,
                              comm_host="127.0.0.1")
         return fedml.init(Arguments(overrides=overrides),
                           should_init_logs=False)
 
     args_s = mk("server")
+    if faulty:
+        server_plan = build_server_fault_plan(a)
+        if server_plan is not None:
+            args_s.fault_plan = server_plan
     ds, od = data_mod.load(args_s)
     bundle = model_mod.create(args_s, od)
     server = FedMLCrossSiloServer(args_s, None, ds, bundle)
 
+    partition = _partition_window(a) if faulty else None
     clients = []
     spawner = None
-    if grpc_leg:
-        from fedml_tpu.traffic.swarm import ProcSpawner, python_module_cmd
+    if server_only:
+        pass  # the orchestrator owns the client processes
+    elif grpc_leg:
+        from fedml_tpu.traffic.swarm import ProcSpawner
 
         spawner = ProcSpawner()
         for rank in range(1, int(a.clients) + 1):
-            spawner.spawn(python_module_cmd(
-                "fedml_tpu.cli", "chaos", "--client",
-                "--client_rank", str(rank), "--port", str(port),
-                "--clients", str(a.clients), "--rounds", str(a.rounds),
-                "--epochs", str(a.epochs), "--seed", str(a.seed),
-                "--loss", str(a.loss), "--duplicate", str(a.duplicate),
-                "--corrupt", str(a.corrupt),
-                "--compression", str(getattr(a, "compression", "") or ""),
-                "--compression_ratio",
-                str(getattr(a, "compression_ratio", 0.1)),
-            ))
+            spawner.spawn(client_proc_cmd(a, rank, port))
     else:
         for rank in range(1, int(a.clients) + 1):
             args_c = mk("client", rank)
             if faulty:
                 args_c.fault_plan = build_fault_plan(
                     rank, int(a.seed), float(a.loss), float(a.duplicate),
-                    float(a.corrupt),
+                    float(a.corrupt), partition=partition,
                 )
             clients.append(FedMLCrossSiloClient(args_c, None, ds, bundle))
 
+    if kill_round >= 0 and _kill_phase(a):
+        kill_round = -1  # the phase switch owns the kill; no SIGTERM watcher
     if kill_round >= 0:
         ledger = runstate.RunLedger.for_checkpoint_dir(checkpoint_dir)
         stop_watch = threading.Event()
@@ -223,13 +322,17 @@ def run_world(a, run_id: str, checkpoint_dir: str, faulty: bool,
 
 def run_worker(a) -> int:
     """One chaos leg in THIS process: run the faulty world, write the final
-    params + report into --out, exit EXIT_PREEMPTED if preempted."""
+    params + report into --out, exit EXIT_PREEMPTED if preempted. A
+    ``--kill-phase`` leg never reaches the report: the armed fault plan
+    SIGKILLs this process at the protocol phase — the restart leg (same
+    checkpoint_dir, no kill) writes them instead."""
     from fedml_tpu.core.runstate import EXIT_PREEMPTED
 
     os.makedirs(a.out, exist_ok=True)
     result = run_world(
         a, run_id=f"chaos-{os.getpid()}", checkpoint_dir=a.checkpoint_dir,
         faulty=True, kill_round=int(a.kill_round),
+        server_only=bool(getattr(a, "server_only", False)),
     )
     report = {
         "preempted": result["preempted"],
@@ -251,8 +354,10 @@ def run_worker(a) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _worker_cmd(a, out: str, ckpt_dir: str, kill_round: int) -> List[str]:
-    return [
+def _worker_cmd(a, out: str, ckpt_dir: str, kill_round: int,
+                kill_phase: str = "", server_only: bool = False,
+                port: int = 0) -> List[str]:
+    cmd = [
         sys.executable, "-m", "fedml_tpu.cli", "chaos", "--worker",
         "--out", out, "--checkpoint_dir", ckpt_dir,
         "--clients", str(a.clients), "--rounds", str(a.rounds),
@@ -261,10 +366,24 @@ def _worker_cmd(a, out: str, ckpt_dir: str, kill_round: int) -> List[str]:
         "--corrupt", str(a.corrupt),
         "--checkpoint_rounds", str(a.checkpoint_rounds),
         "--kill-round", str(kill_round),
+        "--kill-phase", kill_phase,
+        "--partition", str(getattr(a, "partition", "") or ""),
         "--transport", str(getattr(a, "transport", "loopback")),
         "--compression", str(getattr(a, "compression", "") or ""),
         "--compression_ratio", str(getattr(a, "compression_ratio", 0.1)),
     ]
+    if server_only:
+        cmd += ["--server-only", "--port", str(port)]
+    # the RESOLVED heartbeat interval reaches every leg — killed AND
+    # restart (whose own kill_phase is "") — so parity never compares
+    # two different FSM configs
+    cmd += ["--heartbeat_s",
+            str(_resolved_heartbeat_s(
+                a, bool(kill_phase or server_only or _kill_phase(a))))]
+    return cmd
+
+
+SIGKILL_RCS = (-9, 137)  # subprocess returncode forms of a SIGKILL death
 
 
 def _run_leg(cmd: List[str], timeout_s: float) -> int:
@@ -309,30 +428,82 @@ def orchestrate(a) -> int:
         return 1
 
     kill_round = int(a.kill_round)
-    logger.info("chaos: faulty leg (loss=%.2f dup=%.2f corrupt=%.2f, "
-                "self-SIGTERM after round %d) …", a.loss, a.duplicate,
-                a.corrupt, kill_round)
-    rc1 = _run_leg(_worker_cmd(a, chaos_out, chaos_ckpt, kill_round),
-                   float(a.timeout))
-    killed = rc1 == EXIT_PREEMPTED
-    if not killed and rc1 != 0:
-        print(json.dumps({"ok": False,
-                          "error": f"chaos leg failed rc={rc1}"}))
-        return 1
-    if kill_round >= 0 and not killed:
-        # the federation outran the watcher — still verify parity, but
-        # report that preemption wasn't exercised so CI can tighten knobs
-        logger.warning("chaos: run completed before the SIGTERM landed")
+    kill_phase = _kill_phase(a)
+    grpc_failover = (kill_phase and str(
+        getattr(a, "transport", "loopback")).lower() == "grpc")
+    client_spawner = None
+    port = 0
+    if grpc_failover:
+        # the crash-failover flow: the ORCHESTRATOR owns the client
+        # processes, so they survive the server's SIGKILL and must resync
+        # (heartbeat miss -> bounded reconnect -> c2s_resync -> replay)
+        # onto the restarted server process at the same port
+        from fedml_tpu.parallel.multihost import free_port
+        from fedml_tpu.traffic.swarm import ProcSpawner
 
-    if killed:
-        logger.info("chaos: preempted as planned (rc=%d) — restarting "
-                    "with --resume auto …", rc1)
-        rc2 = _run_leg(_worker_cmd(a, chaos_out, chaos_ckpt, -1),
-                       float(a.timeout))
-        if rc2 != 0:
+        port = free_port()
+        client_spawner = ProcSpawner()
+        for rank in range(1, int(a.clients) + 1):
+            client_spawner.spawn(
+                client_proc_cmd(a, rank, port, kill_phase=kill_phase))
+    if kill_phase:
+        logger.info("chaos: faulty leg (loss=%.2f dup=%.2f corrupt=%.2f, "
+                    "SIGKILL at %s of round %d) …", a.loss, a.duplicate,
+                    a.corrupt, kill_phase, kill_round)
+    else:
+        logger.info("chaos: faulty leg (loss=%.2f dup=%.2f corrupt=%.2f, "
+                    "self-SIGTERM after round %d) …", a.loss, a.duplicate,
+                    a.corrupt, kill_round)
+    try:
+        rc1 = _run_leg(
+            _worker_cmd(a, chaos_out, chaos_ckpt, kill_round,
+                        kill_phase=kill_phase, server_only=grpc_failover,
+                        port=port),
+            float(a.timeout))
+        killed = rc1 == EXIT_PREEMPTED or (kill_phase
+                                           and rc1 in SIGKILL_RCS)
+        if not killed and rc1 != 0:
             print(json.dumps({"ok": False,
-                              "error": f"resume leg failed rc={rc2}"}))
+                              "error": f"chaos leg failed rc={rc1}"}))
             return 1
+        if kill_phase and not killed:
+            print(json.dumps({
+                "ok": False,
+                "error": f"kill-phase {kill_phase!r} of round {kill_round} "
+                         "never fired (rc=0) — the armed phase was not "
+                         "reached"}))
+            return 1
+        if kill_round >= 0 and not kill_phase and not killed:
+            # the federation outran the watcher — still verify parity, but
+            # report that preemption wasn't exercised so CI can tighten
+            # knobs
+            logger.warning("chaos: run completed before the SIGTERM landed")
+
+        if killed:
+            logger.info("chaos: killed as planned (rc=%d) — restarting "
+                        "with --resume auto …", rc1)
+            rc2 = _run_leg(
+                _worker_cmd(a, chaos_out, chaos_ckpt, -1,
+                            server_only=grpc_failover, port=port),
+                float(a.timeout))
+            if rc2 != 0:
+                print(json.dumps({"ok": False,
+                                  "error": f"resume leg failed rc={rc2}"}))
+                return 1
+        if client_spawner is not None:
+            # every surviving client process must have resynced its way to
+            # FINISH — a wedged resync FSM shows up here as a nonzero exit
+            client_rcs = client_spawner.wait_all(
+                timeout_s=float(a.timeout))
+            if any(rc != 0 for rc in client_rcs):
+                print(json.dumps({
+                    "ok": False,
+                    "error": f"client processes did not all reach FINISH "
+                             f"across the server kill: {client_rcs}"}))
+                return 1
+    finally:
+        if client_spawner is not None:
+            client_spawner.kill_all()
 
     with np.load(os.path.join(chaos_out, FINAL_PARAMS_FILE)) as z:
         chaos_params = [z[k] for k in z.files]
@@ -349,9 +520,12 @@ def orchestrate(a) -> int:
 
     ledger = RunLedger.for_checkpoint_dir(chaos_ckpt)
     rounds_seen: Dict[int, Dict] = {}
+    round_counts: Dict[int, int] = {}
     double_counted: List[str] = []
     for e in ledger.rounds():
         rounds_seen[int(e["round"])] = e
+        round_counts[int(e["round"])] = round_counts.get(
+            int(e["round"]), 0) + 1
         for client, count in (e.get("contrib") or {}).items():
             if int(count) > 1:
                 double_counted.append(
@@ -364,6 +538,13 @@ def orchestrate(a) -> int:
     missing = expect_rounds - set(rounds_seen)
     if missing:
         problems.append(f"ledger missing committed rounds: {sorted(missing)}")
+    if kill_phase:
+        # a SIGKILL never drains, so no crash round is ever committed
+        # twice: the combined ledger must hold EXACTLY one entry per round
+        dups = sorted(r for r, n in round_counts.items() if n > 1)
+        if dups:
+            problems.append(
+                f"ledger committed rounds more than once: {dups}")
     full_cohort = list(range(1, int(a.clients) + 1))
     bad_cohorts = [r for r, e in sorted(rounds_seen.items())
                    if sorted(e.get("cohort") or []) != full_cohort]
@@ -379,7 +560,10 @@ def orchestrate(a) -> int:
         "fault_matrix": {"loss": float(a.loss),
                          "duplicate": float(a.duplicate),
                          "corrupt": float(a.corrupt),
-                         "seed": int(a.seed)},
+                         "seed": int(a.seed),
+                         "kill_phase": kill_phase or None,
+                         "partition": str(getattr(a, "partition", "")
+                                          or "") or None},
         "problems": problems,
         "workdir": workdir,
     }
@@ -409,7 +593,7 @@ def run_client_worker(a) -> int:
                         should_init_logs=False)
     args_c.fault_plan = build_fault_plan(
         rank, int(a.seed), float(a.loss), float(a.duplicate),
-        float(a.corrupt),
+        float(a.corrupt), partition=_partition_window(a),
     )
     ds, od = data_mod.load(args_c)
     bundle = model_mod.create(args_c, od)

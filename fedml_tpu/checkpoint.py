@@ -59,12 +59,15 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
-    def restore_latest(self, abstract_state: PyTree) -> Optional[PyTree]:
-        """Restore the newest checkpoint into the structure/shardings of
+    def steps(self) -> list:
+        """Every retained step, oldest first — the substrate a restarted
+        server rebuilds its version-store ring from (the retention window
+        IS the recoverable version history)."""
+        return sorted(int(s) for s in self._mgr.all_steps())
+
+    def restore(self, step: int, abstract_state: PyTree) -> PyTree:
+        """Restore one retained step into the structure/shardings of
         ``abstract_state`` (pass a concrete template state)."""
-        step = self._mgr.latest_step()
-        if step is None:
-            return None
         restored = self._mgr.restore(
             step, args=ocp.args.StandardRestore(abstract_state)
         )
@@ -80,6 +83,14 @@ class CheckpointManager:
         )
         logger.info("checkpoint: restored step %d from %s", step, self.directory)
         return restored
+
+    def restore_latest(self, abstract_state: PyTree) -> Optional[PyTree]:
+        """Restore the newest checkpoint into the structure/shardings of
+        ``abstract_state`` (pass a concrete template state)."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        return self.restore(step, abstract_state)
 
     def close(self) -> None:
         self._mgr.close()

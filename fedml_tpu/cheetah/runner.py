@@ -159,8 +159,10 @@ class CheetahRunner:
             jax.random.PRNGKey(int(getattr(self.args, "random_seed", 0)))
         )
         start_step = 0
+        guard = None
         if self.checkpoint_dir:
             from ..checkpoint import CheckpointManager
+            from ..core import runstate
 
             ckpt = CheckpointManager(self.checkpoint_dir)
             restored = ckpt.restore_latest(state)
@@ -168,6 +170,13 @@ class CheetahRunner:
                 state = restored
                 start_step = int(state.step)
                 logger.info("cheetah: resumed from step %d", start_step)
+            # step-granular preemption drain (docs/robustness.md): SIGTERM
+            # during a long pretrain exits within ONE step's latency with
+            # the state checkpointed at the step boundary it latched on
+            guard = runstate.preemption_guard()
+            if bool(getattr(self.args, "preempt_signals", True)):
+                guard.install()
+            guard.reset()
         rng = np.random.RandomState(int(getattr(self.args, "random_seed", 0)))
         gen = self._batches(rng)
         losses = []
@@ -208,6 +217,18 @@ class CheetahRunner:
             telemetry.on_round_end(step)
             if every and (step + 1) % every == 0 and self.checkpoint_dir:
                 ckpt.save(state)
+            if guard is not None and guard.requested() \
+                    and step + 1 < self.total_steps:
+                from ..core.runstate import PreemptionError
+
+                # drain commit: this step completed — persist it NOW (even
+                # off the checkpoint cadence) so the restart resumes at
+                # exactly step + 1 instead of re-training the window
+                if ckpt.latest_step() != int(state.step):
+                    ckpt.save(state)
+                ckpt.close()
+                telemetry.counter_inc("run.preemptions")
+                raise PreemptionError(step)
         jax.block_until_ready(state.params)
         dt = time.perf_counter() - t0
         tps = tokens_done / max(dt, 1e-9)
@@ -221,5 +242,6 @@ class CheetahRunner:
             result["mfu_estimate"] = round(mfu, 4)
         if self.checkpoint_dir:
             ckpt.save(state)
+            ckpt.close()  # release orbax worker threads with the run
         logger.info("cheetah: %s", result)
         return result

@@ -185,7 +185,35 @@ def cmd_top(args) -> int:
                   f"{misses:.0f} misses")
         _print_traffic_summary(metrics)
         _print_delta_summary(metrics)
+        _print_recovery_summary(metrics)
     return 0
+
+
+def _print_recovery_summary(metrics: dict) -> None:
+    """The survivable-serving-plane story (docs/robustness.md): a soak
+    that silently survived a server kill, a partition, or straggler
+    deadlines must be VISIBLE instead of indistinguishable from a clean
+    run. Silent when nothing recovery-shaped happened."""
+    counters = metrics.get("counters", {})
+    recoveries = counters.get("run.server_recoveries", 0)
+    resyncs = counters.get("comm.resyncs", 0)
+    reconnects = counters.get("comm.reconnects", 0)
+    misses = counters.get("comm.heartbeat_misses", 0)
+    partial = counters.get("traffic.partial_rounds", 0)
+    late = counters.get("traffic.late_folds", 0)
+    if not (recoveries or resyncs or reconnects or misses or partial
+            or late):
+        return
+    print("\nrecovery plane (failover / resync / deadlines):")
+    print(f"  server recoveries: {recoveries:.0f}   client resyncs: "
+          f"{resyncs:.0f} (replays "
+          f"{counters.get('comm.resync_replays', 0):.0f})")
+    print(f"  heartbeat misses: {misses:.0f}   reconnect attempts: "
+          f"{reconnects:.0f}")
+    if partial or late:
+        print(f"  partial rounds: {partial:.0f}   late folds: {late:.0f}"
+              f"   late superseded: "
+              f"{counters.get('traffic.late_superseded', 0):.0f}")
 
 
 def _print_delta_summary(metrics: dict) -> None:
@@ -698,9 +726,32 @@ def main(argv=None) -> int:
                          "REAL multiprocess gRPC clients (the reference "
                          "leg stays loopback — parity must hold across "
                          "transports)")
+    p_chaos.add_argument("--kill-phase", dest="kill_phase", default="",
+                         choices=("", "pre_fold", "mid_fold",
+                                  "post_commit"),
+                         help="crash-failover soak: SIGKILL the server "
+                         "process (no drain) at this protocol phase of "
+                         "--kill-round, restart it with --resume auto, and "
+                         "require bitwise parity with the fault-free run; "
+                         "with --transport grpc the client processes "
+                         "SURVIVE the kill and resync onto the restarted "
+                         "server (heartbeat miss -> c2s_resync -> replay)")
+    p_chaos.add_argument("--partition", default="",
+                         metavar="START:DURATION",
+                         help="cut the server off from every client for "
+                         "the window (seconds from world start, both "
+                         "directions visible-fail); the at-least-once "
+                         "layer must absorb it bitwise")
+    p_chaos.add_argument("--heartbeat_s", type=float, default=0.0,
+                         help="client heartbeat interval for the soak "
+                         "(0 = auto: on for kill legs, off otherwise)")
     # internal: run ONE chaos leg in this process (the orchestrator's child)
     p_chaos.add_argument("--worker", action="store_true",
                          help=argparse.SUPPRESS)
+    # internal: the crash-failover flow's server-only worker — the
+    # orchestrator owns the client processes so they survive the kill
+    p_chaos.add_argument("--server-only", dest="server_only",
+                         action="store_true", help=argparse.SUPPRESS)
     p_chaos.add_argument("--out", default="", help=argparse.SUPPRESS)
     p_chaos.add_argument("--checkpoint_dir", default="",
                          help=argparse.SUPPRESS)

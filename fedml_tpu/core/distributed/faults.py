@@ -31,7 +31,23 @@ and applies a ``FaultPlan``:
   corruption: a bit-flipped copy is delivered AND the send raises
   ``TransientSendError`` (the loopback analog of a receiver checksum NACK),
   so the retry layer re-delivers a clean copy while the receiver drops the
-  corrupt one.
+  corrupt one;
+- ``partition(ranks, start_s, duration_s)`` — a network partition: for the
+  window ``[start_s, start_s + duration_s)`` (measured from the wrapper's
+  construction) every message CROSSING the boundary between ``ranks`` and
+  the rest of the world fails with a VISIBLE
+  :class:`delivery.TransientSendError` in both directions — the
+  at-least-once layer backs off and re-delivers once the partition heals;
+- ``straggle(rank, seconds, round)`` — a straggling sender: every message
+  ``rank`` sends (optionally only for one round) is delivered ``seconds``
+  late, modelling a slow client whose round contribution misses the
+  cohort deadline (``--round_deadline_s`` folds it via the staleness
+  path — docs/robustness.md "Partial cohorts under deadline");
+- ``kill_server(phase, round)`` — arms the server-side kill switch: the
+  cross-silo server SIGKILLs its own process (no drain, no atexit — the
+  true crash) when its protocol reaches ``phase`` ∈ {``pre_fold``,
+  ``mid_fold``, ``post_commit``} of round ``round``. The chaos harness
+  restarts it with ``--resume auto`` and the surviving clients resync.
 
 Rules match on the Message header only (sender/receiver/round), never on
 payloads, so injection composes with compression/encryption layers.
@@ -40,8 +56,9 @@ payloads, so injection composes with compression/encryption layers.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -58,11 +75,19 @@ class FaultPlan:
     delays: List[dict] = field(default_factory=list)
     duplicates: List[dict] = field(default_factory=list)
     corrupts: List[dict] = field(default_factory=list)
+    partitions: List[dict] = field(default_factory=list)
     crash_rank: Optional[int] = None
     crash_after_sends: int = 0
     loss_p: float = 0.0
     loss_seed: int = 0
     loss_visible: bool = False
+    # server kill switch (consumed by cross_silo/server_manager.py, not by
+    # the transport wrapper): SIGKILL the server process at this protocol
+    # phase of this round
+    kill_phase: Optional[str] = None
+    kill_round: int = -1
+
+    KILL_PHASES = ("pre_fold", "mid_fold", "post_commit")
 
     def drop(self, sender: Optional[int] = None,
              receiver: Optional[int] = None,
@@ -113,6 +138,54 @@ class FaultPlan:
         )
         return self
 
+    def partition(self, ranks: Sequence[int], start_s: float = 0.0,
+                  duration_s: float = 1.0) -> "FaultPlan":
+        """Bidirectional visible loss between ``ranks`` and everyone else
+        for ``[start_s, start_s + duration_s)`` after wrapper construction.
+        Apply the SAME rule to every endpoint's plan — each side refuses
+        its own crossing sends, so the cut is symmetric."""
+        self.partitions.append(
+            {"ranks": frozenset(int(r) for r in ranks),
+             "start_s": float(start_s), "duration_s": float(duration_s)}
+        )
+        return self
+
+    def straggle(self, rank: int, seconds: float,
+                 round_idx: Optional[int] = None) -> "FaultPlan":
+        """Everything ``rank`` sends (optionally just for one round)
+        arrives ``seconds`` late — sugar over :meth:`delay` naming the
+        straggler scenario the deadline/late-fold plane is built for."""
+        return self.delay(seconds, sender=int(rank), round_idx=round_idx)
+
+    def kill_server(self, phase: str, round_idx: int = 0) -> "FaultPlan":
+        """Arm the server kill switch: SIGKILL at ``phase`` of
+        ``round_idx`` (pre_fold = the round's first update arrives;
+        mid_fold = cohort collected, nothing committed; post_commit =
+        checkpoint + ledger durable, broadcast not yet sent)."""
+        if phase not in self.KILL_PHASES:
+            raise ValueError(
+                f"kill_server phase must be one of {self.KILL_PHASES}, "
+                f"got {phase!r}"
+            )
+        self.kill_phase = str(phase)
+        self.kill_round = int(round_idx)
+        return self
+
+    def maybe_kill_server(self, phase: str, round_idx: int) -> None:
+        """SIGKILL this process if the switch is armed for (phase, round).
+        Called by the server manager at its protocol-phase hook points —
+        a true fail-stop: no drain, no checkpoint, no atexit."""
+        if self.kill_phase == phase and self.kill_round == int(round_idx):
+            import logging
+            import os
+            import signal
+
+            logging.getLogger(__name__).warning(
+                "fault injection: SIGKILL at %s of round %d", phase,
+                round_idx,
+            )
+            os.kill(os.getpid(), signal.SIGKILL)
+
 
 def _matches(rule: dict, msg: Message) -> bool:
     if rule.get("sender") is not None and msg.get_sender_id() != rule["sender"]:
@@ -148,8 +221,26 @@ class FaultyComm(BaseCommunicationManager):
         # pending delay timers (graftiso I005): cancelled on stop so an
         # injected link delay can never deliver into a torn-down node
         self._timers: List[threading.Timer] = []
+        # partition windows are measured from wrapper construction — every
+        # endpoint of a world is wrapped at startup, so the windows align
+        # to within process-start skew
+        self._t0 = time.monotonic()
 
     # -- fault logic --------------------------------------------------------
+
+    def _partitioned(self, msg: Message) -> bool:
+        """Whether an active partition separates sender and receiver."""
+        if not self.plan.partitions:
+            return False
+        now = time.monotonic() - self._t0
+        snd, rcv = msg.get_sender_id(), msg.get_receiver_id()
+        for rule in self.plan.partitions:
+            if not (rule["start_s"] <= now
+                    < rule["start_s"] + rule["duration_s"]):
+                continue
+            if (snd in rule["ranks"]) != (rcv in rule["ranks"]):
+                return True
+        return False
 
     def _send_verdict(self, msg: Message) -> str:
         """One of: deliver | drop | lose_visible."""
@@ -166,6 +257,10 @@ class FaultyComm(BaseCommunicationManager):
             if self.plan.loss_p > 0 and self._rng.rand() < self.plan.loss_p:
                 return ("lose_visible" if self.plan.loss_visible
                         else "drop")
+        if self._partitioned(msg):
+            # a refused write, not silence: the sender's at-least-once
+            # layer backs off and re-delivers after the partition heals
+            return "partitioned"
         if any(_matches(r, msg) for r in self.plan.drops):
             return "drop"
         return "deliver"
@@ -182,6 +277,16 @@ class FaultyComm(BaseCommunicationManager):
                     hit = True
         return hit
 
+    def kill(self) -> None:
+        """Externally declare this node dead (tests/harnesses): every
+        subsequent send vanishes and the receive loop goes dark — the
+        in-process analog of SIGKILLing the wrapped endpoint, usable at a
+        deterministic point (e.g. right after a ledger commit) instead of
+        an Nth-send trigger."""
+        with self._lock:
+            self._crashed = True
+        self.inner.stop_receive_message()
+
     # -- BaseCommunicationManager -------------------------------------------
 
     def send_message(self, msg: Message) -> None:
@@ -191,6 +296,11 @@ class FaultyComm(BaseCommunicationManager):
         if verdict == "lose_visible":
             raise TransientSendError(
                 f"injected loss: {msg.get_type()!r} "
+                f"{msg.get_sender_id()}->{msg.get_receiver_id()}"
+            )
+        if verdict == "partitioned":
+            raise TransientSendError(
+                f"injected partition: {msg.get_type()!r} "
                 f"{msg.get_sender_id()}->{msg.get_receiver_id()}"
             )
         delay_s = 0.0

@@ -304,6 +304,24 @@ class GRPCCommManager(BaseCommunicationManager):
             self._ensure_channel(receiver_id)
             return self._stream_stubs[receiver_id]
 
+    def _evict_channel(self, receiver_id: int) -> None:
+        """Drop the cached channel/stubs for a peer whose connection just
+        failed: the next ``send_message`` re-dials from scratch. A peer
+        process that died and was RESTARTED on the same port must never be
+        reached through the old process's connection state — eviction on
+        connection error is what makes a reconnecting client land cleanly
+        on the restarted server (docs/robustness.md)."""
+        with self._lock:
+            ch = self._channels.pop(receiver_id, None)
+            self._stubs.pop(receiver_id, None)
+            self._stream_stubs.pop(receiver_id, None)
+        if ch is not None:
+            telemetry.counter_inc("comm.grpc.channel_evictions")
+            try:
+                ch.close()
+            except Exception:  # noqa: BLE001 — already-broken channel
+                pass
+
     def send_message(self, msg: Message) -> None:
         msg.wire_format = self.wire_format
         payload = msg.serialize()
@@ -325,6 +343,13 @@ class GRPCCommManager(BaseCommunicationManager):
             return (isinstance(e, grpc.RpcError)
                     and code in TRANSIENT_STATUS_CODES)
 
+        def _on_retry(attempt: int, e: Exception) -> None:
+            telemetry.counter_inc("comm.grpc.send_retries")
+            # rebuild the connection between attempts: the peer may have
+            # been killed and restarted on the same port, and its old
+            # channel must not be retried into
+            self._evict_channel(msg.get_receiver_id())
+
         try:
             # exponential backoff + jitter under a bounded budget
             # (delivery.RetryPolicy) — replaces the old single-UNAVAILABLE
@@ -333,12 +358,13 @@ class GRPCCommManager(BaseCommunicationManager):
             self.retry_policy.call(
                 _once,
                 is_transient=_transient,
-                on_retry=lambda attempt, e: telemetry.counter_inc(
-                    "comm.grpc.send_retries"
-                ),
+                on_retry=_on_retry,
             )
         except grpc.RpcError:
             telemetry.counter_inc("comm.grpc.send_failures")
+            # evict here too: the NEXT send (a later round, a resync
+            # attempt) starts with a fresh dial instead of a dead channel
+            self._evict_channel(msg.get_receiver_id())
             raise
 
     def add_observer(self, observer: Observer) -> None:
@@ -375,6 +401,7 @@ class GRPCCommManager(BaseCommunicationManager):
                 ch.close()
             self._channels.clear()
             self._stubs.clear()
+            self._stream_stubs.clear()
 
     def _notify(self, msg: Message) -> None:
         with self._obs_lock:

@@ -174,7 +174,10 @@ class WorldScope:
         for t in threads:
             if t is me:
                 continue
-            t.join(timeout_s)
+            try:
+                t.join(timeout_s)
+            except RuntimeError:
+                pass  # registered but never started — nothing to drain
         with type(self)._scopes_lock:
             if type(self)._scopes.get((self.run_id, self.rank)) is self:
                 type(self)._scopes.pop((self.run_id, self.rank))

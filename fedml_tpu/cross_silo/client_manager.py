@@ -7,12 +7,22 @@ S2C_SYNC … → S2C_FINISH. The "hierarchical" DDP path
 by JAX intra-host data parallelism: a silo with multiple local chips trains
 its local shard under one jit with a batch-sharded mesh — no process groups
 to manage.
+
+Liveness / resync FSM (``--heartbeat_s``, docs/robustness.md "Server
+failover & resync"): RUNNING --(heartbeat-ack silence past the miss
+window, or a send failure)--> RESYNC --(bounded exponential ``c2s_resync``
+attempts)--> RUNNING on ``s2c_resync_ack``. The ack tells this client
+whether its last trained update was durably aggregated; if not, the cached
+stamped message is replayed verbatim — a restarted server (fresh dedup
+window) accepts it, a server that never died dedups it, so a crash can
+neither lose nor double-count a contribution.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Optional
 
 import jax
@@ -91,6 +101,24 @@ class ClientMasterManager(FedMLCommManager):
             and str(getattr(args, "async_dispatch", "sync_on_consume")
                     or "sync_on_consume").lower() == "client_pull"
         )
+        # -- liveness / resync FSM (docs/robustness.md) ---------------------
+        # heartbeat_s = 0 keeps the whole plane inert (the pre-failover
+        # wire behavior, bitwise). All FSM state is guarded by _fsm_lock:
+        # the comm thread (handlers) and the heartbeat/backoff timer
+        # threads both drive transitions.
+        self._hb_s = float(getattr(args, "heartbeat_s", 0.0) or 0.0)
+        self._hb_miss_limit = max(
+            int(getattr(args, "heartbeat_miss_limit", 3) or 3), 1)
+        self._resync_base_s = float(
+            getattr(args, "resync_backoff_s", 0.5) or 0.5)
+        self._resync_max_s = float(
+            getattr(args, "resync_backoff_max_s", 10.0) or 10.0)
+        self._resync_max_attempts = int(
+            getattr(args, "resync_max_attempts", 30) or 30)
+        self._fsm_lock = threading.Lock()
+        self._fsm_state = "running"   # running | resync | lost
+        self._resync_attempt = 0
+        self._last_server_traffic = time.monotonic()
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -108,9 +136,24 @@ class ClientMasterManager(FedMLCommManager):
         self.register_message_receive_handler(
             MyMessage.MSG_TYPE_S2C_SHED_NOTICE, self._on_shed
         )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_HEARTBEAT_ACK, self._on_heartbeat_ack
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_RESYNC_ACK, self._on_resync_ack
+        )
 
     def _on_connection_ready(self, msg: Message) -> None:
-        self._announce_online()
+        self._note_server_traffic()
+        try:
+            self._announce_online()
+        except Exception as e:  # noqa: BLE001 — classified below
+            if self._hb_s <= 0:
+                raise  # no liveness plane: keep the fail-fast behavior
+            # the server is not up (yet, or anymore): the resync loop is
+            # the announcement path — its handshake doubles as ONLINE
+            self._suspect_connection(f"online announce failed: {e}")
+        self._arm_heartbeat()
 
     def _announce_online(self) -> None:
         """The ONE ONLINE announcement (connection-ready AND the delta
@@ -120,6 +163,151 @@ class ClientMasterManager(FedMLCommManager):
         status.add(MyMessage.MSG_ARG_KEY_CLIENT_STATUS,
                    MyMessage.CLIENT_STATUS_ONLINE)
         self.send_message(status)
+
+    # -- liveness / resync FSM (docs/robustness.md) -------------------------
+
+    def _note_server_traffic(self) -> None:
+        """Any S2C message renews the server's lease (heartbeat acks are
+        just the guaranteed-minimum traffic)."""
+        with self._fsm_lock:
+            self._last_server_traffic = time.monotonic()
+
+    def _arm_heartbeat(self) -> None:
+        if self._hb_s <= 0 or self.done.is_set():
+            return
+        t = threading.Timer(self._hb_s, self._on_heartbeat_tick)
+        t.daemon = True
+        # tethered (graftiso I005): finish() -> world.shutdown() cancels
+        # the pending tick when the federation ends
+        self.world.register_timer(t)
+        t.start()
+
+    def _on_heartbeat_tick(self) -> None:
+        """One lease check: silence past the miss window enters RESYNC;
+        otherwise send a heartbeat. Re-arms itself until FINISH."""
+        if self.done.is_set():
+            return
+        enter_resync = False
+        with self._fsm_lock:
+            silence = time.monotonic() - self._last_server_traffic
+            running = self._fsm_state == "running"
+            if running and silence > self._hb_miss_limit * self._hb_s:
+                self._fsm_state = "resync"
+                self._resync_attempt = 0
+                enter_resync = True
+        if enter_resync:
+            self.world.telemetry.counter_inc("comm.heartbeat_misses")
+            logger.warning(
+                "client %d: no server traffic for %.2fs (> %d x %.2fs) — "
+                "entering resync", self.rank, silence,
+                self._hb_miss_limit, self._hb_s,
+            )
+            self._attempt_resync()
+        elif running:
+            hb = Message(MyMessage.MSG_TYPE_C2S_HEARTBEAT, self.rank, 0)
+            hb.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
+            try:
+                self.send_message(hb)
+            except Exception as e:  # noqa: BLE001 — any send failure
+                self._suspect_connection(f"heartbeat send failed: {e}")
+        self._arm_heartbeat()
+
+    def _suspect_connection(self, reason: str) -> None:
+        """A failed send (gRPC UNAVAILABLE past the retry budget, MQTT
+        drop) or heartbeat silence: RUNNING -> RESYNC. Idempotent — a
+        caller racing an already-resyncing FSM no-ops."""
+        if self._hb_s <= 0 or self.done.is_set():
+            return
+        with self._fsm_lock:
+            if self._fsm_state != "running":
+                return
+            self._fsm_state = "resync"
+            self._resync_attempt = 0
+        self.world.telemetry.counter_inc("comm.heartbeat_misses")
+        logger.warning("client %d: connection suspect (%s) — entering "
+                       "resync", self.rank, reason)
+        self._attempt_resync()
+
+    def _attempt_resync(self) -> None:
+        """One bounded-exponential reconnect attempt: send ``c2s_resync``
+        (fresh stamp each attempt — the server's ack is idempotent) and
+        re-arm the backoff timer until the ack flips the FSM back to
+        RUNNING or the attempt budget runs out."""
+        if self.done.is_set():
+            return
+        with self._fsm_lock:
+            if self._fsm_state != "resync":
+                return
+            self._resync_attempt += 1
+            attempt = self._resync_attempt
+        if attempt > self._resync_max_attempts:
+            with self._fsm_lock:
+                self._fsm_state = "lost"
+            logger.error(
+                "client %d: resync gave up after %d attempts — the server "
+                "never came back", self.rank, self._resync_max_attempts,
+            )
+            return
+        self.world.telemetry.counter_inc("comm.reconnects")
+        msg = Message(MyMessage.MSG_TYPE_C2S_RESYNC, self.rank, 0)
+        msg.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self._last_trained_round)
+        if self._s2c_delta_on:
+            # the resync doubles as a delta ACK: this client still holds
+            # the global it last trained from, so S2C deltas can resume
+            # against it without a full-frame round-trip
+            msg.add(MyMessage.MSG_ARG_KEY_DELTA_CAPABLE, 1)
+        try:
+            self.send_message(msg)
+        except Exception as e:  # noqa: BLE001 — server still down: back off
+            logger.info("client %d: resync attempt %d failed to send (%s)",
+                        self.rank, attempt, e)
+        delay = min(self._resync_base_s * (2.0 ** (attempt - 1)),
+                    self._resync_max_s)
+        t = threading.Timer(delay, self._attempt_resync)
+        t.daemon = True
+        self.world.register_timer(t)
+        t.start()
+
+    def _on_heartbeat_ack(self, msg: Message) -> None:
+        self._note_server_traffic()
+
+    def _on_resync_ack(self, msg: Message) -> None:
+        """The handshake's answer: back to RUNNING, and replay the cached
+        unACKed update iff the server's committed record does not cover it
+        — verbatim (same seq), so a server that never died dedups the
+        replay while a restarted one (fresh window) accepts it. Either
+        way the contribution is folded exactly once."""
+        self._note_server_traffic()
+        with self._fsm_lock:
+            was = self._fsm_state
+            self._fsm_state = "running"
+            self._resync_attempt = 0
+        committed = int(msg.get(MyMessage.MSG_ARG_KEY_COMMITTED_ROUND, -1))
+        cached = self._last_model_msg
+        try:
+            if (was != "running" and cached is not None
+                    and self._last_trained_round > committed):
+                self.world.telemetry.counter_inc("comm.resync_replays")
+                logger.info(
+                    "client %d: round-%d update not covered by the server "
+                    "(committed %d) — replaying the cached stamped message",
+                    self.rank, self._last_trained_round, committed,
+                )
+                self.send_message(cached)
+            if was != "running" and self._client_pull \
+                    and self._last_trained_round >= 0:
+                # client_pull dispatch: re-park our pull — a restarted
+                # server lost the parking, and a live one parks the
+                # fresh pull idempotently (it is a set)
+                pull = Message(MyMessage.MSG_TYPE_C2S_PULL_REQUEST,
+                               self.rank, 0)
+                pull.add(MyMessage.MSG_ARG_KEY_ROUND_IDX,
+                         self._last_trained_round)
+                if self._s2c_delta_on:
+                    pull.add(MyMessage.MSG_ARG_KEY_DELTA_CAPABLE, 1)
+                self.send_message(pull)
+        except Exception as e:  # noqa: BLE001
+            self._suspect_connection(f"resync replay failed: {e}")
 
     def _ensure_skeleton(self) -> None:
         if self._treedef is not None:
@@ -184,6 +372,7 @@ class ClientMasterManager(FedMLCommManager):
         return True
 
     def _on_init(self, msg: Message) -> None:
+        self._note_server_traffic()
         self.client_index = int(
             msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, self.client_index)
         )
@@ -196,6 +385,7 @@ class ClientMasterManager(FedMLCommManager):
         self._train_and_send()
 
     def _on_sync(self, msg: Message) -> None:
+        self._note_server_traffic()
         round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0))
         if self._replay_guard("SYNC", round_idx):
             return
@@ -233,6 +423,7 @@ class ClientMasterManager(FedMLCommManager):
         AFTER the server's dedup window recorded the original seq, so a
         verbatim re-send of the cached message would be dropped as a wire
         duplicate and the contribution lost for good."""
+        self._note_server_traffic()
         shed_round = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, -1))
         if shed_round != self._last_trained_round \
                 or self._last_model_msg is None:
@@ -265,6 +456,7 @@ class ClientMasterManager(FedMLCommManager):
         self.send_message(fresh)
 
     def _on_finish(self, msg: Message) -> None:
+        self._note_server_traffic()
         self._install_params(msg)
         logger.info("client %d: finished", self.rank)
         if self.silo_plane is not None:
@@ -325,16 +517,25 @@ class ClientMasterManager(FedMLCommManager):
             self.world.telemetry.counter_inc(
                 "comm.delta.c2s_bytes_saved", max(raw_nbytes - sent, 0))
         self._last_model_msg = msg
-        self.send_message(msg)
-        if self._client_pull:
-            # client_pull dispatch (docs/delivery.md): ask for the next
-            # version now — the server answers as soon as it bumps past
-            # the round we just trained
-            pull = Message(MyMessage.MSG_TYPE_C2S_PULL_REQUEST, self.rank, 0)
-            pull.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
-            if self._s2c_delta_on:
-                pull.add(MyMessage.MSG_ARG_KEY_DELTA_CAPABLE, 1)
-            self.send_message(pull)
+        try:
+            self.send_message(msg)
+            if self._client_pull:
+                # client_pull dispatch (docs/delivery.md): ask for the next
+                # version now — the server answers as soon as it bumps past
+                # the round we just trained
+                pull = Message(MyMessage.MSG_TYPE_C2S_PULL_REQUEST,
+                               self.rank, 0)
+                pull.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
+                if self._s2c_delta_on:
+                    pull.add(MyMessage.MSG_ARG_KEY_DELTA_CAPABLE, 1)
+                self.send_message(pull)
+        except Exception as e:  # noqa: BLE001 — classified below
+            if self._hb_s <= 0:
+                raise  # no liveness plane: keep the fail-fast behavior
+            # the update is CACHED (stamped) — the resync handshake will
+            # replay it once the server answers again, so a send into a
+            # dead/partitioned server costs a reconnect, not the round
+            self._suspect_connection(f"model send failed: {e}")
 
     def _train_hierarchical(self):
         """Silo-parallel round: broadcast to DCN slaves, train the master's

@@ -17,9 +17,26 @@ class MyMessage:
     # immediately when the head is already newer, else on the next bump
     MSG_TYPE_C2S_PULL_REQUEST = "c2s_pull_request"
 
+    # survivable serving plane (docs/robustness.md "Server failover &
+    # resync"): the client liveness/resync FSM. Heartbeats lease the
+    # server connection (a missed-ack window means the server is gone or
+    # partitioned away); c2s_resync is the idempotent reconnect
+    # handshake — it doubles as an ONLINE announcement on a restarted
+    # server, and its ack tells the client whether its last trained
+    # update was durably aggregated (COMMITTED_ROUND) so an unACKed
+    # update is replayed through the existing dedup window instead of
+    # being lost or double-counted.
+    MSG_TYPE_C2S_HEARTBEAT = "c2s_heartbeat"
+    MSG_TYPE_C2S_RESYNC = "c2s_resync"
+
     MSG_TYPE_S2C_INIT_CONFIG = "s2c_init_config"
     MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT = "s2c_sync_model_to_client"
     MSG_TYPE_S2C_FINISH = "s2c_finish"
+    # heartbeat lease renewal + the resync handshake's answer (carries the
+    # server's round/version head and the sender's last committed
+    # contribution round)
+    MSG_TYPE_S2C_HEARTBEAT_ACK = "s2c_heartbeat_ack"
+    MSG_TYPE_S2C_RESYNC_ACK = "s2c_resync_ack"
     # async traffic plane (aggregation_mode=async, docs/traffic.md):
     # admission control shed a C2S model — the explicit NACK carrying the
     # shed update's version and a retry_after_s the client backs off by
@@ -41,6 +58,11 @@ class MyMessage:
     # these keys ride the shed NACK
     MSG_ARG_KEY_RETRY_AFTER_S = "retry_after_s"
     MSG_ARG_KEY_SHED_REASON = "shed_reason"
+    # survivable serving plane: the resync ack's record of the sender's
+    # highest trained round whose contribution was durably aggregated —
+    # a client whose last trained round is newer replays its cached
+    # (still-stamped) update; one that is covered does not
+    MSG_ARG_KEY_COMMITTED_ROUND = "committed_round"
     # delta delivery plane: a C2S message sets this when its sender can
     # decode S2C delta frames (capability negotiation — swarm devices and
     # pre-delta clients never set it and keep receiving full frames). The

@@ -85,6 +85,34 @@ class FedMLServerManager(FedMLCommManager):
         self.round_timeout = float(getattr(args, "round_timeout", 0.0) or 0.0)
         self.min_clients = int(getattr(args, "min_clients_per_round", 1))
         self._round_timer: Optional[threading.Timer] = None
+        # graceful degradation under stragglers (docs/robustness.md
+        # "Partial cohorts under deadline"): --round_deadline_s closes a
+        # sync round with the K' <= K updates that arrived — reweighting
+        # exactly (weighted_average normalizes over PRESENT weights, so a
+        # full cohort stays bitwise-identical to plain FedAvg) — and a
+        # straggler's LATE update folds into the round in progress with
+        # the async staleness weight (1+s)^-alpha instead of being
+        # dropped. Unlike round_timeout, the deadline does NOT declare
+        # stragglers dead: they stay in the cohort, their late folds
+        # count toward the next round's quorum.
+        self.round_deadline_s = float(
+            getattr(args, "round_deadline_s", 0.0) or 0.0)
+        self._late_fold = self.round_deadline_s > 0
+        self.late_alpha = float(
+            getattr(args, "async_staleness_alpha", 0.0) or 0.0)
+        # the client round each pending model was trained at (== its
+        # message's round tag; differs from the aggregation round for
+        # late folds) — parallel to _models, guarded by self._lock
+        self._model_rounds: Dict[int, int] = {}
+        # per-client highest trained round whose contribution was
+        # aggregated (sync) or folded into a committed step (async) —
+        # what the resync ack reports so a reconnecting client knows
+        # whether to replay its last unACKed update. Rebuilt from the
+        # ledger on restart; guarded by self._lock.
+        self._committed_client_round: Dict[int, int] = {}
+        # chaos kill switch (core/distributed/faults.py kill_server):
+        # SIGKILL at a protocol phase — consulted via _maybe_kill
+        self._fault_plan = getattr(args, "fault_plan", None)
         self.global_params = (
             aggregator.get_model_params()
             if aggregator.get_model_params() is not None
@@ -183,81 +211,161 @@ class FedMLServerManager(FedMLCommManager):
             from ..core import runstate
 
             self._ckpt = CheckpointManager(ckpt_dir)
-            mode = runstate.resume_mode(args)
-            step = self._ckpt.latest_step()
-            if mode == "never" and step is not None:
-                raise RuntimeError(
-                    f"--resume never, but {ckpt_dir} already holds a "
-                    f"checkpoint (step {step}) — point at a fresh "
-                    "checkpoint_dir or use --resume auto"
-                )
-            if mode == "require" and step is None:
-                raise RuntimeError(
-                    f"--resume require, but {ckpt_dir} holds no checkpoint "
-                    "to resume from"
-                )
-            if step is not None:
-                restored = self._ckpt.restore_latest(
-                    {"global_params": self.global_params}
-                )
-                self.global_params = restored["global_params"]
-                self.aggregator.set_model_params(self.global_params)
-                self.round_idx = step + 1
-                self.world.telemetry.counter_inc("run.resumes")
-                logger.info(
-                    "server: resumed federation at round %d from %s",
-                    self.round_idx, ckpt_dir,
-                )
-            # identity pins engine + world size, NOT comm_round: restarting
-            # a finished federation with a larger round budget is the
-            # supported "extend the run" pattern
-            self._ledger = runstate.RunLedger.for_checkpoint_dir(ckpt_dir)
-            world = {
-                "engine": type(self).__name__,
-                "client_num": self.client_num,
-            }
-            if self.async_mode:
-                # buffer state is run identity: resuming an async ledger
-                # with a different mode/buffer/decay is a different
-                # federation — ensure_meta's world comparison rejects it.
-                # (sync ledgers stay byte-identical to the pre-traffic
-                # format, so old checkpoints keep resuming.)
-                world.update(
-                    aggregation_mode="async",
-                    buffer_size=self.async_cfg.buffer_size,
-                    staleness_alpha=self.async_cfg.staleness_alpha,
-                    max_staleness=self.async_cfg.max_staleness,
-                )
-                if self.async_dispatch != "sync_on_consume":
-                    # which clients re-enter training when decides who
-                    # trains what — dispatch policy is run identity too
-                    # (default omitted: pre-delta async ledgers keep
-                    # resuming)
-                    world["dispatch"] = self.async_dispatch
-            delivery_id = delivery_identity(args)
-            if delivery_id is not None:
-                # lossy C2S codec config, adapter filter and store depth
-                # all change what aggregation ever sees — resuming this
-                # ledger under a different delivery configuration is a
-                # different federation and is refused (plain worlds keep
-                # the pre-delta ledger format)
-                world["delivery"] = delivery_id
-            self._ledger.ensure_meta(
-                seed=int(getattr(args, "random_seed", 0)),
-                world=world,
-            )
-            # preemption-safe drain: SIGTERM/SIGINT latches; the in-flight
-            # round finishes aggregating, commits checkpoint + ledger, and
-            # the FSM stops instead of dispatching the next round
-            self._guard = runstate.preemption_guard()
-            if bool(getattr(args, "preempt_signals", True)):
-                self._guard.install()
-            self._guard.reset()
+            try:
+                self._init_resume(args, ckpt_dir, runstate)
+            except Exception:
+                # a refused resume (mode conflict, run_meta identity
+                # mismatch) must not leak the orbax manager's worker
+                # threads into the process
+                self._ckpt.close()
+                raise
         # seed the reference store with the version INIT dispatches (the
         # post-resume round index): the first C2S deltas decode against it
         if self._store_active:
             self.store.put(self.round_idx,
                            flatten_leaves(jax.tree.leaves(self.global_params)))
+
+    def _init_resume(self, args, ckpt_dir: str, runstate) -> None:
+        """The checkpointed-world half of __init__: resume-mode checks,
+        state restore, ledger identity, preemption guard, and — on an
+        actual restart — hot-state reconstruction."""
+        mode = runstate.resume_mode(args)
+        step = self._ckpt.latest_step()
+        if mode == "never" and step is not None:
+            raise RuntimeError(
+                f"--resume never, but {ckpt_dir} already holds a "
+                f"checkpoint (step {step}) — point at a fresh "
+                "checkpoint_dir or use --resume auto"
+            )
+        if mode == "require" and step is None:
+            raise RuntimeError(
+                f"--resume require, but {ckpt_dir} holds no checkpoint "
+                "to resume from"
+            )
+        if step is not None:
+            restored = self._ckpt.restore_latest(
+                {"global_params": self.global_params}
+            )
+            self.global_params = restored["global_params"]
+            self.aggregator.set_model_params(self.global_params)
+            self.round_idx = step + 1
+            self.world.telemetry.counter_inc("run.resumes")
+            self.world.telemetry.counter_inc("run.server_recoveries")
+            logger.info(
+                "server: resumed federation at round %d from %s",
+                self.round_idx, ckpt_dir,
+            )
+        # identity pins engine + world size, NOT comm_round: restarting
+        # a finished federation with a larger round budget is the
+        # supported "extend the run" pattern
+        self._ledger = runstate.RunLedger.for_checkpoint_dir(ckpt_dir)
+        world = {
+            "engine": type(self).__name__,
+            "client_num": self.client_num,
+        }
+        if self.async_mode:
+            # buffer state is run identity: resuming an async ledger
+            # with a different mode/buffer/decay is a different
+            # federation — ensure_meta's world comparison rejects it.
+            # (sync ledgers stay byte-identical to the pre-traffic
+            # format, so old checkpoints keep resuming.)
+            world.update(
+                aggregation_mode="async",
+                buffer_size=self.async_cfg.buffer_size,
+                staleness_alpha=self.async_cfg.staleness_alpha,
+                max_staleness=self.async_cfg.max_staleness,
+            )
+            if self.async_dispatch != "sync_on_consume":
+                # which clients re-enter training when decides who
+                # trains what — dispatch policy is run identity too
+                # (default omitted: pre-delta async ledgers keep
+                # resuming)
+                world["dispatch"] = self.async_dispatch
+        delivery_id = delivery_identity(args)
+        if delivery_id is not None:
+            # lossy C2S codec config, adapter filter and store depth
+            # all change what aggregation ever sees — resuming this
+            # ledger under a different delivery configuration is a
+            # different federation and is refused (plain worlds keep
+            # the pre-delta ledger format)
+            world["delivery"] = delivery_id
+        self._ledger.ensure_meta(
+            seed=int(getattr(args, "random_seed", 0)),
+            world=world,
+        )
+        # preemption-safe drain: SIGTERM/SIGINT latches; the in-flight
+        # round finishes aggregating, commits checkpoint + ledger, and
+        # the FSM stops instead of dispatching the next round
+        self._guard = runstate.preemption_guard()
+        if bool(getattr(args, "preempt_signals", True)):
+            self._guard.install()
+        self._guard.reset()
+        if step is not None:
+            # crash-failover (docs/robustness.md "Server failover &
+            # resync"): a restarted server reconstructs its hot
+            # serving state from durable substrate alone — the
+            # version-store ring from the retained Orbax steps and
+            # the per-client committed-contribution map from the run
+            # ledger. The async fold buffer restarts EMPTY but
+            # consistent: its in-flight (uncommitted) contributions
+            # are re-solicited through the resync handshake, never
+            # silently dropped.
+            self._recover_serving_state()
+
+    def _recover_serving_state(self) -> None:
+        """Rebuild the restart-survivable half of the hot serving state
+        from durable substrate (crash-failover, docs/robustness.md).
+
+        - **Version-store ring**: re-derived from the retained Orbax
+          checkpoint steps (version = step + 1 — the version that round's
+          commit dispatched). Only versions still inside the ring's
+          capacity window are restored, so a version the pre-kill store
+          had already evicted stays evicted — a stale delta against it
+          gets the same loud fallback either side of the crash.
+        - **Committed-contribution map**: replayed from the ledger's
+          round entries. A sync round's contributions were trained AT
+          that round unless the entry recorded explicit
+          ``client_versions`` (late folds and async steps do). The
+          resync ack reports this map, which is what lets a client
+          decide replay-vs-rejoin without guessing.
+        """
+        if self._ledger is not None:
+            for e in self._ledger.rounds():
+                cohort = [int(c) for c in (e.get("cohort") or [])]
+                versions = [
+                    int(v) for v in (e.get("client_versions")
+                                     or [e["round"]] * len(cohort))
+                ]
+                for sender, cv in zip(cohort, versions):
+                    if cv > self._committed_client_round.get(sender, -1):
+                        self._committed_client_round[sender] = cv
+        if self._store_active:
+            head = self.round_idx  # the version the resumed INIT ships
+            floor = head - self.store.capacity
+            rebuilt = 0
+            for s in self._ckpt.steps():
+                version = s + 1
+                if version <= floor or version >= head:
+                    continue  # evicted / the head (seeded from the
+                    # restored global right after this method)
+                restored = self._ckpt.restore(
+                    s, {"global_params": self.global_params})
+                self.store.put(version, flatten_leaves(
+                    jax.tree.leaves(restored["global_params"])))
+                rebuilt += 1
+            self.world.telemetry.counter_inc(
+                "comm.delta.server_store.rebuilt_versions", rebuilt)
+            logger.info(
+                "server: rebuilt %d version-store entries from the "
+                "checkpoint retention window (head version %d)",
+                rebuilt, head,
+            )
+
+    def _maybe_kill(self, phase: str, round_idx: int) -> None:
+        """Chaos kill switch (faults.FaultPlan.kill_server): SIGKILL this
+        process at a protocol phase — the crash-failover soak's trigger."""
+        if self._fault_plan is not None:
+            self._fault_plan.maybe_kill_server(phase, round_idx)
 
     # -- FSM ----------------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -266,6 +374,12 @@ class FedMLServerManager(FedMLCommManager):
         )
         self.register_message_receive_handler(
             MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self._on_client_status
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_HEARTBEAT, self._on_heartbeat
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_RESYNC, self._on_resync
         )
         if self.async_mode:
             self.register_message_receive_handler(
@@ -311,19 +425,30 @@ class FedMLServerManager(FedMLCommManager):
                 )
                 finish = not self.async_mode and self._round_complete_locked()
                 finish_round = self.round_idx
-            # init barrier counts the dead as resolved — a client that died
-            # during startup must not stall the federation forever
-            ready = (
-                len(self._online) + len(self._dead) >= self.client_num
-                and len(self._online) > 0
-                and not self._init_sent
-            )
+            ready = self._barrier_ready_locked()
             if ready:
                 self._init_sent = True
-        if ready and self.round_idx >= self.round_num:
-            # a RESTART of an already-completed federation (resumed
-            # round_idx == comm_round): do not train an extra round past
-            # the budget — deliver the final model and finish
+        if ready:
+            self._post_barrier()
+        elif finish:
+            self._finish_round(finish_round)
+
+    def _barrier_ready_locked(self) -> bool:
+        """Caller holds the lock. The init barrier counts the dead as
+        resolved — a client that died during startup must not stall the
+        federation forever."""
+        return (
+            len(self._online) + len(self._dead) >= self.client_num
+            and len(self._online) > 0
+            and not self._init_sent
+        )
+
+    def _post_barrier(self) -> None:
+        """The init barrier just completed (this caller flipped
+        ``_init_sent``): start the federation — or, on a RESTART of an
+        already-completed one (resumed round_idx == comm_round), do not
+        train past the budget: deliver the final model and finish."""
+        if self.round_idx >= self.round_num:
             self._broadcast_finish(
                 "server: federation already complete after %d rounds")
             if self.ds is not None and self.final_metrics is None:
@@ -331,10 +456,89 @@ class FedMLServerManager(FedMLCommManager):
                     self.global_params, self.ds.test_x, self.ds.test_y
                 )
             self._close_and_finish()
-        elif ready:
+        else:
             self._send_init_msg()
-        elif finish:
-            self._finish_round(finish_round)
+
+    # -- liveness / resync (docs/robustness.md "Server failover & resync") --
+
+    def _on_heartbeat(self, msg: Message) -> None:
+        """Heartbeat lease: a heartbeat from a KNOWN client proves it
+        lives; the ack renews the sender's lease on US (a missed-ack
+        window is how the client detects a dead or partitioned-away
+        server). A heartbeat from a client this server has no session
+        with — a RESTARTED server draining the dead process's mailbox —
+        is deliberately left unanswered: silence is what lease-trips that
+        client into the resync handshake that (re)introduces it. Acking
+        it would wedge the federation — a leased client never resyncs,
+        and the restarted server's init barrier never completes."""
+        if self.done.is_set():
+            return
+        sender = msg.get_sender_id()
+        with self._lock:
+            # NB: a heartbeat does NOT clear a _dead mark — reviving a
+            # client whose dispatch failed without re-delivering what it
+            # missed would grow the quorum back while the client still
+            # waits for a model, wedging the round. Revival stays where
+            # re-delivery (or fresh work) actually happens: a model
+            # arrival or a resync.
+            known = sender in self._online
+            head = self.round_idx
+        if not known:
+            self.world.telemetry.counter_inc("comm.heartbeat_unknown")
+            return
+        ack = Message(MyMessage.MSG_TYPE_S2C_HEARTBEAT_ACK, self.rank,
+                      sender)
+        ack.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, head)
+        self._send_or_mark_dead(sender, ack)
+
+    def _on_resync(self, msg: Message) -> None:
+        """Idempotent reconnect handshake. A resync counts as an ONLINE
+        announcement (a restarted server's init barrier accepts it), but
+        — unlike ONLINE — does NOT clear the sender's delta ACK: a
+        resyncing client kept its version store; only a restarted client
+        (fresh ONLINE) lost it. The ack carries the server's head round
+        and the sender's last durably-aggregated contribution round, so
+        the client replays its cached unACKed update exactly when it is
+        NOT covered — through the existing dedup window, which makes the
+        replay safe against a server that never actually died."""
+        sender = msg.get_sender_id()
+        self.world.telemetry.counter_inc("comm.resyncs")
+        # a delta-capable resync re-ACKs the version its sender still
+        # holds — S2C deltas resume against it without a full-frame trip
+        self._record_ack(msg)
+        if self.done.is_set():
+            # the federation finished while this client was away: deliver
+            # the final model so it terminates too (idempotent — FINISH
+            # handling tolerates repeats)
+            m = Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, sender)
+            m.set_arrays(
+                [np.asarray(l) for l in jax.tree.leaves(self.global_params)])
+            self._send_or_mark_dead(sender, m)
+            return
+        with self._lock:
+            self._online.add(sender)
+            self._dead.discard(sender)
+            self._offline_declared.discard(sender)
+            # a parked client_pull survives the resync (unlike ONLINE,
+            # which drops it — a restarted client re-pulls after INIT):
+            # the reconnecting client is still waiting for the version
+            # bump it asked for, and it also re-issues the pull on the
+            # ack in case THIS server is a restart that lost the parking
+            committed = self._committed_client_round.get(sender, -1)
+            head = self.round_idx
+            ready = self._barrier_ready_locked()
+            if ready:
+                self._init_sent = True
+        logger.info(
+            "server: client %d resynced (head round %d, committed-for-it "
+            "%d)", sender, head, committed,
+        )
+        ack = Message(MyMessage.MSG_TYPE_S2C_RESYNC_ACK, self.rank, sender)
+        ack.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, head)
+        ack.add(MyMessage.MSG_ARG_KEY_COMMITTED_ROUND, committed)
+        self._send_or_mark_dead(sender, ack)
+        if ready:
+            self._post_barrier()
 
     def _round_complete_locked(self) -> bool:
         """Caller holds the lock. True when every still-live client of the
@@ -347,21 +551,26 @@ class FedMLServerManager(FedMLCommManager):
         return live_models >= max(expected, self.min_clients) > 0
 
     def _arm_round_timer(self) -> None:
-        if self.round_timeout <= 0 or self.async_mode:
+        # --round_deadline_s (partial cohorts, stragglers fold late) wins
+        # over the legacy round_timeout (stragglers dropped dead)
+        deadline = self.round_deadline_s or self.round_timeout
+        if deadline <= 0 or self.async_mode:
             return  # async mode has no cohort barrier to deadline
         if self._round_timer is not None:
             self._round_timer.cancel()
         self._round_timer = threading.Timer(
-            self.round_timeout, self._on_round_timeout, args=(self.round_idx,)
+            deadline, self._on_round_timeout, args=(self.round_idx,)
         )
         self._round_timer.daemon = True
         self.world.register_timer(self._round_timer)
         self._round_timer.start()
 
     def _on_round_timeout(self, round_idx: int) -> None:
-        """Cohort deadline: aggregate the subset that answered; clients that
-        missed the deadline are marked dead (they rejoin by re-sending
-        ONLINE status)."""
+        """Cohort deadline fired: aggregate the K' <= K updates that
+        arrived. Under ``--round_deadline_s`` the stragglers stay LIVE
+        cohort members — their late updates fold into the next open round
+        through the staleness path; under the legacy ``round_timeout``
+        they are marked dead (they rejoin by re-sending ONLINE status)."""
         if self.done.is_set():
             # a callback that already started when _close_and_finish
             # cancelled the timer: it must not re-arm into (or aggregate
@@ -382,8 +591,18 @@ class FedMLServerManager(FedMLCommManager):
             missing = (
                 set(range(1, self.size)) - set(self._models) - self._dead
             )
-            self._dead.update(missing)
-        if missing:
+            if not self._late_fold:
+                self._dead.update(missing)
+        if missing and self._late_fold:
+            self.world.telemetry.counter_inc("traffic.partial_rounds")
+            logger.warning(
+                "server round %d: deadline (%.3fs) passed; closing a "
+                "PARTIAL cohort of %d/%d — stragglers %s stay live, their "
+                "late updates fold via the staleness path",
+                round_idx, self.round_deadline_s, len(self._models),
+                self.client_num, sorted(missing),
+            )
+        elif missing:
             logger.warning(
                 "server round %d: deadline passed; dropping %s and "
                 "aggregating %d/%d models",
@@ -405,15 +624,22 @@ class FedMLServerManager(FedMLCommManager):
 
     def _on_model_received(self, msg: Message) -> None:
         sender = msg.get_sender_id()
-        if int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)) != self.round_idx:
+        msg_round = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX,
+                                self.round_idx))
+        with self._lock:
+            head = self.round_idx
+        if msg_round != head and not (self._late_fold and msg_round < head):
+            # without a deadline plane, a stale-round model is dropped (the
+            # pre-deadline semantics, bitwise-pinned); with one, the late
+            # update folds through the staleness path below
             logger.warning(
                 "server: stale round model from client %d ignored", sender
             )
             return
+        self._maybe_kill("pre_fold", msg_round)
         from ..core.compression import UpdateCodec
 
         self._record_ack(msg)
-        msg_round = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx))
         params = self._reconstruct_update(
             sender, msg_round, msg.get_arrays(),
             msg.get(UpdateCodec.META_KEY), msg.get(FILTER_KEY),
@@ -432,17 +658,60 @@ class FedMLServerManager(FedMLCommManager):
                 self._finish_round(msg_round)
             return
         n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0))
+        late = False
+        staleness = 0
         with self._lock:
-            if msg_round != self.round_idx:
-                return  # round closed between the unlocked check and here
-            self._models[sender] = (n, params)
+            staleness = self.round_idx - msg_round
+            if staleness < 0:
+                return  # a round tag from the future: corrupt header
+            if staleness > 0:
+                if not self._late_fold:
+                    return  # round closed between the unlocked check & here
+                # Partial-cohort plane (docs/robustness.md): the straggler
+                # missed its round's deadline — fold the update into the
+                # round IN PROGRESS with the async staleness decay
+                # (exactly the FedBuff treatment of a stale arrival),
+                # unless this client already contributed something at
+                # least as fresh to the open round.
+                if (sender in self._models
+                        and self._model_rounds.get(sender, -1) >= msg_round):
+                    self.world.telemetry.counter_inc(
+                        "traffic.late_superseded")
+                    return
+                from ..traffic.async_aggregator import staleness_weight
+
+                late = True
+                weight = n * staleness_weight(staleness, self.late_alpha)
+            else:
+                weight = n
+                if (self._late_fold and sender in self._models
+                        and self._model_rounds.get(sender, msg_round)
+                        < msg_round):
+                    # this client's own FRESH update replaces its pending
+                    # late fold in the open round — the older contribution
+                    # is consumed, and counted, exactly like the mirror
+                    # direction (late arriving after fresh):
+                    # late_folds − late_superseded = late folds that
+                    # actually aggregated
+                    self.world.telemetry.counter_inc(
+                        "traffic.late_superseded")
+            self._models[sender] = (weight, params)
+            self._model_rounds[sender] = msg_round
             # a model from a previously-dropped client revives it — one
             # missed deadline must not exclude a live client forever
             self._dead.discard(sender)
             self._offline_declared.discard(sender)
             have_all = self._round_complete_locked()
+            fold_round = self.round_idx
+        if late:
+            self.world.telemetry.counter_inc("traffic.late_folds")
+            logger.info(
+                "server: late round-%d update from client %d folded into "
+                "round %d (staleness %d)", msg_round, sender, fold_round,
+                staleness,
+            )
         if have_all:
-            self._finish_round(msg_round)
+            self._finish_round(fold_round)
 
     # -- delta delivery plane: C2S decode (fedml_tpu/delivery/) -------------
 
@@ -611,7 +880,14 @@ class FedMLServerManager(FedMLCommManager):
                 self._round_timer = None
             senders = sorted(self._models)
             raw = [self._models[r] for r in senders]
+            # the round each aggregated update was actually trained at
+            # (== the round for on-time updates; older for late folds) —
+            # what the resync ack reports and what a restarted server
+            # rebuilds from the ledger's client_versions
+            trained_at = [self._model_rounds.get(s, self.round_idx)
+                          for s in senders]
             self._models.clear()
+            self._model_rounds.clear()
             # close the round window NOW: any round-r straggler arriving
             # while the (slow) aggregation below runs must be rejected by
             # the stale-round check, not counted toward round r+1
@@ -624,9 +900,21 @@ class FedMLServerManager(FedMLCommManager):
             per_round = self.contrib_counts.setdefault(round_r, {})
             for s in senders:
                 per_round[s] = per_round.get(s, 0) + 1
+            for s, tr in zip(senders, trained_at):
+                if tr > self._committed_client_round.get(s, -1):
+                    self._committed_client_round[s] = tr
+        self._maybe_kill("mid_fold", round_r)
         agg = self._aggregate_models(raw, senders, round_r)
+        ledger_extra = {}
+        if any(tr != round_r for tr in trained_at):
+            # late folds: record the trained-at rounds so a restarted
+            # server rebuilds the committed-contribution map exactly
+            # (plain full-cohort rounds keep the pre-deadline format)
+            ledger_extra["client_versions"] = trained_at
         preempt = self._commit_and_eval(round_r, agg, senders,
-                                        log_label="server round")
+                                        log_label="server round",
+                                        **ledger_extra)
+        self._maybe_kill("post_commit", round_r)
         if preempt and self.round_idx < self.round_num:
             self._preempt_exit(round_r)
             return
@@ -856,6 +1144,7 @@ class FedMLServerManager(FedMLCommManager):
         only the reference global is version-correct."""
         t_enq, sender, client_version, n, arrays, codec_meta, \
             filter_meta = item
+        self._maybe_kill("pre_fold", self.round_idx)
         params = self._reconstruct_update(
             sender, client_version, arrays, codec_meta, filter_meta)
         if params is None:
@@ -899,12 +1188,21 @@ class FedMLServerManager(FedMLCommManager):
             per_round = self.contrib_counts.setdefault(round_r, {})
             for e in entries:
                 per_round[e.sender] = per_round.get(e.sender, 0) + 1
+                # what the resync ack reports: the client's last trained
+                # version whose update entered a server step
+                if e.client_version > self._committed_client_round.get(
+                        e.sender, -1):
+                    self._committed_client_round[e.sender] = \
+                        e.client_version
+        self._maybe_kill("mid_fold", round_r)
         agg = self._aggregate_models(raw, senders, round_r)
         self.world.telemetry.counter_inc("traffic.server_steps")
         preempt = self._commit_and_eval(
             round_r, agg, senders, log_label="server step",
             mode="async", staleness=[e.staleness for e in entries],
+            client_versions=[e.client_version for e in entries],
         )
+        self._maybe_kill("post_commit", round_r)
         self.world.telemetry.observe("traffic.step_s",
                                      time.monotonic() - t0)
         if preempt and self.round_idx < self.round_num:

@@ -763,23 +763,31 @@ class FedAvgAPI:
             from ..checkpoint import CheckpointManager
 
             ckpt = CheckpointManager(ckpt_dir)
-            if mode == "never" and ckpt.latest_step() is not None:
-                raise RuntimeError(
-                    f"--resume never, but {ckpt_dir} already holds a "
-                    f"checkpoint (step {ckpt.latest_step()}) — point at a "
-                    "fresh checkpoint_dir or use --resume auto"
+            try:
+                if mode == "never" and ckpt.latest_step() is not None:
+                    raise RuntimeError(
+                        f"--resume never, but {ckpt_dir} already holds a "
+                        f"checkpoint (step {ckpt.latest_step()}) — point at "
+                        "a fresh checkpoint_dir or use --resume auto"
+                    )
+                if mode == "require" and ckpt.latest_step() is None:
+                    raise RuntimeError(
+                        f"--resume require, but {ckpt_dir} holds no "
+                        "checkpoint to resume from"
+                    )
+                start_round = self._maybe_resume(ckpt)
+                ledger = runstate.RunLedger.for_checkpoint_dir(ckpt_dir)
+                ledger.ensure_meta(
+                    seed=int(getattr(self.args, "random_seed", 0)),
+                    world=self._ledger_world(),
                 )
-            if mode == "require" and ckpt.latest_step() is None:
-                raise RuntimeError(
-                    f"--resume require, but {ckpt_dir} holds no checkpoint "
-                    "to resume from"
-                )
-            start_round = self._maybe_resume(ckpt)
-            ledger = runstate.RunLedger.for_checkpoint_dir(ckpt_dir)
-            ledger.ensure_meta(
-                seed=int(getattr(self.args, "random_seed", 0)),
-                world=self._ledger_world(),
-            )
+            except Exception:
+                # a refused resume (mode conflict, world-identity mismatch)
+                # must not leak the orbax manager's worker threads — a
+                # lingering executor racing a later jax trace is a
+                # process-killing segfault on CPU hosts
+                ckpt.close()
+                raise
             last_committed = ledger.last_round()
             if last_committed is not None \
                     and last_committed != start_round - 1:
@@ -904,6 +912,16 @@ class FedAvgAPI:
         k = self._superround_k
         if k <= 1 or r + k > rounds:
             return 1
+        if every:
+            from ..core import runstate
+
+            if runstate.preemption_guard().requested():
+                # step-granular drain: SIGTERM already latched — never
+                # launch another K-round scan program (it cannot be
+                # interrupted mid-scan); single rounds bound the drain
+                # latency to ONE round, and the train loop's guard check
+                # commits + exits right after it
+                return 1
         if not self._fusion_ready:
             self._setup_round_fusion()
         if self._superround_step is None:

@@ -637,6 +637,13 @@ def swarm_soak(a) -> Dict:
         "stale_dropped_updates": counters.get(
             "traffic.stale_dropped_updates", 0.0),
         "server_steps": counters.get("traffic.server_steps", 0.0),
+        # recovery plane (docs/robustness.md): a soak that silently
+        # survived a server restart / client resyncs / deadline rounds
+        # must be visible in the report, not indistinguishable from a
+        # clean run
+        "server_recoveries": counters.get("run.server_recoveries", 0.0),
+        "resyncs": counters.get("comm.resyncs", 0.0),
+        "partial_rounds": counters.get("traffic.partial_rounds", 0.0),
         # device-side stats live in the device processes under grpc, not
         # this registry — report None there instead of a misleading 0
         "swarm_dropouts": (None if grpc_mode

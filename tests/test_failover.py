@@ -1,0 +1,586 @@
+"""Survivable serving plane (ISSUE 12): server crash-failover, client
+resync FSM, deadline-based partial cohorts, and the reconstruction of the
+server's hot state from durable substrate.
+
+Four layers:
+
+- deadline plane: ``--round_deadline_s`` partial cohorts are bitwise-equal
+  to full-cohort FedAvg when nobody straggles, and a seeded straggler run
+  converges with partial rounds > 0 and zero dropped contributions (late
+  arrivals fold via the staleness path);
+- in-process crash-failover: a server transport killed at a deterministic
+  point (FaultyComm.kill right after a ledger commit), a second server
+  manager resumed on the same world, heartbeat-driven client resync with
+  cached-update replay — bitwise parity with an uninterrupted run;
+- reconstruction units: version-store ring rebuilt from the checkpoint
+  retention window (digests equal, evicted boundaries honored), re-solicited
+  updates folding with the same staleness weights, run_meta identity
+  refusal;
+- subprocess SIGKILL matrix: ``kill_server`` at each protocol phase
+  (pre_fold / mid_fold / post_commit), restart with ``--resume auto``,
+  bitwise parity + exactly one ledger entry per committed round.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import fedml_tpu as fedml
+from fedml_tpu import chaos
+from fedml_tpu import data as data_mod
+from fedml_tpu import models as model_mod
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.core.distributed.faults import FaultPlan
+from fedml_tpu.core.mlops import telemetry
+from fedml_tpu.core.runstate import RunLedger
+from fedml_tpu.cross_silo import FedMLCrossSiloClient, FedMLCrossSiloServer
+
+HB = dict(heartbeat_s=0.2, heartbeat_miss_limit=2, resync_backoff_s=0.2,
+          resync_backoff_max_s=1.0, resync_max_attempts=60)
+
+
+def make_args(run_id, **kw):
+    base = dict(
+        training_type="cross_silo", dataset="synthetic", model="lr",
+        client_num_in_total=2, client_num_per_round=2, comm_round=3,
+        epochs=1, batch_size=8, learning_rate=0.2, backend="LOOPBACK",
+        run_id=run_id, frequency_of_the_test=1000, random_seed=7,
+    )
+    base.update(kw)
+    return fedml.init(Arguments(overrides=base), should_init_logs=False)
+
+
+def run_world(run_id, n_clients=2, fault_plans=None, server_plan=None,
+              **kw):
+    args_s = make_args(run_id, role="server",
+                       client_num_in_total=n_clients, **kw)
+    if server_plan is not None:
+        args_s.fault_plan = server_plan
+    ds, od = data_mod.load(args_s)
+    bundle = model_mod.create(args_s, od)
+    server = FedMLCrossSiloServer(args_s, None, ds, bundle)
+    clients = []
+    for rank in range(1, n_clients + 1):
+        args_c = make_args(run_id, role="client", rank=rank,
+                           client_num_in_total=n_clients, **kw)
+        if fault_plans and rank in fault_plans:
+            args_c.fault_plan = fault_plans[rank]
+        clients.append(FedMLCrossSiloClient(args_c, None, ds, bundle))
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    server.run()
+    for t in threads:
+        t.join(timeout=30)
+    return server, clients
+
+
+def _leaves(manager):
+    import jax
+
+    return [np.asarray(l) for l in jax.tree.leaves(manager.global_params)]
+
+
+class TestPartialCohortDeadline:
+    def test_deadline_unfired_is_bitwise_identical(self):
+        """--round_deadline_s with nobody straggling: the deadline never
+        fires and the run is BITWISE the plain full-cohort FedAvg run."""
+        ref, _ = run_world("dl-ref")
+        dl, _ = run_world("dl-on", round_deadline_s=30.0)
+        for a, b in zip(_leaves(ref.manager), _leaves(dl.manager)):
+            assert a.dtype == b.dtype and np.array_equal(a, b), \
+                "an unfired deadline changed the numerics"
+
+    def test_straggler_partial_rounds_and_late_folds(self, tmp_path):
+        """A persistent straggler under --round_deadline_s: rounds close
+        partially on the deadline, the straggler's late updates fold into
+        the open round via the staleness path (never dropped), and the
+        federation converges with every contribution counted exactly
+        once."""
+        reg = telemetry.registry()
+        partial0 = reg.counter("traffic.partial_rounds")
+        late0 = reg.counter("traffic.late_folds")
+        plans = {1: FaultPlan().straggle(1, 1.0)}  # every send 1s late
+        server, clients = run_world(
+            "dl-straggle", fault_plans=plans, comm_round=4,
+            round_deadline_s=0.6, min_clients_per_round=1,
+            async_staleness_alpha=0.5,
+            checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_rounds=1,
+        )
+        assert server.manager.round_idx == 4
+        assert reg.counter("traffic.partial_rounds") > partial0
+        assert reg.counter("traffic.late_folds") > late0
+        # exactly-once: no contribution ever aggregated twice, and from
+        # round 1 on every round folds BOTH clients (one fresh, one late)
+        led = RunLedger.for_checkpoint_dir(str(tmp_path / "ckpt"))
+        rounds = led.rounds()
+        assert sorted(e["round"] for e in rounds) == [0, 1, 2, 3]
+        for e in rounds:
+            for client, count in (e.get("contrib") or {}).items():
+                assert count == 1, (e["round"], client, count)
+        # the straggler's work is not thrown away: its late updates fold
+        # into later rounds (how many rounds close partially vs full is
+        # host-timing dependent — the counters above pin that both partial
+        # closes and late folds actually happened)
+        assert any(1 in (e.get("cohort") or []) for e in rounds
+                   if e["round"] >= 1), \
+            "no straggler contribution ever folded after round 0"
+        # a late-folding round records the trained-at rounds so a
+        # restarted server rebuilds its committed-contribution map
+        late_rounds = [e for e in rounds if e.get("client_versions")]
+        assert late_rounds, "no round recorded client_versions"
+        for e in late_rounds:
+            assert len(e["client_versions"]) == len(e["cohort"])
+            assert min(e["client_versions"]) < e["round"]
+        # zero dropped contributions: every trained round of the straggler
+        # short of the final one appears exactly once across the ledger
+        straggler_versions = sorted(
+            v for e in rounds
+            for s, v in zip(e["cohort"],
+                            e.get("client_versions")
+                            or [e["round"]] * len(e["cohort"]))
+            if s == 1
+        )
+        assert straggler_versions == sorted(set(straggler_versions)), \
+            "a straggler update folded twice"
+        assert straggler_versions[0] == 0
+
+    def test_deadline_below_min_clients_keeps_waiting(self):
+        """A deadline with fewer than min_clients models re-arms instead
+        of closing an empty round."""
+        plans = {1: FaultPlan().straggle(1, 0.8),
+                 2: FaultPlan().straggle(2, 0.8)}
+        server, _ = run_world(
+            "dl-wait", fault_plans=plans, comm_round=2,
+            round_deadline_s=0.3, min_clients_per_round=1,
+        )
+        assert server.manager.round_idx == 2  # completed, never wedged
+
+
+class _Killable:
+    """Find the server's FaultyComm wrapper so a test can declare it dead
+    at a deterministic protocol point."""
+
+    @staticmethod
+    def kill(server):
+        comm = server.manager.com_manager
+        assert hasattr(comm, "kill"), "server transport is not FaultyComm"
+        comm.kill()
+
+
+class TestServerCrashFailover:
+    def _run_crash_world(self, tmp_path, kill_after_round):
+        """Run a heartbeat world, kill the server's transport right after
+        the ledger commits ``kill_after_round`` (fail-stop: its queue goes
+        dark), resume a second server manager on the same world, and
+        return (server_b, clients)."""
+        ck = str(tmp_path / "ckpt")
+        run_id = f"crash-{kill_after_round}-{os.getpid()}"
+        args_s = make_args(run_id, role="server", checkpoint_dir=ck,
+                           checkpoint_rounds=1, **HB)
+        args_s.fault_plan = FaultPlan()  # wrap only: external kill()
+        ds, od = data_mod.load(args_s)
+        bundle = model_mod.create(args_s, od)
+        server_a = FedMLCrossSiloServer(args_s, None, ds, bundle)
+        clients = [
+            FedMLCrossSiloClient(
+                make_args(run_id, role="client", rank=r, **HB),
+                None, ds, bundle)
+            for r in (1, 2)
+        ]
+        threads = [threading.Thread(target=c.run, daemon=True)
+                   for c in clients]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        ta = threading.Thread(target=server_a.manager.run, daemon=True)
+        ta.start()
+        led = RunLedger.for_checkpoint_dir(ck)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            last = led.last_round()
+            if last is not None and last >= kill_after_round:
+                break
+            time.sleep(0.01)
+        else:
+            pytest.fail("round never committed before the kill window")
+        _Killable.kill(server_a)  # fail-stop: no drain, no FINISH
+        ta.join(timeout=30)
+        # the dead process's orbax threads die with it in real life; the
+        # in-process stand-in must reap them (they would race jax tracing
+        # in later tests)
+        server_a.manager._ckpt.close()
+
+        args_b = make_args(run_id, role="server", checkpoint_dir=ck,
+                           checkpoint_rounds=1, **HB)
+        server_b = FedMLCrossSiloServer(args_b, None, ds, bundle)
+        tb = threading.Thread(target=server_b.run, daemon=True)
+        tb.start()
+        tb.join(timeout=120)
+        for t in threads:
+            t.join(timeout=30)
+        return server_b, clients
+
+    def test_kill_after_commit_resync_bitwise(self, tmp_path):
+        """Server transport killed right after round 0's ledger commit;
+        surviving clients heartbeat-miss, resync, and replay anything
+        uncommitted; the restarted manager reconstructs from ledger +
+        checkpoint and the federation finishes BITWISE equal to the
+        fault-free run, with each contribution folded exactly once."""
+        reg = telemetry.registry()
+        resyncs0 = reg.counter("comm.resyncs")
+        recoveries0 = reg.counter("run.server_recoveries")
+        ref, _ = run_world(f"crash-ref-{os.getpid()}")
+        ref_params = _leaves(ref.manager)
+
+        server_b, clients = self._run_crash_world(tmp_path,
+                                                  kill_after_round=0)
+        assert server_b.manager.done.is_set(), "resumed server never finished"
+        assert all(c.manager.done.is_set() for c in clients), \
+            "a client never reached FINISH across the kill"
+        for a, b in zip(ref_params, _leaves(server_b.manager)):
+            assert a.dtype == b.dtype and np.array_equal(a, b), \
+                "kill + resync diverged from the fault-free run"
+        assert reg.counter("comm.resyncs") > resyncs0
+        assert reg.counter("run.server_recoveries") > recoveries0
+        # exactly one ledger entry per round, nobody counted twice
+        led = RunLedger.for_checkpoint_dir(str(tmp_path / "ckpt"))
+        rounds = [e["round"] for e in led.rounds()]
+        assert sorted(rounds) == [0, 1, 2]
+        assert len(rounds) == len(set(rounds))
+        for e in led.rounds():
+            for client, count in (e.get("contrib") or {}).items():
+                assert count == 1, (e["round"], client, count)
+
+    def test_resync_ack_after_finish_delivers_final_model(self, tmp_path):
+        """A resync landing on a FINISHED federation gets the final model
+        (S2C_FINISH) instead of silence — the late client terminates."""
+        server, clients = run_world(f"finish-resync-{os.getpid()}", **HB)
+        mgr = server.manager
+        # drive the handler directly: done is set, a straggling resync
+        # arrives from rank 1
+        from fedml_tpu.core.distributed import Message
+        from fedml_tpu.cross_silo.message_define import MyMessage
+
+        sent = []
+        mgr.send_message = lambda m: sent.append(m)
+        resync = Message(MyMessage.MSG_TYPE_C2S_RESYNC, 1, 0)
+        resync.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, 2)
+        mgr._on_resync(resync)
+        assert sent and sent[0].get_type() == MyMessage.MSG_TYPE_S2C_FINISH
+
+
+class TestServingStateReconstruction:
+    """ISSUE 12 satellite: fold-buffer and version-store reconstruction
+    units — an async federation serialized mid-buffer, the server manager
+    restarted, and the rebuilt state compared against the pre-kill one."""
+
+    def _async_manager(self, tmp_path, run_id, seed=7):
+        args = make_args(run_id, role="server",
+                         checkpoint_dir=str(tmp_path / "ckpt"),
+                         checkpoint_rounds=1, aggregation_mode="async",
+                         async_buffer_size=2, async_staleness_alpha=0.5,
+                         random_seed=seed)
+        ds, od = data_mod.load(args)
+        bundle = model_mod.create(args, od)
+        return FedMLCrossSiloServer(args, None, ds, bundle).manager, bundle
+
+    def _update_item(self, mgr, sender, client_version, n=4.0):
+        import jax
+
+        leaves = [np.asarray(l) for l in jax.tree.leaves(mgr.global_params)]
+        return (time.monotonic(), sender, client_version, n, leaves,
+                None, None)
+
+    def test_store_ring_and_buffer_weights_survive_restart(self, tmp_path):
+        """(a) the restarted store ring matches the pre-kill committed
+        state digest-for-digest; (b) a re-solicited update folds with the
+        SAME staleness weight it would have folded with pre-kill."""
+        run_id = f"rebuild-{os.getpid()}"
+        mgr_a, _ = self._async_manager(tmp_path, run_id)
+        # two committed server steps: versions 1 and 2 (ckpt steps 0, 1)
+        for step in range(2):
+            for sender in (1, 2):
+                mgr_a._async_fold(
+                    self._update_item(mgr_a, sender, mgr_a.round_idx))
+            assert mgr_a._async_step()
+        assert mgr_a.round_idx == 2
+        # one MID-BUFFER (uncommitted, in-flight) fold: stale by 1 version
+        mgr_a._async_fold(self._update_item(mgr_a, 1, 1))
+        pre_entries = list(mgr_a.buffer._entries)
+        assert len(pre_entries) == 1 and pre_entries[0].staleness == 1
+        pre_weight = pre_entries[0].weight
+        pre_digests = {v: mgr_a.store.digest(v)
+                       for v in mgr_a.store.versions()}
+
+        # restart: a second manager on the same checkpoint dir
+        mgr_b, _ = self._async_manager(tmp_path, run_id)
+        assert mgr_b.round_idx == 2
+        # (a) ring contents: every version a checkpoint backs is rebuilt
+        # with an identical digest; version 0 (never committed) stays out
+        # — the evicted/unrecoverable boundary is honored, a delta against
+        # it gets the loud fallback
+        assert mgr_b.store.versions() == [1, 2]
+        for v in mgr_b.store.versions():
+            assert mgr_b.store.digest(v) == pre_digests[v], v
+        assert not mgr_b.store.has(0)
+        # the fold buffer restarts EMPTY but consistent
+        assert mgr_b.buffer.occupancy() == 0
+        # (b) the re-solicited update (the client replays the same vector
+        # against the same version) folds with the same staleness weight
+        mgr_b._async_fold(self._update_item(mgr_b, 1, 1))
+        post = list(mgr_b.buffer._entries)
+        assert len(post) == 1
+        assert post[0].staleness == pre_entries[0].staleness
+        assert post[0].weight == pre_weight
+        # the committed-contribution map came back from the ledger
+        assert mgr_b._committed_client_round == {1: 1, 2: 1}
+        mgr_a._ckpt.close()
+        mgr_b._ckpt.close()
+
+    def test_resume_refuses_mismatched_identity(self, tmp_path):
+        """(c) resuming a ledger whose run_meta identity disagrees is a
+        loud error, not a silent cross-federation merge."""
+        run_id = f"identity-{os.getpid()}"
+        mgr_a, _ = self._async_manager(tmp_path, run_id)
+        for sender in (1, 2):
+            mgr_a._async_fold(
+                self._update_item(mgr_a, sender, mgr_a.round_idx))
+        assert mgr_a._async_step()
+        with pytest.raises(RuntimeError, match="run_meta mismatch"):
+            self._async_manager(tmp_path, run_id, seed=8)
+        mgr_a._ckpt.close()
+
+
+class TestKillServerPhases:
+    """The headline acceptance: SIGKILL (no drain) at each protocol phase
+    + restart + client resync is BITWISE equal to the fault-free run, with
+    the ledger holding exactly one entry per committed round."""
+
+    @pytest.mark.parametrize("phase", ["pre_fold", "mid_fold",
+                                       "post_commit"])
+    def test_sigkill_phase_restart_bitwise(self, tmp_path, phase):
+        import types
+
+        a = types.SimpleNamespace(
+            clients=2, rounds=3, epochs=1, seed=7, loss=0.0, duplicate=0.0,
+            corrupt=0.0, kill_round=1, kill_phase=phase, partition="",
+            heartbeat_s=0.0, checkpoint_rounds=1, workdir=str(tmp_path),
+            timeout=240.0, worker=False, server_only=False, out="",
+            checkpoint_dir="", transport="loopback", port=0,
+        )
+        ref = chaos.run_world(
+            a, run_id=f"killref-{phase}-{os.getpid()}",
+            checkpoint_dir=str(tmp_path / "ref_ckpt"), faulty=False)
+
+        out = str(tmp_path / "out")
+        ckpt = str(tmp_path / "kill_ckpt")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        import subprocess
+
+        cwd = os.path.dirname(os.path.dirname(
+            os.path.abspath(chaos.__file__)))
+        p1 = subprocess.run(
+            chaos._worker_cmd(a, out, ckpt, a.kill_round, kill_phase=phase),
+            timeout=240, env=env, cwd=cwd,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        assert p1.returncode in chaos.SIGKILL_RCS, (
+            f"expected SIGKILL death, got rc={p1.returncode}:\n"
+            + p1.stdout.decode(errors="replace")[-3000:])
+        p2 = subprocess.run(
+            chaos._worker_cmd(a, out, ckpt, -1, kill_phase=""),
+            timeout=240, env=env, cwd=cwd,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        assert p2.returncode == 0, \
+            p2.stdout.decode(errors="replace")[-3000:]
+
+        with open(os.path.join(out, chaos.REPORT_FILE)) as f:
+            report = json.load(f)
+        assert report["preempted"] is False
+        assert report["round_idx"] == a.rounds
+        with np.load(os.path.join(out, chaos.FINAL_PARAMS_FILE)) as z:
+            kill_params = [z[k] for k in z.files]
+        assert len(kill_params) == len(ref["params"])
+        for i, (x, y) in enumerate(zip(ref["params"], kill_params)):
+            assert x.dtype == y.dtype and np.array_equal(x, y), \
+                f"leaf {i} not bitwise equal after {phase} SIGKILL+restart"
+        # SIGKILL never drains: exactly ONE ledger entry per round, every
+        # contribution counted once
+        led = RunLedger.for_checkpoint_dir(ckpt)
+        rounds = [e["round"] for e in led.rounds()]
+        assert sorted(rounds) == list(range(a.rounds))
+        assert len(rounds) == len(set(rounds)), "a round committed twice"
+        for e in led.rounds():
+            for client, count in (e.get("contrib") or {}).items():
+                assert count == 1, (e["round"], client, count)
+
+
+class TestGrpcRestartedServerReconnect:
+    def test_send_survives_server_restart_on_same_port(self):
+        """ISSUE 12 satellite: a killed-and-restarted (multiplexed) gRPC
+        server must be reachable — the client's stale channel is evicted
+        on connection error and the next send re-dials."""
+        import queue as queue_mod
+
+        from fedml_tpu.core.distributed.grpc_backend import GRPCCommManager
+        from fedml_tpu.core.distributed.message import Message
+        from fedml_tpu.parallel.multihost import free_port
+
+        base = free_port()
+        got: "queue_mod.Queue" = queue_mod.Queue()
+
+        class Obs:
+            def receive_message(self, t, m):
+                got.put((t, m.get_sender_id()))
+
+        def serve():
+            srv = GRPCCommManager("127.0.0.1", base, rank=0, world_size=2,
+                                  base_port=base)
+            srv.add_observer(Obs())
+            th = threading.Thread(target=srv.handle_receive_message,
+                                  daemon=True)
+            th.start()
+            return srv, th
+
+        def drain_until(label):
+            deadline = time.monotonic() + 10
+            seen = []
+            while time.monotonic() < deadline:
+                try:
+                    seen.append(got.get(timeout=0.2)[0])
+                except queue_mod.Empty:
+                    pass
+                if label in seen:
+                    return True
+            return False
+
+        srv1, th1 = serve()
+        cli = GRPCCommManager(
+            "127.0.0.1", base + 1, rank=1, world_size=2, base_port=base)
+        msg = Message("probe", 1, 0)
+        msg.set_arrays([np.arange(3, dtype=np.float32)])
+        cli.send_message(msg)
+        assert drain_until("probe")
+
+        # kill the server process's stand-in: stop + release the port
+        srv1.stop_receive_message()
+        th1.join(timeout=10)
+        # a send into the dead server exhausts the retry budget, raises,
+        # and EVICTS the stale channel (the regression surface)
+        import grpc
+
+        dead = Message("probe_dead", 1, 0)
+        dead.set_arrays([np.arange(3, dtype=np.float32)])
+        with pytest.raises(grpc.RpcError):
+            cli.send_message(dead)
+        # restart on the SAME port (a new process image would do the same)
+        srv2, th2 = serve()
+        try:
+            msg2 = Message("probe2", 1, 0)
+            msg2.set_arrays([np.arange(3, dtype=np.float32)])
+            cli.send_message(msg2)  # must re-dial, not die on a stale channel
+            assert drain_until("probe2"), \
+                "send after server restart never arrived"
+        finally:
+            cli.stop_receive_message()
+            srv2.stop_receive_message()
+            th2.join(timeout=10)
+
+
+class TestStepGranularPreemption:
+    def test_chunker_never_launches_scan_after_latch(self):
+        """A latched PreemptionGuard forces the superround chunker to
+        single rounds — the drain latency is bounded by ONE round, never
+        another K-round scan program."""
+        from fedml_tpu.core.runstate import preemption_guard
+        from fedml_tpu.simulation.sp_api import FedAvgAPI
+
+        overrides = dict(
+            dataset="synthetic", model="lr", client_num_in_total=16,
+            client_num_per_round=16, comm_round=8, epochs=1,
+            batch_size=16, learning_rate=0.1, superround_k=4,
+            preempt_signals=False, frequency_of_the_test=100,
+        )
+        args = fedml.init(Arguments(overrides=overrides),
+                          should_init_logs=False)
+        ds, od = data_mod.load(args)
+        api = FedAvgAPI(args, fedml.get_device(args), ds,
+                        model_mod.create(args, od))
+        guard = preemption_guard()
+        guard.reset()
+        # round 4: no eval (freq 100) or checkpoint boundary strictly
+        # inside the chunk — the scan is allowed
+        assert api._chunk_len(4, 8, 100, 4) == 4
+        guard.request()
+        try:
+            assert api._chunk_len(4, 8, 100, 4) == 1
+            # without checkpointing (every=0) the guard is not consulted —
+            # the legacy no-ckpt flow keeps its exact schedule
+            assert api._chunk_len(4, 8, 100, 0) == 4
+        finally:
+            guard.reset()
+
+    def test_cheetah_step_loop_drains_within_one_step(self, tmp_path):
+        """SIGTERM (programmatic latch) during a cheetah pretrain exits
+        after the in-flight STEP with the state checkpointed — not after
+        the full step budget."""
+        from collections import namedtuple
+
+        import jax.numpy as jnp
+
+        from fedml_tpu.cheetah.runner import CheetahRunner, config_from_args
+        from fedml_tpu.core.runstate import PreemptionError, preemption_guard
+
+        State = namedtuple("State", ["step", "params"])
+
+        class StubTrainer:
+            def init_state(self, rng):
+                return State(step=0, params={"w": jnp.zeros((4,),
+                                                            jnp.float32)})
+
+            def train_step(self, state, tokens, mask):
+                # the SIGTERM analog lands DURING the first step (run()
+                # resets the guard at startup, as the real path does)
+                preemption_guard().request()
+                return (State(step=state.step + 1, params=state.params),
+                        {"loss": jnp.float32(1.0)})
+
+        args = fedml.init(Arguments(overrides=dict(
+            training_type="distributed", backend="LOOPBACK",
+            dataset="synthetic", total_steps=6, batch_size=2, seq_len=8,
+            checkpoint_dir=str(tmp_path / "ck"),
+            checkpoint_every_rounds=100,  # cadence would only fire late
+            preempt_signals=False,
+        )), should_init_logs=False)
+        runner = CheetahRunner.__new__(CheetahRunner)
+        runner.args = args
+        runner.cfg = config_from_args(args)
+        runner.batch_size = 2
+        runner.seq_len = 8
+        runner.total_steps = 6
+        runner.accum_steps = 1
+        runner.trainer = StubTrainer()
+        runner.dataset = None
+        runner.checkpoint_dir = str(tmp_path / "ck")
+
+        guard = preemption_guard()
+        guard.reset()
+        try:
+            with pytest.raises(PreemptionError) as ei:
+                runner.run()
+        finally:
+            guard.reset()
+        assert ei.value.last_round == 0, \
+            "drain did not stop at the first step boundary"
+        from fedml_tpu.checkpoint import CheckpointManager
+
+        ck = CheckpointManager(str(tmp_path / "ck"))
+        try:
+            assert ck.latest_step() == 1  # state AFTER the drained step
+        finally:
+            ck.close()

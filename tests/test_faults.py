@@ -101,6 +101,74 @@ class TestFaultPlanUnit:
         assert 5 < count_through(7) < 45  # actually lossy, not all-or-nothing
 
 
+class TestFaultShapes:
+    """ISSUE 12: the chaos matrix's new fault shapes — partitions,
+    stragglers, and the server kill switch."""
+
+    class Sink:
+        def __init__(self):
+            self.sent = []
+
+        def send_message(self, m):
+            self.sent.append(m.get_type())
+
+        def add_observer(self, o): ...
+        def remove_observer(self, o): ...
+        def handle_receive_message(self): ...
+        def stop_receive_message(self): ...
+
+    def test_partition_is_visible_bidirectional_and_heals(self):
+        """Messages CROSSING the partitioned rank set fail VISIBLY (the
+        at-least-once layer's signal) during the window; same-side traffic
+        flows; after the window everything flows again."""
+        import pytest
+
+        from fedml_tpu.core.distributed.delivery import TransientSendError
+
+        sink = self.Sink()
+        plan = FaultPlan().partition({0}, start_s=0.0, duration_s=0.5)
+        comm = FaultyComm(sink, plan, rank=0)
+        with pytest.raises(TransientSendError, match="partition"):
+            comm.send_message(Message("s2c", 0, 1))  # crossing: cut
+        with pytest.raises(TransientSendError, match="partition"):
+            comm.send_message(Message("c2s", 1, 0))  # crossing, other way
+        comm.send_message(Message("gossip", 1, 2))   # same side: flows
+        assert sink.sent == ["gossip"]
+        time.sleep(0.6)  # the partition heals
+        comm.send_message(Message("s2c", 0, 1))
+        assert sink.sent == ["gossip", "s2c"]
+
+    def test_straggle_is_a_sender_delay_rule(self):
+        plan = FaultPlan().straggle(2, 1.5, round_idx=3)
+        assert plan.delays == [{"sender": 2, "receiver": None, "round": 3,
+                                "seconds": 1.5}]
+
+    def test_kill_server_validates_phase(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="kill_server phase"):
+            FaultPlan().kill_server("between_rounds", 1)
+        plan = FaultPlan().kill_server("mid_fold", 2)
+        assert plan.kill_phase == "mid_fold" and plan.kill_round == 2
+        # a non-matching phase/round is a no-op (we are still alive to
+        # assert this — a match would have SIGKILLed the test runner)
+        plan.maybe_kill_server("pre_fold", 2)
+        plan.maybe_kill_server("mid_fold", 1)
+
+    def test_external_kill_goes_dark(self):
+        """FaultyComm.kill(): the deterministic fail-stop used by the
+        failover tests — sends vanish, the receive loop stops."""
+        sink = self.Sink()
+        stopped = []
+        sink.stop_receive_message = lambda: stopped.append(1)
+        comm = FaultyComm(sink, FaultPlan(), rank=0)
+        comm.send_message(Message("alive", 0, 1))
+        comm.kill()
+        comm.send_message(Message("after-death", 0, 1))
+        assert sink.sent == ["alive"]
+        assert stopped == [1]
+
+
 class TestFaultRecovery:
     def test_transient_message_loss_revives_client(self):
         """Client 3's round-0 model vanishes on the wire: the deadline

@@ -134,4 +134,108 @@ if [ "$rc" -ne 0 ]; then
     echo "chaos_smoke: FAIL — gRPC verdict did not validate" >&2
     exit 1
 fi
+
+# ---- server-kill leg, loopback (ISSUE 12 tentpole) -------------------------
+# SIGKILL (no drain) at a protocol phase of round 1, restart with --resume
+# auto: bitwise parity with the fault-free reference AND exactly one ledger
+# entry per committed round. tests/test_failover.py covers all three phases;
+# the smoke pins one mid-protocol phase per transport.
+workdir_k=$(mktemp -d /tmp/fedml_chaos_smoke_kill.XXXXXX)
+trap 'rm -rf "$workdir" "$workdir_c" "$workdir2" "$workdir_k"' EXIT
+out=$(timeout -k 10 300 env JAX_PLATFORMS=cpu python -m fedml_tpu.cli chaos \
+    --clients 2 --rounds 3 --seed 7 \
+    --loss 0.05 --duplicate 0.1 --corrupt 0.1 \
+    --kill-round 1 --kill-phase mid_fold --workdir "$workdir_k" 2>/dev/null)
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL — server-kill (loopback) leg exited rc=$rc" >&2
+    printf '%s\n' "$out" >&2
+    exit 1
+fi
+python - "$out" <<'EOF'
+import json
+import sys
+
+verdict = json.loads(sys.argv[1])
+assert verdict["ok"], verdict["problems"]
+assert verdict["parity"], verdict["problems"]
+assert verdict["preemption_exercised"], "the SIGKILL never fired"
+print("chaos_smoke: server-kill (loopback, mid_fold) OK —",
+      f"{verdict['rounds']} rounds x {verdict['clients']} clients")
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL — server-kill verdict did not validate" >&2
+    exit 1
+fi
+
+# ---- server-kill leg, gRPC crash-failover ----------------------------------
+# the client processes are owned by the ORCHESTRATOR and survive the server
+# SIGKILL: they must heartbeat-miss, reconnect (stale channel evicted),
+# c2s_resync onto the restarted server-only worker at the same port, replay
+# anything uncommitted, and reach FINISH with exit 0
+workdir_kg=$(mktemp -d /tmp/fedml_chaos_smoke_killg.XXXXXX)
+trap 'rm -rf "$workdir" "$workdir_c" "$workdir2" "$workdir_k" "$workdir_kg"' EXIT
+out=$(timeout -k 10 480 env JAX_PLATFORMS=cpu python -m fedml_tpu.cli chaos \
+    --clients 2 --rounds 3 --epochs 2 --seed 7 \
+    --loss 0.05 --duplicate 0.1 --corrupt 0.1 \
+    --kill-round 1 --kill-phase post_commit --transport grpc \
+    --timeout 360 --workdir "$workdir_kg" 2>/dev/null)
+rc=$?
+if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    echo "chaos_smoke: FAIL — gRPC failover leg hit the hard timeout" >&2
+    exit 1
+fi
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL — gRPC failover leg exited rc=$rc" >&2
+    printf '%s\n' "$out" >&2
+    exit 1
+fi
+python - "$out" <<'EOF'
+import json
+import sys
+
+verdict = json.loads(sys.argv[1])
+assert verdict["ok"], verdict["problems"]
+assert verdict["parity"], verdict["problems"]
+assert verdict["preemption_exercised"], "the SIGKILL never fired"
+print("chaos_smoke: server-kill (gRPC failover, post_commit) OK —",
+      "surviving client procs resynced across the restart")
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL — gRPC failover verdict did not validate" >&2
+    exit 1
+fi
+
+# ---- partition leg ---------------------------------------------------------
+# a 1.2 s bidirectional server<->clients cut 1 s into the world: the
+# at-least-once retry budget must absorb it with bitwise parity
+workdir_p=$(mktemp -d /tmp/fedml_chaos_smoke_part.XXXXXX)
+trap 'rm -rf "$workdir" "$workdir_c" "$workdir2" "$workdir_k" "$workdir_kg" "$workdir_p"' EXIT
+out=$(timeout -k 10 300 env JAX_PLATFORMS=cpu python -m fedml_tpu.cli chaos \
+    --clients 2 --rounds 4 --seed 7 \
+    --loss 0.05 --duplicate 0.1 --corrupt 0.1 \
+    --kill-round -1 --partition 1.0:1.2 --workdir "$workdir_p" 2>/dev/null)
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL — partition leg exited rc=$rc" >&2
+    printf '%s\n' "$out" >&2
+    exit 1
+fi
+python - "$out" <<'EOF'
+import json
+import sys
+
+verdict = json.loads(sys.argv[1])
+assert verdict["ok"], verdict["problems"]
+assert verdict["parity"], verdict["problems"]
+print("chaos_smoke: partition OK —",
+      f"window {verdict['fault_matrix']['partition']} absorbed bitwise")
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL — partition verdict did not validate" >&2
+    exit 1
+fi
 echo "chaos_smoke: PASS"
