@@ -111,6 +111,21 @@ COMPRESSED_OVERRIDES = dict(
 )
 COMPRESSED_SCHEME = dict(compression="eftopk", compression_ratio=0.01)
 
+# Device-direct wire leg (fedml_tpu/delivery/device_codec.py — docs/
+# delivery.md "Device-direct wire path"): host-CPU cost of putting one S2C
+# frame on the wire, full vs host-delta vs device-delta, at a frame size
+# where per-call overhead vanishes (~16 MB fp32). "Host CPU" is SERVING-
+# THREAD CPU time (``time.thread_time``): the resource the device path
+# frees — jit'd kernels run off the serving thread (off-host entirely on
+# TPU; on the CPU backend they land in XLA's pool, so wall time there is
+# a stand-in, flagged by ``platform``). The parity gate is absolute: the
+# device frames must be byte-identical to the host codec's before any
+# timing is believed. BENCH_WIRE_DIM / BENCH_WIRE_REPS scale it down for
+# smoke runs.
+WIRE_DIM = 4_000_000
+WIRE_CHANGED_FRAC = 0.01  # steady-state sparse-ish round delta
+WIRE_SOAK = dict(clients=8, steps=3, think_s=0.01, seed=7)
+
 # The flagship is the PRODUCT shape: Llama-standard head_dim 128 with GQA
 # 16q/4kv on a wide-shallow d2048 x 8L body — chosen product-shape-first,
 # not max-MFU-first. Two levers got it to 75.7% MFU on the v5e
@@ -183,9 +198,16 @@ _MILLION_SOURCES = [
 ]
 _COMPRESSED_SOURCES = [
     "fedml_tpu/delivery/model_store.py", "fedml_tpu/delivery/delta_codec.py",
-    "fedml_tpu/core/compression.py", "fedml_tpu/cross_silo/server_manager.py",
+    "fedml_tpu/delivery/device_codec.py", "fedml_tpu/core/compression.py",
+    "fedml_tpu/cross_silo/server_manager.py",
     "fedml_tpu/cross_silo/client_manager.py",
     "fedml_tpu/core/distributed/message.py", "bench.py",
+]
+_WIRE_SOURCES = [
+    "fedml_tpu/delivery/device_codec.py", "fedml_tpu/delivery/delta_codec.py",
+    "fedml_tpu/delivery/model_store.py",
+    "fedml_tpu/core/distributed/tensor_transport.py",
+    "fedml_tpu/traffic/swarm.py", "bench.py",
 ]
 
 
@@ -587,6 +609,105 @@ def bench_compressed_round() -> dict:
     }
 
 
+def bench_fedavg_wire() -> dict:
+    """Device-direct wire leg: serving-thread CPU s/MB to emit one S2C
+    frame, full vs host-delta vs device-delta (see WIRE_DIM comment).
+
+    Three parts, strict order: (1) the PARITY GATE — device frames must be
+    byte-identical to the host codec's at the bench dim, or the leg raises
+    and no number is reported; (2) the codec timing at WIRE_DIM; (3) an
+    engagement proof — a short loopback swarm soak with ``--wire_path
+    device`` whose report must show nonzero device encodes/decodes and
+    zero host fallbacks.
+    """
+    _maybe_force_platform()
+    import argparse
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.core.distributed.tensor_transport import encode_frames
+    from fedml_tpu.delivery import DeltaCodec, WireCodec
+
+    dim = int(os.environ.get("BENCH_WIRE_DIM", WIRE_DIM))
+    reps = int(os.environ.get("BENCH_WIRE_REPS", "10"))
+    rng = np.random.default_rng(0)
+    base = rng.standard_normal(dim).astype(np.float32)
+    new = base.copy()
+    changed = rng.choice(dim, size=max(1, int(dim * WIRE_CHANGED_FRAC)),
+                         replace=False)
+    new[changed] += 0.01
+    base_d, new_d = jnp.asarray(base), jnp.asarray(new)
+    wire = WireCodec("device")
+
+    # (1) parity gate — before any timing is believed
+    h_arrays, h_meta = DeltaCodec.encode(base, new)
+    d_arrays, d_meta = wire.encode(base_d, new_d)
+    if h_meta != d_meta or (
+            [np.asarray(a).tobytes() for a in h_arrays]
+            != [np.asarray(a).tobytes() for a in d_arrays]):
+        raise RuntimeError(
+            f"device frames diverge from host codec at dim={dim} "
+            f"(host {h_meta} vs device {d_meta})")
+
+    # (2) timing: serving-thread CPU + wall, per path, after jit warmup
+    def clock(fn):
+        fn()  # warmup (compiles on the device path)
+        w0, c0 = time.perf_counter(), time.thread_time()
+        for _ in range(reps):
+            fn()
+        return ((time.perf_counter() - w0) / reps,
+                (time.thread_time() - c0) / reps)
+
+    mb = dim * 4 / 1e6
+    paths = {
+        "full": lambda: encode_frames([new]),
+        "host_delta": lambda: DeltaCodec.encode(base, new),
+        "device_delta": lambda: wire.encode(base_d, new_d),
+    }
+    timing = {}
+    for tag, fn in paths.items():
+        wall, cpu = clock(fn)
+        timing[tag] = {"host_cpu_ms_per_mb": round(cpu / mb * 1e3, 4),
+                       "wall_ms_per_mb": round(wall / mb * 1e3, 4)}
+
+    # (3) engagement proof: short device-path soak, fallbacks must be zero
+    from fedml_tpu.traffic.swarm import swarm_soak
+
+    soak = swarm_soak(argparse.Namespace(
+        clients=WIRE_SOAK["clients"], steps=WIRE_SOAK["steps"],
+        buffer=0, staleness_alpha=0.5, max_staleness=0, flush_s=5.0,
+        admit_rate=0.0, admit_burst=0, queue_limit=0,
+        think_s=WIRE_SOAK["think_s"], dropout=0.0, seed=WIRE_SOAK["seed"],
+        backend="loopback", procs=1, ranks_per_port=0, port=0,
+        s2c_delta="auto", wire_path="device", timeout=120.0,
+        run_id=f"bench-wire-{os.getpid()}",
+    ))
+
+    host_cpu = {t: v["host_cpu_ms_per_mb"] for t, v in timing.items()}
+    reduction = (host_cpu["host_delta"] / host_cpu["device_delta"]
+                 if host_cpu["device_delta"] else 0.0)
+    return {
+        "wire_dim": dim,
+        "wire_frame_mb": round(mb, 1),
+        "wire_scheme": h_meta["scheme"],
+        "wire_parity": True,  # the gate above raised otherwise
+        "wire_host_cpu_ms_per_mb": host_cpu,
+        "wire_wall_ms_per_mb": {t: v["wall_ms_per_mb"]
+                                for t, v in timing.items()},
+        "wire_host_cpu_reduction_x": round(reduction, 2),
+        "wire_soak_ok": bool(soak.get("ok")),
+        "wire_soak_device_encodes": int(soak.get("wire_device_encodes") or 0),
+        "wire_soak_device_decodes": int(soak.get("wire_device_decodes") or 0),
+        "wire_soak_host_fallbacks": int(soak.get("wire_host_fallbacks") or 0),
+        "wire_soak_s2c_delta_frames": int(soak.get("s2c_delta_frames") or 0),
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+    }
+
+
 def bench_cheetah() -> dict:
     """Single-chip flagship-transformer pretrain throughput + MFU."""
     import gc
@@ -820,6 +941,12 @@ def _translate_compressed(parsed: dict):
     return out, platform
 
 
+def _translate_wire(parsed: dict):
+    platform = parsed.pop("platform", None)
+    out = {"wire_device_kind": parsed.pop("device_kind", None), **parsed}
+    return out, platform
+
+
 def leg_specs() -> list:
     """(name, argv, digest, translate) per leg, priority order: the headline
     FedAvg metric first, then the flagship, then the secondary shapes."""
@@ -837,6 +964,9 @@ def leg_specs() -> list:
         ("fedavg_compressed_round", [py, me, "--leg", "compressed"],
          _digest({"cfg": COMPRESSED_OVERRIDES, "scheme": COMPRESSED_SCHEME},
                  _COMPRESSED_SOURCES), _translate_compressed),
+        ("fedavg_wire", [py, me, "--leg", "wire"],
+         _digest({"dim": WIRE_DIM, "frac": WIRE_CHANGED_FRAC,
+                  "soak": WIRE_SOAK}, _WIRE_SOURCES), _translate_wire),
         ("cheetah", [py, me, "--leg", "cheetah"],
          _digest({"base": CHEETAH_BASE, "ladder": CHEETAH_LADDER,
                   "run": CHEETAH_RUN}, _CHEETAH_SOURCES), _translate_cheetah),
@@ -1027,7 +1157,8 @@ def main() -> None:
     if len(sys.argv) > 2 and sys.argv[1] == "--leg":
         fn = {"fedavg": bench_fedavg, "cheetah": bench_cheetah,
               "million": bench_million_client,
-              "compressed": bench_compressed_round}[sys.argv[2]]
+              "compressed": bench_compressed_round,
+              "wire": bench_fedavg_wire}[sys.argv[2]]
         print(json.dumps(fn()), flush=True)
         return
     budget = float(os.environ.get("BENCH_BUDGET_S", "2400"))

@@ -201,6 +201,12 @@ _SCHEMA: Dict[str, tuple] = {
     # against the client's last-ACKed version whenever that base is still
     # in the store, loud full-frame fallback otherwise) | off
     "s2c_delta": (str, "auto"),
+    # which implementation serves delta encode/decode: host (numpy
+    # reference), device (jit'd kernels + dlpack emission), or auto
+    # (device when JAX is importable). PERFORMANCE knob only — frames are
+    # byte-identical across paths, so this is deliberately NOT part of
+    # delivery_identity
+    "wire_path": (str, "auto"),
     # bounded ring of committed global versions both wire ends keep
     # (VersionedModelStore capacity); also bounds how stale a compressed
     # C2S delta can be and still decode
@@ -382,6 +388,10 @@ class Arguments:
         s2c = str(getattr(self, "s2c_delta", "auto") or "auto").lower()
         if s2c not in ("auto", "off"):
             raise ValueError(f"s2c_delta must be auto|off, got {s2c!r}")
+        wire = str(getattr(self, "wire_path", "auto") or "auto").lower()
+        if wire not in ("host", "device", "auto"):
+            raise ValueError(
+                f"wire_path must be host|device|auto, got {wire!r}")
         if int(getattr(self, "delta_store_versions", 8) or 0) < 1:
             raise ValueError("delta_store_versions must be >= 1")
         dispatch = str(
@@ -594,6 +604,13 @@ def add_args() -> argparse.Namespace:
         help="S2C sync frames: auto ships a lossless delta against the "
         "client's last-ACKed version (full-frame fallback on store "
         "eviction); off always broadcasts full models",
+    )
+    parser.add_argument(
+        "--wire_path", type=str, default=None,
+        choices=("host", "device", "auto"),
+        help="delta codec implementation: host (numpy reference), device "
+        "(jit'd kernels, zero-copy emission), auto (device when JAX is "
+        "available); frames are byte-identical either way",
     )
     parser.add_argument(
         "--delta_store_versions", type=int, default=None, metavar="V",

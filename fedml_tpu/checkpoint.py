@@ -46,11 +46,19 @@ class CheckpointManager:
         # round_engine.py) donates the state buffers to the next round's XLA
         # program, so a device reference held across the next dispatch would
         # be read-after-donate. device_get blocks until the values are
-        # computed and materializes them as numpy — safe no matter when the
-        # caller dispatches the next round.
+        # computed — but on the CPU backend it returns ZERO-COPY numpy views
+        # over the jax buffers (owndata=False, dlpack-capsule base), so the
+        # donation would still invalidate them mid-serialize. Force owned
+        # copies of any non-owning leaf.
         import jax
+        import numpy as np
 
         state = jax.device_get(state)
+        state = jax.tree.map(
+            lambda x: np.array(x)
+            if isinstance(x, np.ndarray) and not x.flags.owndata else x,
+            state,
+        )
         self._mgr.save(step, args=ocp.args.StandardSave(state))
         self._mgr.wait_until_finished()
         logger.info("checkpoint: saved step %d to %s", step, self.directory)
@@ -72,11 +80,17 @@ class CheckpointManager:
             step, args=ocp.args.StandardRestore(abstract_state)
         )
         # re-commit every leaf to the template's sharding: orbax may land
-        # scalars on a single device, which breaks jit with mesh-sharded args
+        # scalars on a single device, which breaks jit with mesh-sharded args.
+        # Copy through jnp.array FIRST: device_put on the CPU backend
+        # zero-copy ALIASES 64-byte-aligned numpy buffers, and the restored
+        # leaves become the round state the fused engine donates — XLA
+        # reclaiming a buffer numpy also owns is a use-after-free (observed
+        # as intermittent segfaults / silently corrupted resumes).
         import jax
+        import jax.numpy as jnp
 
         restored = jax.tree.map(
-            lambda r, t: jax.device_put(r, t.sharding)
+            lambda r, t: jax.device_put(jnp.array(r), t.sharding)
             if hasattr(t, "sharding") else r,
             restored,
             abstract_state,

@@ -185,8 +185,39 @@ def cmd_top(args) -> int:
                   f"{misses:.0f} misses")
         _print_traffic_summary(metrics)
         _print_delta_summary(metrics)
+        _print_wire_summary(metrics)
         _print_recovery_summary(metrics)
     return 0
+
+
+def _print_wire_summary(metrics: dict) -> None:
+    """The wire-path story (comm.wire.* family, docs/delivery.md
+    device-direct): which codec served encodes/decodes, the per-call time
+    histograms, and bytes that had to be materialized host-side. Silent
+    when no wire codec ever ran."""
+    counters = metrics.get("counters", {})
+    hists = metrics.get("histograms", {})
+    enc = hists.get("comm.wire.encode_s") or {}
+    dec = hists.get("comm.wire.decode_s") or {}
+    dev_enc = counters.get("comm.wire.device_encodes", 0)
+    dev_dec = counters.get("comm.wire.device_decodes", 0)
+    fallbacks = counters.get("comm.wire.host_fallbacks", 0)
+    if not (enc.get("count") or dec.get("count") or dev_enc or fallbacks):
+        return
+    print("\nwire path (delta codec kernels):")
+    print(f"  encodes: {enc.get('count', 0):.0f} "
+          f"({dev_enc:.0f} device)   decodes: {dec.get('count', 0):.0f} "
+          f"({dev_dec:.0f} device)   host fallbacks: {fallbacks:.0f}")
+    if enc.get("count"):
+        print(f"  encode_s p50 {1e3 * (enc.get('p50') or 0):.2f}ms   "
+              f"p99 {1e3 * (enc.get('p99') or 0):.2f}ms")
+    if dec.get("count"):
+        print(f"  decode_s p50 {1e3 * (dec.get('p50') or 0):.2f}ms   "
+              f"p99 {1e3 * (dec.get('p99') or 0):.2f}ms")
+    copied = counters.get("comm.wire.host_bytes_copied", 0)
+    if copied:
+        print(f"  host bytes copied: {copied / 1e6:.2f} MB "
+              "(non-dlpack transfers)")
 
 
 def _print_recovery_summary(metrics: dict) -> None:
@@ -811,6 +842,12 @@ def main(argv=None) -> int:
                          "devices delta-capable (ACK + base store + frame "
                          "decode) so dispatches ship delta frames; off "
                          "keeps the legacy full-frame soak")
+    p_swarm.add_argument("--wire_path", choices=("host", "device", "auto"),
+                         default="auto",
+                         help="delta codec implementation for the soak: "
+                         "device forces the jit'd kernels (byte-identical "
+                         "frames), host the numpy reference, auto picks "
+                         "device only on a real accelerator")
     p_swarm.add_argument("--timeout", type=float, default=300.0)
     p_swarm.add_argument("--run_id", default="swarm")
     # internal: one gRPC device-host process (the orchestrator's child)

@@ -31,27 +31,36 @@ import numpy as np
 RAW_MAGIC = b"FTT1"
 
 
-def encode_frame_parts(arrays: Sequence[np.ndarray]) -> List[bytes]:
+def encode_frame_parts(
+        arrays: Sequence[np.ndarray]) -> List[Union[bytes, memoryview]]:
     """[arrays] → the body PIECES [RAW_MAGIC, u32 header_len, JSON header,
     frame, frame, ...] — callers join them together with their own prefix
     so the whole wire payload is assembled in ONE pass (Message.serialize
     does exactly that; a naive encode-then-concat would copy a GB-scale
     blob twice).
 
+    Already-C-contiguous arrays ride as MEMORYVIEWS over their own buffers
+    — zero data copies on encode; the single pass that touches bytes is the
+    caller's join/socket write. Only non-contiguous inputs pay a
+    materializing ``ascontiguousarray``.
+
     No alignment padding: the body rides behind a variable-length message
     prefix anyway, so in-body alignment cannot survive to the receive
     buffer — numpy accepts unaligned views (ALIGNED=False)."""
     metas = []
-    frames: List[bytes] = []
+    frames: List[Union[bytes, memoryview]] = []
     off = 0
     for a in arrays:
         a = np.asarray(a)
         # record the TRUE shape before ascontiguousarray, which promotes
         # 0-d scalars to (1,) — the npz path preserves () and so must we
         shape = list(a.shape)
-        a = np.ascontiguousarray(a)
+        if not a.flags.c_contiguous:
+            a = np.ascontiguousarray(a)
         metas.append({"dtype": a.dtype.str, "shape": shape, "off": off})
-        frames.append(a.tobytes())  # the single data copy on encode
+        # flat byte view, zero-copy (read-only arrays export read-only
+        # views; join/write only ever reads)
+        frames.append(memoryview(a).cast("B") if a.nbytes else b"")
         off += a.nbytes
     header = json.dumps(metas).encode("utf-8")
     return [RAW_MAGIC, len(header).to_bytes(4, "big"), header, *frames]

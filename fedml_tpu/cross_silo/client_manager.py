@@ -32,8 +32,9 @@ import numpy as np
 from .. import constants
 from ..core.distributed import FedMLCommManager, Message
 from ..core.dp import FedPrivacyMechanism
-from ..delivery import VersionedModelStore, flatten_leaves
-from ..delivery.delta_codec import DELTA_KEY, DeltaCodec, payload_nbytes
+from ..delivery import VersionedModelStore, WireCodec, flatten_leaves
+from ..delivery.delta_codec import DELTA_KEY, payload_nbytes
+from ..delivery.device_codec import host_view
 from ..delivery.payload_filter import filter_from_args
 from .message_define import MyMessage
 
@@ -92,6 +93,10 @@ class ClientMasterManager(FedMLCommManager):
             int(getattr(args, "delta_store_versions", 8) or 8),
             metric_prefix="comm.delta.client_store",
         ) if self._s2c_delta_on else None
+        # wire-path facade (shared knob with the server): device-kernel
+        # decode feeds tree_unflatten_from_vector without a host round-trip
+        self.wire = WireCodec(getattr(args, "wire_path", "auto"),
+                              scoped=self.world.telemetry)
         # adapter-only C2S payloads — built with the treedef (needs the
         # model skeleton for leaf names)
         self._filter = None
@@ -334,8 +339,16 @@ class ClientMasterManager(FedMLCommManager):
         if dmeta is not None:
             from ..utils.tree import tree_unflatten_from_vector
 
-            base = (self._base_store.get(int(dmeta["base_version"]))
-                    if self._base_store is not None else None)
+            on_device = self.wire.path == "device"
+            if self._base_store is None:
+                base = None
+            elif on_device:
+                # device-resident ring head: the base we ACKed last round
+                # is already on device — the decode never re-uploads it
+                base = self._base_store.get_device(
+                    int(dmeta["base_version"]))
+            else:
+                base = self._base_store.get(int(dmeta["base_version"]))
             if base is None:
                 self.world.telemetry.counter_inc(
                     "comm.delta.client_base_missing")
@@ -348,7 +361,10 @@ class ClientMasterManager(FedMLCommManager):
                 )
                 self._announce_online()
                 return False
-            new_vec = DeltaCodec.decode(base, msg.get_arrays(), dmeta)
+            # device path: new_vec IS a device array — it feeds the
+            # unflatten below directly (jnp.asarray no-ops) instead of
+            # round-tripping the reconstructed model through host memory
+            new_vec = self.wire.decode(base, msg.get_arrays(), dmeta)
             params = tree_unflatten_from_vector(
                 jnp.asarray(new_vec), self._treedef, self._shapes)
         else:
@@ -358,7 +374,16 @@ class ClientMasterManager(FedMLCommManager):
         if self._base_store is not None and version is not None:
             if new_vec is None:
                 new_vec = flatten_leaves(jax.tree.leaves(params))
-            self._base_store.put(int(version), new_vec)
+            if isinstance(new_vec, np.ndarray):
+                self._base_store.put(int(version), new_vec)
+            else:
+                # seed the device ring-head cache with the buffer we
+                # already hold — next round's delta decodes against it
+                # with zero uploads
+                self._base_store.put(
+                    int(version),
+                    host_view(new_vec, scoped=self.world.telemetry),
+                    device=new_vec)
         if self.codec.enabled():
             from ..utils.tree import tree_flatten_to_vector
 

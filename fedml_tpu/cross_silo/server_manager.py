@@ -52,8 +52,10 @@ from ..core.aggregate import stack_trees, weighted_average
 from ..core.distributed import FedMLCommManager, Message
 from ..core.dp import FedPrivacyMechanism
 from ..core.security.defender import FedMLDefender
-from ..delivery import VersionedModelStore, delivery_identity, flatten_leaves
-from ..delivery.delta_codec import DELTA_KEY, DeltaCodec, payload_nbytes
+from ..delivery import (
+    VersionedModelStore, WireCodec, delivery_identity, flatten_leaves,
+)
+from ..delivery.delta_codec import DELTA_KEY, payload_nbytes
 from ..delivery.payload_filter import FILTER_KEY, filter_from_args
 from ..ml.evaluate import make_eval_fn
 from ..utils.tree import tree_flatten_to_vector, tree_unflatten_from_vector
@@ -151,6 +153,11 @@ class FedMLServerManager(FedMLCommManager):
             str(getattr(args, "s2c_delta", "auto") or "auto").lower()
             != "off"
         )
+        # the wire-path facade: jit'd device kernels (or the host numpy
+        # reference) behind one encode/decode surface, byte-identical
+        # frames either way (--wire_path host|device|auto)
+        self.wire = WireCodec(getattr(args, "wire_path", "auto"),
+                              scoped=self.world.telemetry)
         # with the plane fully opted out (--s2c_delta off, no
         # --compression) the store never serves a decode or encode — skip
         # the per-version full-vector copy + digest entirely
@@ -779,7 +786,14 @@ class FedMLServerManager(FedMLCommManager):
             head = self.global_params
         head_leaves = jax.tree.leaves(head)
         if codec_meta:
-            base_vec = self.store.get(client_version)
+            # device wire path: the base rides the store's device-resident
+            # ring-head cache — folding a stream of async updates decodes
+            # every one of them against ONE upload per version instead of
+            # re-crossing the host/device boundary per arrival. The
+            # filtered path slices the host vector, so it keeps host reads.
+            use_device = (self.wire.path == "device" and filt is None)
+            base_vec = (self.store.get_device(client_version) if use_device
+                        else self.store.get(client_version))
             if base_vec is None:
                 self.world.telemetry.counter_inc(
                     "comm.delta.c2s_base_missing")
@@ -934,12 +948,13 @@ class FedMLServerManager(FedMLCommManager):
             if self._store_active:
                 self.store.put(version, vec)  # graftlint: disable=G005
             cache: Dict[int, tuple] = {}
-            for client_rank in range(1, self.size):
+            targets = [r for r in range(1, self.size)
+                       if r not in self._offline_declared]
+            self._prefill_encode_cache(targets, vec, cache, version)
+            for client_rank in targets:
                 # dropped clients still receive the sync (maybe the stall was
                 # transient); they rejoin the quorum when a model arrives.
                 # Clients that DECLARED OFFLINE have torn down — skip them.
-                if client_rank in self._offline_declared:
-                    continue
                 self._send_model_to(
                     client_rank, MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
                     leaves=leaves, vec=vec, cache=cache, version=version,
@@ -1240,6 +1255,7 @@ class FedMLServerManager(FedMLCommManager):
         else:
             targets = [r for r in sorted(set(senders)) if r not in skip]
         cache: Dict[int, tuple] = {}
+        self._prefill_encode_cache(targets, vec, cache, version)
         for client_rank in targets:
             self._send_model_to(
                 client_rank, MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
@@ -1293,14 +1309,40 @@ class FedMLServerManager(FedMLCommManager):
         m = Message(msg_type, self.rank, client_rank)
         m.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, version)
         arrays, delta_meta = self._encode_model_payload(
-            client_rank, leaves, vec, cache)
+            client_rank, leaves, vec, cache, version=version)
         if delta_meta is not None:
             m.add(DELTA_KEY, delta_meta)
         m.set_arrays(arrays)
         self._send_or_mark_dead(client_rank, m)
 
+    def _prefill_encode_cache(self, targets, vec, cache, version) -> None:
+        """Batched per-cohort encode (device wire path): ONE vmapped kernel
+        dispatch covers every distinct ACKed base in this fan-out — the
+        stacked-base axis replaces E sequential host loops. Evicted bases
+        are left for the per-client path (which logs the fallback once per
+        base via the same cache). No-op off the device path: the host
+        codec's per-distinct-base memoization is already one encode each.
+        """
+        if not self.s2c_delta_on or self.wire.path != "device":
+            return
+        with self._lock:
+            acked = {self._acked.get(r) for r in targets}
+        acked.discard(None)
+        versions, bases = [], []
+        for v in sorted(acked):
+            base = self.store.get_device(v)
+            if base is not None:
+                versions.append(v)
+                bases.append(base)
+        if len(bases) < 2:
+            return  # 0/1 distinct bases: one per-client encode covers it
+        new_dev = self.store.get_device(version)  # one dispatch
+        for v, entry in zip(versions, self.wire.encode_batch(
+                bases, new_dev if new_dev is not None else vec)):
+            cache[v] = entry
+
     def _encode_model_payload(self, client_rank: int, leaves, vec=None,
-                              cache=None):
+                              cache=None, version=None):
         """``(arrays, delta_meta-or-None)`` for one model dispatch: a
         lossless delta against the client's last-ACKed version when that
         base is still in the store, else the full leaf list — LOUDLY when
@@ -1322,7 +1364,9 @@ class FedMLServerManager(FedMLCommManager):
             # fan-out (client-pull batching, docs/delivery.md): a thousand
             # parked pulls on the same base hit the store once; the evicted
             # case is cached too so the fallback never re-probes per client
-            base_vec = self.store.get(acked)
+            on_device = self.wire.path == "device"
+            base_vec = (self.store.get_device(acked) if on_device
+                        else self.store.get(acked))
             if base_vec is None:
                 logger.warning(
                     "server: ACKed version %d (client %d) was evicted from "
@@ -1333,10 +1377,16 @@ class FedMLServerManager(FedMLCommManager):
                 )
                 entry = (None, None)
             else:
-                if vec is None:
-                    vec = flatten_leaves(leaves)
-                arrays, meta = DeltaCodec.encode(base_vec, vec)
-                entry = (arrays, meta)
+                new_vec = None
+                if on_device and version is not None:
+                    # the committed head is (or becomes) device-resident in
+                    # the store ring — every encode in this fan-out, and
+                    # every later round's base, reads that one upload
+                    new_vec = self.store.get_device(version)
+                if new_vec is None:
+                    new_vec = vec if vec is not None else flatten_leaves(
+                        leaves)
+                entry = self.wire.encode(base_vec, new_vec)
             if cache is not None:
                 cache[acked] = entry
         arrays, meta = entry

@@ -17,6 +17,13 @@ The pieces here make the wire carry *changes* instead:
   the raw bits), so a delta-shipped sync is bitwise-identical to a full
   broadcast — which is what lets delta shipping default on without touching
   any trajectory pin.
+- :class:`~fedml_tpu.delivery.device_codec.WireCodec` — the wire-path
+  facade over the host codec and its jit'd device twin
+  (:class:`~fedml_tpu.delivery.device_codec.DeviceDeltaCodec`). The
+  ``--wire_path host|device|auto`` knob is a PERFORMANCE choice only:
+  device frames are byte-identical to host frames (shared
+  :func:`~fedml_tpu.delivery.delta_codec.plan_frame` scheme decision), so
+  the knob is deliberately excluded from :func:`delivery_identity`.
 - :class:`~fedml_tpu.delivery.payload_filter.PayloadFilter` — adapter-only
   payloads: a regex over named pytree leaves (the
   ``scale/partition_rules`` naming) selects which leaves ride the C2S wire;
@@ -32,15 +39,19 @@ delivery configuration is refused.
 from __future__ import annotations
 
 from .delta_codec import DeltaCodec
+from .device_codec import DeviceDeltaCodec, WireCodec, resolve_wire_path
 from .model_store import VersionedModelStore
 from .payload_filter import PayloadFilter
 
 __all__ = [
     "DeltaCodec",
+    "DeviceDeltaCodec",
     "PayloadFilter",
     "VersionedModelStore",
+    "WireCodec",
     "delivery_identity",
     "flatten_leaves",
+    "resolve_wire_path",
 ]
 
 
@@ -55,7 +66,13 @@ def flatten_leaves(leaves):
     import numpy as np
 
     arrs = [np.ravel(np.asarray(l)) for l in leaves]
-    return np.concatenate(arrs) if arrs else np.zeros((0,), np.float32)
+    if not arrs:
+        return np.zeros((0,), np.float32)
+    if len(arrs) == 1:
+        # single-leaf models: ravel is already a view — skip the
+        # concatenate, which would copy the whole vector unconditionally
+        return np.ascontiguousarray(arrs[0])
+    return np.concatenate(arrs)
 
 
 def delivery_identity(args):

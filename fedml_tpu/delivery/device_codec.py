@@ -1,0 +1,413 @@
+"""Device-direct S2C delta codec: jit'd kernels + the wire-path facade.
+
+The host :class:`~fedml_tpu.delivery.delta_codec.DeltaCodec` is the
+reference implementation; this module moves its hot arithmetic on-device
+(ROADMAP "Device-direct wire path"):
+
+- **raw-bit compare / count / last-index** — one fused pass via
+  ``lax.bitcast_convert_type`` instead of numpy's compare → nonzero →
+  index chain (three full sweeps plus a bool temporary);
+- **sparse-exact compaction** — ``jnp.nonzero(mask, size=N)`` with
+  power-of-two ``N`` buckets (recompiles are bounded by log2(dim)), values
+  gathered in the *bit domain* so NaN payloads and ``-0.0`` survive XLA
+  untouched;
+- **XOR substrate** for ``xorz`` — computed on device; **zlib stays
+  host-side** (DEFLATE is branchy byte-serial Huffman coding, there is no
+  XLA story for it) and reads the XORed bits through the buffer protocol;
+- **scatter / XOR-apply decode** — ``.at[idx].set()`` on the bitcast view.
+
+Scheme *choice* is delegated to the host codec's
+:func:`~fedml_tpu.delivery.delta_codec.plan_frame` over identically-derived
+costs, so device frames are **byte-identical** to host frames — every
+bitwise trajectory pin and chaos parity leg holds unchanged whichever path
+a deployment picks.
+
+Emission is zero-copy: device buffers cross to the frame writer as dlpack
+views (``np.from_dlpack``), which the raw-frame writer (``tensor_transport``)
+wraps in memoryviews — bytes are touched once, by the final socket write.
+
+Host fallback rules (per encode/decode, accounted as
+``comm.wire.host_fallbacks``):
+
+- JAX absent or import-gated → host path for everything;
+- 8-byte dtypes → host (x64 is disabled by default; ``uint64`` bitcast is
+  unavailable on the device path);
+- ``dim == 0`` or ``dim >= 2^31`` → host (the latter also preserves the
+  int32 index-overflow guard byte-for-byte: the device path never sees a
+  vector it couldn't address).
+
+The :class:`WireCodec` facade owns the knob (``--wire_path host|device|
+auto``), the fallback decisions, and the ``comm.wire.*`` telemetry family.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from ..core.mlops import telemetry
+from .delta_codec import _BIT_VIEWS, DeltaCodec, _as_host, plan_frame
+
+try:  # pragma: no cover - exercised implicitly by every import site
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    _HAS_JAX = True
+except Exception:  # jax is baked into the image, but stay import-safe
+    jax = None  # type: ignore[assignment]
+    jnp = None  # type: ignore[assignment]
+    lax = None  # type: ignore[assignment]
+    _HAS_JAX = False
+
+# itemsize -> device bit dtype; 8 is absent on purpose (x64 disabled)
+_DEV_BITS = {1: "uint8", 2: "uint16", 4: "uint32"}
+
+
+def device_available() -> bool:
+    """Whether the device wire path can run at all in this process."""
+    return _HAS_JAX
+
+
+def _accelerator_present() -> bool:
+    """A real accelerator backs the default JAX device. On the CPU backend
+    the 'device' kernels are an XLA-CPU stand-in that LOSES to the numpy
+    reference (its nonzero/scatter lower serially), so ``auto`` only picks
+    the device path when the kernels actually run off-host."""
+    if not _HAS_JAX:
+        return False
+    try:
+        return jax.devices()[0].platform != "cpu"
+    except Exception:
+        return False
+
+
+def device_supported(dtype, dim: int) -> bool:
+    """Whether (dtype, dim) is addressable by the device kernels."""
+    return (_HAS_JAX and 0 < int(dim) < (1 << 31)
+            and np.dtype(dtype).itemsize in _DEV_BITS)
+
+
+def resolve_wire_path(requested: str) -> str:
+    """``auto`` resolves to ``device`` only when a real accelerator backs
+    JAX (see :func:`_accelerator_present`), else ``host``. An explicit
+    ``device`` request always gets the kernels when JAX is importable —
+    even on the CPU backend (tests, smoke legs, benches) — and degrades
+    loudly (counter) rather than crashing a JAX-less process."""
+    requested = str(requested or "auto")
+    if requested == "host":
+        return "host"
+    if requested == "device":
+        if not _HAS_JAX:
+            telemetry.counter_inc("comm.wire.host_fallbacks")
+            return "host"
+        return "device"
+    return "device" if _accelerator_present() else "host"
+
+
+def _bits_of(vec):
+    """Device bitcast of ``vec`` to the unsigned type of its itemsize."""
+    bits = _DEV_BITS[vec.dtype.itemsize]
+    if str(vec.dtype) == bits:
+        return vec
+    return lax.bitcast_convert_type(vec, jnp.dtype(bits))
+
+
+def _from_bits(bits_vec, dtype):
+    if str(bits_vec.dtype) == str(np.dtype(dtype)):
+        return bits_vec
+    return lax.bitcast_convert_type(bits_vec, jnp.dtype(dtype))
+
+
+# -- jit'd kernels -----------------------------------------------------------
+# All arithmetic happens in the bit domain: gathers/scatters/XORs on uintN
+# are exact, so no XLA canonicalization can perturb NaN payloads or -0.0.
+
+def _stats_kernel(base, new):
+    """(count, last_changed) of raw-bit-differing entries, one fused pass."""
+    mask = _bits_of(base) != _bits_of(new)
+    count = jnp.sum(mask, dtype=jnp.int32)
+    idx = jnp.arange(mask.shape[0], dtype=jnp.int32)
+    last = jnp.max(jnp.where(mask, idx, jnp.int32(-1)))
+    return jnp.stack([count, last])
+
+
+def _xor_kernel(base, new):
+    """XOR of the two vectors' raw bits (the ``xorz`` substrate)."""
+    return _bits_of(base) ^ _bits_of(new)
+
+
+def _compact_kernel(base, new, size: int):
+    """Sparse-exact compaction: (int32 indices, changed bits) padded to the
+    static ``size`` bucket (slice ``[:count]`` host-side)."""
+    new_bits = _bits_of(new)
+    mask = _bits_of(base) != new_bits
+    idx = jnp.nonzero(mask, size=size, fill_value=0)[0].astype(jnp.int32)
+    return idx, jnp.take(new_bits, idx)
+
+
+def _scatter_kernel(base, idx, val_bits):
+    """Sparse decode: scatter changed bits into the base, bit-exact."""
+    out_bits = _bits_of(base).at[idx].set(val_bits)
+    return _from_bits(out_bits, base.dtype)
+
+
+def _xor_apply_kernel(base, xor_bits):
+    """``xorz`` decode: XOR the base's bits with the decompressed mask."""
+    return _from_bits(_bits_of(base) ^ xor_bits, base.dtype)
+
+
+if _HAS_JAX:
+    _stats_jit = jax.jit(_stats_kernel)
+    # vmap over the stacked-base axis: one dispatch covers E distinct ACKed
+    # bases against the same new global (per-cohort fan-out, pull batches)
+    _stats_batch_jit = jax.jit(jax.vmap(_stats_kernel, in_axes=(0, None)))
+    _xor_jit = jax.jit(_xor_kernel)
+    _xor_batch_jit = jax.jit(jax.vmap(_xor_kernel, in_axes=(0, None)))
+    _compact_jit = jax.jit(_compact_kernel, static_argnums=2)
+    _compact_batch_jit = jax.jit(
+        jax.vmap(_compact_kernel, in_axes=(0, None, None)), static_argnums=2)
+    _scatter_jit = jax.jit(_scatter_kernel)
+    _xor_apply_jit = jax.jit(_xor_apply_kernel)
+
+
+def host_view(x, scoped=None) -> np.ndarray:
+    """Zero-copy host view of a device buffer via dlpack; falls back to a
+    materializing transfer (accounted) when the exporter refuses.
+
+    ``scoped`` is a :class:`TelemetryScope`; serving-plane callers pass
+    their ``world.telemetry`` so the copy counter lands in the tenant's
+    registry (graftiso I002), library callers omit it for the process
+    default."""
+    if isinstance(x, np.ndarray):
+        return x
+    try:
+        return np.from_dlpack(x)
+    except Exception:
+        out = np.asarray(x)
+        scope = scoped if scoped is not None else telemetry
+        scope.counter_inc("comm.wire.host_bytes_copied", float(out.nbytes))
+        return out
+
+
+def _bucket(count: int, dim: int) -> int:
+    """Static nonzero size: next power of two ≥ count, capped at dim —
+    bounds jit recompiles to log2(dim) shape variants."""
+    return min(1 << max(int(count) - 1, 0).bit_length(), int(dim))
+
+
+class DeviceDeltaCodec:
+    """Device-kernel twin of :class:`DeltaCodec` — same frames, same bytes.
+
+    Inputs are device (or device-uploadable) 1-D vectors; outputs are host
+    views suitable for the raw-frame writer. ``decode`` returns a DEVICE
+    array — the S2C install path feeds it straight to
+    ``tree_unflatten_from_vector`` without a host round-trip.
+    """
+
+    @staticmethod
+    def encode(base_dev, new_dev,
+               level: int = 1) -> Tuple[List[np.ndarray], Dict]:
+        base = jnp.asarray(base_dev)
+        new = jnp.asarray(new_dev)
+        if base.shape != new.shape or base.dtype != new.dtype:
+            raise ValueError(
+                f"device delta codec: base {base.dtype}{base.shape} and new "
+                f"{new.dtype}{new.shape} frames disagree"
+            )
+        dim = int(new.shape[0])
+        dtype = np.dtype(str(new.dtype))
+        meta: Dict = {"dim": dim, "dtype": dtype.str}
+        count, last = (int(v) for v in np.asarray(_stats_jit(base, new)))
+        raw_cost = dim * dtype.itemsize
+        scheme, xor_comp = plan_frame(
+            raw_cost, dtype.itemsize, count, max(last, 0),
+            lambda: zlib.compress(host_view(_xor_jit(base, new)), level))
+        meta["scheme"] = scheme
+        if scheme == "sparse":
+            if count == 0:
+                return [np.empty(0, np.int32), np.empty(0, dtype)], meta
+            idx_d, bits_d = _compact_jit(base, new, _bucket(count, dim))
+            return [host_view(idx_d)[:count],
+                    host_view(bits_d)[:count].view(dtype)], meta
+        if scheme == "xorz":
+            return [np.frombuffer(xor_comp, dtype=np.uint8)], meta
+        return [host_view(new)], meta
+
+    @staticmethod
+    def encode_batch(bases_dev, new_dev,
+                     level: int = 1) -> List[Tuple[List[np.ndarray], Dict]]:
+        """Encode the same ``new`` against E stacked bases in batched
+        dispatches (vmap over the base axis) — one stats launch and one
+        compaction launch for the whole cohort instead of E host loops.
+        Frames are identical to E sequential :meth:`encode` calls."""
+        new = jnp.asarray(new_dev)
+        bases = jnp.stack([jnp.asarray(b) for b in bases_dev])
+        n_bases = int(bases.shape[0])
+        dim = int(new.shape[0])
+        dtype = np.dtype(str(new.dtype))
+        stats = np.asarray(_stats_batch_jit(bases, new))
+        counts = [int(c) for c in stats[:, 0]]
+        lasts = [int(v) for v in stats[:, 1]]
+        raw_cost = dim * dtype.itemsize
+
+        # one vmapped compaction dispatch sized for the widest sparse frame
+        need_compact = [i for i, c in enumerate(counts)
+                        if 0 < c * (4 + dtype.itemsize) < raw_cost]
+        idx_b = bits_b = None
+        if need_compact:
+            size = _bucket(max(counts[i] for i in need_compact), dim)
+            idx_b, bits_b = _compact_batch_jit(bases, new, size)
+        xor_b = None
+
+        out: List[Tuple[List[np.ndarray], Dict]] = []
+        for i in range(n_bases):
+            count = counts[i]
+
+            def make_xor(i=i):
+                nonlocal xor_b
+                if xor_b is None:
+                    xor_b = _xor_batch_jit(bases, new)
+                return zlib.compress(host_view(xor_b[i]), level)
+
+            scheme, xor_comp = plan_frame(
+                raw_cost, dtype.itemsize, count, max(lasts[i], 0), make_xor)
+            meta = {"dim": dim, "dtype": dtype.str, "scheme": scheme}
+            if scheme == "sparse":
+                if count == 0:
+                    arrays = [np.empty(0, np.int32), np.empty(0, dtype)]
+                else:
+                    arrays = [host_view(idx_b[i])[:count],
+                              host_view(bits_b[i])[:count].view(dtype)]
+            elif scheme == "xorz":
+                arrays = [np.frombuffer(xor_comp, dtype=np.uint8)]
+            else:
+                arrays = [host_view(new)]
+            out.append((arrays, meta))
+        return out
+
+    @staticmethod
+    def decode(base_dev, arrays: Sequence[np.ndarray], meta: Dict):
+        base = jnp.asarray(base_dev)
+        dim = int(meta["dim"])
+        dtype = np.dtype(meta["dtype"])
+        if base.shape != (dim,) or str(base.dtype) != str(dtype):
+            raise ValueError(
+                f"device delta codec: base {base.dtype}{base.shape} does not "
+                f"match frame ({dtype}, dim {dim})"
+            )
+        scheme = meta.get("scheme")
+        if scheme == "sparse":
+            # the uploads ARE the (unavoidable) wire→device crossing; the
+            # scatter itself happens in the bit domain on device
+            idx = jnp.asarray(_as_host(arrays[0]))
+            vals = jnp.asarray(_as_host(arrays[1]))
+            return _scatter_jit(base, idx, _bits_of(vals))
+        if scheme == "xorz":
+            xor = np.frombuffer(zlib.decompress(_as_host(arrays[0])),
+                                dtype=_BIT_VIEWS[dtype.itemsize])
+            return _xor_apply_jit(base, jnp.asarray(xor))
+        if scheme == "raw":
+            return jnp.asarray(_as_host(arrays[0]))
+        raise ValueError(f"device delta codec: unknown scheme {scheme!r}")
+
+
+class WireCodec:
+    """The wire-path facade every encode/decode call site goes through.
+
+    Owns the resolved ``--wire_path`` choice, the per-call host-fallback
+    rules, and the ``comm.wire.*`` telemetry family:
+
+    - ``comm.wire.encode_s`` / ``comm.wire.decode_s`` — per-call histograms;
+    - ``comm.wire.device_encodes`` / ``comm.wire.device_decodes`` — calls
+      served by the device kernels;
+    - ``comm.wire.host_fallbacks`` — device-path calls that had to degrade
+      (unsupported dtype/dim, JAX-less process);
+    - ``comm.wire.host_bytes_copied`` — bytes materialized by non-dlpack
+      transfers (zero on the healthy path).
+
+    Frames out of ``encode`` are byte-identical whichever path serves the
+    call — the path knob is a performance choice, never a protocol one
+    (``delivery_identity`` excludes it on purpose).
+    """
+
+    def __init__(self, path: str = "auto", scoped=None):
+        self.requested = str(path or "auto")
+        self.path = resolve_wire_path(self.requested)
+        # ONE metrics sink: the world-scoped telemetry when a serving-plane
+        # owner hands one in (graftiso I002), else the process default —
+        # never both (the default scope wraps the same global registry;
+        # double-emitting would double-count loopback worlds)
+        self._scoped = scoped
+
+    # -- helpers -------------------------------------------------------------
+
+    def _emit(self, name: str, value: float, kind: str = "counter") -> None:
+        sink = self._scoped if self._scoped is not None else telemetry
+        if kind == "observe":
+            sink.observe(name, value)
+        else:
+            sink.counter_inc(name, value)
+
+    def _use_device(self, dtype, dim: int) -> bool:
+        if self.path != "device":
+            return False
+        if device_supported(dtype, dim):
+            return True
+        self._emit("comm.wire.host_fallbacks", 1.0)
+        return False
+
+    # -- codec surface -------------------------------------------------------
+
+    def encode(self, base_vec, new_vec,
+               level: int = 1) -> Tuple[List[np.ndarray], Dict]:
+        dim = int(getattr(new_vec, "shape", (len(new_vec),))[0])
+        dtype = getattr(new_vec, "dtype", np.dtype(np.float32))
+        t0 = time.perf_counter()
+        if self._use_device(dtype, dim):
+            out = DeviceDeltaCodec.encode(base_vec, new_vec, level=level)
+            self._emit("comm.wire.device_encodes", 1.0)
+        else:
+            out = DeltaCodec.encode(base_vec, new_vec, level=level)
+        self._emit("comm.wire.encode_s", time.perf_counter() - t0, "observe")
+        return out
+
+    def encode_batch(self, bases, new_vec,
+                     level: int = 1) -> List[Tuple[List[np.ndarray], Dict]]:
+        """Batched per-cohort encode over distinct ACKed bases. Falls back
+        to sequential host encodes off the device path."""
+        bases = list(bases)
+        if not bases:
+            return []
+        dim = int(getattr(new_vec, "shape", (len(new_vec),))[0])
+        dtype = getattr(new_vec, "dtype", np.dtype(np.float32))
+        t0 = time.perf_counter()
+        if len(bases) > 1 and self._use_device(dtype, dim):
+            out = DeviceDeltaCodec.encode_batch(bases, new_vec, level=level)
+            self._emit("comm.wire.device_encodes", float(len(bases)))
+        elif self._use_device(dtype, dim):
+            out = [DeviceDeltaCodec.encode(bases[0], new_vec, level=level)]
+            self._emit("comm.wire.device_encodes", 1.0)
+        else:
+            out = [DeltaCodec.encode(b, new_vec, level=level) for b in bases]
+        self._emit("comm.wire.encode_s", time.perf_counter() - t0, "observe")
+        return out
+
+    def decode(self, base_vec, arrays: Sequence[np.ndarray], meta: Dict):
+        """Reconstruct the new vector. On the device path the result is a
+        DEVICE array (ready for ``tree_unflatten_from_vector``); host path
+        returns numpy — both bitwise-identical to the encoded vector."""
+        dim = int(meta["dim"])
+        dtype = np.dtype(meta["dtype"])
+        t0 = time.perf_counter()
+        if self._use_device(dtype, dim):
+            out = DeviceDeltaCodec.decode(base_vec, arrays, meta)
+            self._emit("comm.wire.device_decodes", 1.0)
+        else:
+            out = DeltaCodec.decode(host_view(base_vec) if not isinstance(
+                base_vec, np.ndarray) else base_vec, arrays, meta)
+        self._emit("comm.wire.decode_s", time.perf_counter() - t0, "observe")
+        return out

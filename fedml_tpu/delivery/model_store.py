@@ -52,6 +52,14 @@ class VersionedModelStore:
     ``metric_prefix`` namespaces the counters per wire end
     (``comm.delta.server_store.*`` vs ``comm.delta.client_store.*``): in
     loopback worlds both ends share one process-wide registry.
+
+    The device wire path (``delivery/device_codec.py``) additionally keeps a
+    **device-resident copy of ring heads**: :meth:`get_device` uploads a
+    version's vector at most once and every subsequent encode against that
+    base reads the cached device buffer — bases never re-upload per fan-out.
+    ``put(..., device=...)`` seeds the cache directly with a buffer the
+    caller already holds on device (the server stores the global it just
+    encoded with). Eviction drops the device copy with the host entry.
     """
 
     def __init__(self, capacity: int = 8,
@@ -63,22 +71,28 @@ class VersionedModelStore:
         self.metric_prefix = str(metric_prefix)
         self._lock = threading.Lock()
         self._entries: Dict[int, Tuple[np.ndarray, str]] = {}
+        self._device: Dict[int, object] = {}
         self._evictions = 0
 
     # -- write side ---------------------------------------------------------
 
-    def put(self, version: int, vec) -> str:
+    def put(self, version: int, vec, device=None) -> str:
         """Store ``vec`` under ``version``; returns the content digest.
-        Oldest versions beyond ``capacity`` are evicted and counted."""
+        Oldest versions beyond ``capacity`` are evicted and counted.
+        ``device`` optionally seeds the device-resident cache with an
+        already-uploaded copy of the same vector."""
         version = int(version)
         vec = np.array(np.asarray(vec), copy=True)  # detach from wire views
         digest = vector_digest(vec)
         evicted = 0
         with self._lock:
             self._entries[version] = (vec, digest)
+            if device is not None:
+                self._device[version] = device
             while len(self._entries) > self.capacity:
                 oldest = min(self._entries)
                 del self._entries[oldest]
+                self._device.pop(oldest, None)
                 evicted += 1
             self._evictions += evicted
             occupancy = len(self._entries)
@@ -105,6 +119,38 @@ class VersionedModelStore:
             return None
         telemetry.counter_inc(f"{self.metric_prefix}.hits")
         return entry[0]
+
+    def get_device(self, version):
+        """Device-resident copy of the stored vector for ``version`` (or
+        None) — uploaded AT MOST ONCE per version, then served from the
+        cache so encode bases never re-cross the host/device boundary.
+        Same READ-ONLY contract (and hit/miss accounting) as :meth:`get`.
+        Falls back to the host array when JAX is unavailable."""
+        if version is None:
+            telemetry.counter_inc(f"{self.metric_prefix}.misses")
+            return None
+        version = int(version)
+        with self._lock:
+            dev = self._device.get(version)
+            entry = self._entries.get(version)
+        if dev is not None:
+            telemetry.counter_inc(f"{self.metric_prefix}.hits")
+            return dev
+        if entry is None:
+            telemetry.counter_inc(f"{self.metric_prefix}.misses")
+            return None
+        try:
+            import jax.numpy as jnp
+            dev = jnp.asarray(entry[0])
+            telemetry.counter_inc(f"{self.metric_prefix}.device_uploads")
+        except Exception:
+            dev = entry[0]
+        with self._lock:
+            # only cache if the version is still resident (racing eviction)
+            if version in self._entries:
+                self._device[version] = dev
+        telemetry.counter_inc(f"{self.metric_prefix}.hits")
+        return dev
 
     def has(self, version) -> bool:
         with self._lock:

@@ -178,10 +178,12 @@ class SwarmClientManager(FedMLCommManager):
         self._store = None
         self._leaf_meta: Optional[List] = None
         if self._delta_on:
-            from ..delivery import VersionedModelStore
+            from ..delivery import VersionedModelStore, WireCodec
 
             self._store = VersionedModelStore(
                 4, metric_prefix="swarm.delta_store")
+            self._wire = WireCodec(getattr(args, "wire_path", "auto"),
+                                   scoped=self.world.telemetry)
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -218,20 +220,29 @@ class SwarmClientManager(FedMLCommManager):
         store; delta frames decode against the stored base (or trigger the
         ONLINE resync when that base is gone)."""
         from ..delivery import flatten_leaves
-        from ..delivery.delta_codec import DeltaCodec
+        from ..delivery.device_codec import host_view
 
         if dmeta is None:
             self._leaf_meta = [(np.asarray(a).shape, np.asarray(a).dtype)
                                for a in arrays]
             self._store.put(version, flatten_leaves(arrays))
             return list(arrays)
-        base = self._store.get(int(dmeta["base_version"]))
+        on_device = self._wire.path == "device"
+        base = (self._store.get_device(int(dmeta["base_version"]))
+                if on_device else self._store.get(int(dmeta["base_version"])))
         if base is None or self._leaf_meta is None:
             self.world.telemetry.counter_inc("swarm.delta_base_missing")
             self._announce_online()
             return None
-        vec = DeltaCodec.decode(base, arrays, dmeta)
-        self._store.put(version, vec)
+        vec = self._wire.decode(base, arrays, dmeta)
+        if isinstance(vec, np.ndarray):
+            self._store.put(version, vec)
+        else:
+            # device decode: keep the device buffer as the next base and
+            # slice the per-leaf views off the (dlpack) host view
+            dev = vec
+            vec = host_view(dev, scoped=self.world.telemetry)
+            self._store.put(version, vec, device=dev)
         self.world.telemetry.counter_inc("swarm.delta_decodes")
         out, off = [], 0
         for shape, dtype in self._leaf_meta:
@@ -437,6 +448,10 @@ def _s2c_delta(a) -> str:
     return str(getattr(a, "s2c_delta", "off") or "off").lower()
 
 
+def _wire_path(a) -> str:
+    return str(getattr(a, "wire_path", "auto") or "auto").lower()
+
+
 def _server_overrides(a) -> Dict:
     return dict(
         training_type="cross_silo", dataset="synthetic", model="lr",
@@ -446,6 +461,7 @@ def _server_overrides(a) -> Dict:
         random_seed=int(a.seed), role="server", rank=0,
         run_id=str(a.run_id),
         s2c_delta=_s2c_delta(a),
+        wire_path=_wire_path(a),
         aggregation_mode="async",
         async_buffer_size=int(a.buffer),
         async_staleness_alpha=float(a.staleness_alpha),
@@ -471,6 +487,7 @@ def _device_args(a, rank: int, backend: str):
         comm_round=int(a.steps), role="client", rank=int(rank),
         run_id=str(a.run_id), backend=backend,
         random_seed=int(a.seed),
+        wire_path=_wire_path(a),
     )
     if backend == constants.COMM_BACKEND_GRPC:
         overrides.update(
@@ -584,6 +601,7 @@ def swarm_soak(a) -> Dict:
                     "--procs", str(a.procs),
                     "--ranks_per_port", str(_ranks_per_port(a)),
                     "--s2c_delta", _s2c_delta(a),
+                    "--wire_path", _wire_path(a),
                 ))
                 base += count
 
@@ -658,6 +676,13 @@ def swarm_soak(a) -> Dict:
         "s2c_delta_frames": counters.get("comm.delta.s2c_delta_frames",
                                          0.0),
         "s2c_full_frames": counters.get("comm.delta.s2c_full_frames", 0.0),
+        # wire path (docs/delivery.md device-direct): which codec served
+        # the server's encodes, and whether the device kernels engaged
+        "wire_path": _wire_path(a),
+        "wire_device_encodes": counters.get("comm.wire.device_encodes", 0.0),
+        "wire_device_decodes": (None if grpc_mode else counters.get(
+            "comm.wire.device_decodes", 0.0)),
+        "wire_host_fallbacks": counters.get("comm.wire.host_fallbacks", 0.0),
         "swarm_delta_decodes": (None if grpc_mode else
                                 counters.get("swarm.delta_decodes", 0.0)),
         "devices_finished": (

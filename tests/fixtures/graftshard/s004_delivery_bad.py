@@ -10,7 +10,9 @@ class HostDeltaCodec:
     def encode(base_vec, new_vec):
         base = np.asarray(base_vec)
         new = np.asarray(new_vec)
-        return [new - base], {"dim": int(new.shape[0])}
+        frame = np.ascontiguousarray(new_vec)
+        wire = (base ^ new).tobytes()
+        return [frame, wire], {"dim": int(new.shape[0])}
 
     @staticmethod
     def decode(base_vec, arrays, meta):

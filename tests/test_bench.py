@@ -47,6 +47,10 @@ def _tpu_runner(argv, timeout):
                 "million_cohort_k": 10000, "million_prefetch_overlap": 0.9,
                 "million_steady_compiles": 0, "platform": "tpu",
                 "device_kind": "TPU v5 lite"}
+    if "--leg wire" in joined:
+        return {"wire_host_cpu_reduction_x": 3.3, "wire_parity": True,
+                "wire_soak_ok": True, "wire_frame_mb": 16.0,
+                "platform": "tpu", "device_kind": "TPU v5 lite"}
     if "--leg compressed" in joined:
         return {"compressed_reduction_x": 11.6, "compressed_acc": 0.999,
                 "uncompressed_acc": 1.0, "compressed_bytes_per_round": 22000.0,

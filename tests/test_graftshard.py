@@ -102,24 +102,28 @@ class TestRuleFixtures:
         of codec inputs inside delivery-module encode/decode paths."""
         fs = _findings("s004_delivery_bad.py")
         assert {f.rule for f in fs} == {"S004"}
-        # 11/12: encode inputs; 17/18: decode base + frame
-        assert _rule_lines(fs, "S004") == [11, 12, 17, 18]
+        # 11/12: encode inputs; 13: ascontiguousarray materialization;
+        # 14: tobytes frame copy; 19/20: decode base + frame
+        assert _rule_lines(fs, "S004") == [11, 12, 13, 14, 19, 20]
         assert all("delivery-plane" in f.message for f in fs)
 
     def test_s004_delivery_prong_good(self):
-        """Pragma'd allowance + non-codec helpers stay silent."""
+        """Pragma'd allowance, non-codec helpers, and the device-direct
+        idiom (module-helper conversions + memoryview emission) stay
+        silent."""
         assert _findings("s004_delivery_good.py") == []
 
-    def test_delta_codec_allowances_visible(self):
-        """The real host codec ships pragma'd S004 allowances — visible
-        inventory for the device-direct wire path, not silent debt."""
-        src = open(os.path.join(
-            REPO_ROOT, "fedml_tpu", "delivery", "delta_codec.py")).read()
-        assert src.count("graftshard: disable=S004") >= 7
-        fs = analyze_paths([os.path.join(REPO_ROOT, "fedml_tpu",
-                                         "delivery", "delta_codec.py")],
-                           repo_root=REPO_ROOT)
-        assert fs == [], [f.render() for f in fs]
+    def test_delta_codec_has_no_allowances(self):
+        """The device-direct wire path deleted the host codec's pragma'd
+        S004 allowances — the codec surface (host reference AND device
+        kernels) must now be clean with ZERO pragmas, not pragma'd debt."""
+        for fname in ("delta_codec.py", "device_codec.py"):
+            path = os.path.join(
+                REPO_ROOT, "fedml_tpu", "delivery", fname)
+            src = open(path).read()
+            assert src.count("graftshard: disable=S004") == 0, fname
+            fs = analyze_paths([path], repo_root=REPO_ROOT)
+            assert fs == [], [f.render() for f in fs]
 
 
 class TestSuppression:

@@ -21,9 +21,11 @@ trap 'rm -rf "$track_dir"' EXIT
 out=$(timeout -k 10 240 env \
     BENCH_PLATFORM=cpu \
     BENCH_SMOKE=1 \
-    BENCH_LEGS=fedavg,fedavg_million_client,fedavg_compressed_round \
+    BENCH_LEGS=fedavg,fedavg_million_client,fedavg_compressed_round,fedavg_wire \
     BENCH_REGISTRY_N=20000 \
     BENCH_COHORT_K=256 \
+    BENCH_WIRE_DIM=262144 \
+    BENCH_WIRE_REPS=3 \
     BENCH_BUDGET_S=220 \
     BENCH_MIN_LEG_S=5 \
     BENCH_LEG_TIMEOUT_S=100 \
@@ -111,6 +113,21 @@ assert line.get("compressed_reduction_x", 0) >= 10.0, line
 acc_drop = line.get("uncompressed_acc", 1) - line.get("compressed_acc", 0)
 assert acc_drop <= 0.05, f"accuracy not at parity: {line}"
 
+# device-direct wire leg (fedml_tpu/delivery/device_codec.py, docs/
+# delivery.md): the device kernels must ENGAGE (nonzero device encodes +
+# decodes, zero host fallbacks in the soak) and the frames must be
+# byte-identical to the host codec (the leg raises on divergence, so
+# wire_parity present+true == the gate actually ran)
+assert "fedavg_wire_error" not in line, line
+assert "fedavg_wire_skipped" not in line, line
+assert line.get("wire_parity") is True, line
+assert line.get("wire_soak_ok") is True, line
+assert line.get("wire_soak_device_encodes", 0) > 0, line
+assert line.get("wire_soak_device_decodes", 0) > 0, line
+assert line.get("wire_soak_host_fallbacks", -1) == 0, line
+assert line.get("wire_host_cpu_ms_per_mb", {}).get("device_delta", 0) > 0, \
+    line
+
 print("bench_smoke: OK —",
       f"{line['fedavg_cpu_smoke_rounds_per_sec']:.2f} rounds/s,",
       f"compile {line.get('fedavg_compile_s', '?')}s,",
@@ -122,5 +139,7 @@ print("bench_smoke: OK —",
       f"delta {line['compressed_reduction_x']:.1f}x bytes",
       f"(acc {line['compressed_acc']:.3f} vs"
       f" {line['uncompressed_acc']:.3f}),",
+      f"wire {line['wire_host_cpu_reduction_x']:.1f}x host-CPU",
+      f"({line['wire_soak_device_encodes']} dev encodes),",
       f"{len(records)} round records, {samples} metric samples")
 EOF

@@ -306,13 +306,15 @@ def _check_host_transfers(mod: ModuleInfo, fi: FuncInfo) -> List[Finding]:
 
 def _check_delivery_codec(mod: ModuleInfo, fi: FuncInfo) -> List[Finding]:
     """S004, delivery-plane prong (ROADMAP device-direct wire path): the
-    delta plane's ``encode``/``decode`` stages every frame through host
-    memory — ``np.asarray``/``np.array``/``np.frombuffer`` on a codec
-    input is the host round-trip the device-direct item removes (jit'd
-    elementwise kernels + dlpack into the raw-frame writer). Scoped to
+    delta plane's ``encode``/``decode`` must not stage frames through host
+    memory — ``np.asarray``/``np.array``/``np.frombuffer``/
+    ``np.ascontiguousarray`` on a codec input is the host round-trip the
+    device-direct wire path removed (jit'd kernels + dlpack emission), and
+    ANY ``.tobytes()`` inside a codec stage is a full-frame byte
+    materialization (the raw-frame writer takes zero-copy memoryviews —
+    a tobytes can only be a regression hiding in a hot path). Scoped to
     modules under the delivery plane (``delivery`` in the module path) so
-    the finding inventory is exactly the codec surface; the current host
-    codec carries per-line pragma'd allowances until it goes on-device."""
+    the finding inventory is exactly the codec surface."""
     if "delivery" not in mod.name or fi.name not in ("encode", "decode"):
         return []
     params = set(fi.params())
@@ -321,10 +323,24 @@ def _check_delivery_codec(mod: ModuleInfo, fi: FuncInfo) -> List[Finding]:
     for node in _walk_shallow(fi.node):
         if not isinstance(node, ast.Call):
             continue
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "tobytes"
+                and not node.args
+                and node.lineno not in seen_lines):
+            seen_lines.add(node.lineno)
+            findings.append(_mk(
+                "S004", mod, node.lineno,
+                f"`.tobytes()` inside delivery-plane `{fi.qualname}` "
+                "materializes a full frame copy on host — the raw-frame "
+                "writer takes zero-copy memoryviews/buffer-protocol "
+                "objects; pass the array (or a dlpack host view) through "
+                "instead (ROADMAP device-direct wire path)"))
+            continue
         ds = dotted(node.func)
         parts = ds.split(".") if ds else []
         if not (len(parts) > 1
-                and parts[-1] in ("asarray", "array", "frombuffer")
+                and parts[-1] in ("asarray", "array", "frombuffer",
+                                  "ascontiguousarray")
                 and _is_numpy(mod, parts[0])):
             continue
         arg = node.args[0] if node.args else None

@@ -14,6 +14,11 @@
 #     (s2c_delta=auto): every device-host process must exit 0, delta
 #     frames must actually flow (comm.delta.s2c_delta_frames > 0), and the
 #     verdict reports p99 dispatch→ready next to the loopback leg's.
+#  leg 4 (device wire): the delta-plane soak again with --wire_path device
+#     (docs/delivery.md device-direct wire path): the jit'd codec kernels
+#     must serve the soak's encodes AND decodes (nonzero
+#     comm.wire.device_encodes / device_decodes, ZERO host fallbacks)
+#     while every step still completes — same protocol, different engine.
 #
 # This is the executable form of the traffic-plane contract;
 # tests/test_traffic.py is the fine-grained half.
@@ -116,5 +121,36 @@ print("swarm_smoke: grpc+delta OK —",
       f"(loopback leg: {1e3 * p99_l:.1f}ms)")
 EOF
 [ $? -ne 0 ] && { echo "swarm_smoke: FAIL — grpc+delta verdict" >&2; exit 1; }
+
+wire=$(run_leg --clients 12 --steps 4 --buffer 6 --think_s 0.02 \
+    --s2c_delta auto --wire_path device --seed 7 --timeout 180 \
+    --run_id swarm-smoke-wire)
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "swarm_smoke: FAIL — device-wire leg exited rc=$rc" >&2
+    printf '%s\n' "$wire" >&2
+    exit 1
+fi
+
+python - "$wire" <<'EOF'
+import json
+import sys
+
+r = json.loads(sys.argv[1])
+assert r["ok"], r
+assert r["wire_path"] == "device", r
+assert r["steps_completed"] == r["steps_requested"], r
+assert r["s2c_delta_frames"] > 0, r
+# the device kernels actually served the wire: encodes on the server,
+# decodes on every delta-framed dispatch, and never a silent host fallback
+assert r["wire_device_encodes"] > 0, r
+assert r["wire_device_decodes"] > 0, r
+assert r["wire_host_fallbacks"] == 0, r
+print("swarm_smoke: device-wire OK —",
+      f"{r['clients']} devices, {r['s2c_delta_frames']:.0f} delta frames,",
+      f"{r['wire_device_encodes']:.0f} dev encodes /",
+      f"{r['wire_device_decodes']:.0f} dev decodes, 0 fallbacks")
+EOF
+[ $? -ne 0 ] && { echo "swarm_smoke: FAIL — device-wire verdict" >&2; exit 1; }
 
 echo "swarm_smoke: PASS"
