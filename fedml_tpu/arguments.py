@@ -82,6 +82,18 @@ _SCHEMA: Dict[str, tuple] = {
     # tracking / telemetry (core/mlops/telemetry.py)
     "enable_tracking": (bool, False),
     "tracking_dir": (str, ""),  # JSONL event sink dir (default .fedml_tpu_runs)
+    # write-behind JSONL sink drain interval (core/mlops/__init__.py):
+    # events buffer in memory and hit the disk every this-many seconds
+    # (or at 256 buffered events, or at shutdown). 0 = flush per event.
+    "tracking_flush_s": (float, 0.5),
+    # distributed tracing (core/mlops/tracing.py, docs/tracing.md):
+    # cross-process causal spans + flight recorder. trace_sample is the
+    # deterministic per-round sampling probability for soak-scale runs;
+    # trace_dir overrides where flight-recorder post-mortems land
+    # (default: tracking_dir).
+    "enable_tracing": (bool, False),
+    "trace_sample": (float, 1.0),
+    "trace_dir": (str, ""),
     "enable_wandb": (bool, False),
     # Prometheus-style text exposition of the metrics registry, refreshed
     # during the run and at exit. Empty = no file.
@@ -378,6 +390,12 @@ class Arguments:
                              "resync_backoff_max_s", "resync_max_attempts"):
             if float(getattr(self, non_negative, 0) or 0) < 0:
                 raise ValueError(f"{non_negative} must be >= 0")
+        if float(getattr(self, "tracking_flush_s", 0.5) or 0) < 0:
+            raise ValueError("tracking_flush_s must be >= 0")
+        sample = float(getattr(self, "trace_sample", 1.0) or 0.0)
+        if not 0.0 <= sample <= 1.0:
+            raise ValueError(
+                f"trace_sample must be in [0, 1], got {sample}")
         # delta delivery plane (docs/delivery.md)
         scheme = str(getattr(self, "compression", "") or "").lower()
         if scheme not in COMPRESSION_SCHEMES:
@@ -661,6 +679,23 @@ def add_args() -> argparse.Namespace:
     parser.add_argument(
         "--sys_perf_interval_s", type=float, default=None,
         help="sample host CPU/RSS + HBM every N seconds (0 = off)",
+    )
+    parser.add_argument(
+        "--tracking_flush_s", type=float, default=None, metavar="S",
+        help="write-behind JSONL sink drain interval (0 = per-event)",
+    )
+    parser.add_argument(
+        "--enable_tracing", action="store_true", default=None,
+        help="cross-process causal spans + crash flight recorder "
+        "(docs/tracing.md); implies a JSONL sink for span records",
+    )
+    parser.add_argument(
+        "--trace_sample", type=float, default=None, metavar="P",
+        help="deterministic per-round trace sampling probability in [0,1]",
+    )
+    parser.add_argument(
+        "--trace_dir", type=str, default=None,
+        help="flight-recorder post-mortem dir (default: tracking dir)",
     )
     args, _ = parser.parse_known_args()
     return args

@@ -85,6 +85,14 @@ def _world_overrides(a) -> Dict:
         over.update(compression=scheme,
                     compression_ratio=float(
                         getattr(a, "compression_ratio", 0.1)))
+    tdir = str(getattr(a, "trace_dir", "") or "")
+    if tdir:
+        # traced leg (server-kill chaos runs set this): spans persist
+        # through the JSONL sink into the shared trace dir, and the flight
+        # recorder's pre-SIGKILL flush lands its post-mortem there too —
+        # the orchestrator's verdict reads both
+        over.update(enable_tracing=True, trace_sample=1.0, trace_dir=tdir,
+                    enable_tracking=True, tracking_dir=tdir)
     return over
 
 
@@ -159,6 +167,7 @@ def client_proc_cmd(a, rank: int, port: int,
         "--heartbeat_s", str(hb),
         "--compression", str(getattr(a, "compression", "") or ""),
         "--compression_ratio", str(getattr(a, "compression_ratio", 0.1)),
+        "--trace_dir", str(getattr(a, "trace_dir", "") or ""),
     )
     if kill_phase:
         # turns the client liveness/resync FSM on (matching the
@@ -371,6 +380,7 @@ def _worker_cmd(a, out: str, ckpt_dir: str, kill_round: int,
         "--transport", str(getattr(a, "transport", "loopback")),
         "--compression", str(getattr(a, "compression", "") or ""),
         "--compression_ratio", str(getattr(a, "compression_ratio", 0.1)),
+        "--trace_dir", str(getattr(a, "trace_dir", "") or ""),
     ]
     if server_only:
         cmd += ["--server-only", "--port", str(port)]
@@ -429,6 +439,16 @@ def orchestrate(a) -> int:
 
     kill_round = int(a.kill_round)
     kill_phase = _kill_phase(a)
+    if kill_phase:
+        # server-kill legs run traced: the pre-SIGKILL flight-recorder
+        # flush must leave a post-mortem naming the kill phase, and the
+        # killed + restarted legs' spans must merge orphan-free. Resolved
+        # onto the namespace so _worker_cmd and client_proc_cmd (both
+        # read ``a.trace_dir``) ship the SAME dir to every process. The
+        # reference leg ran above, untraced — tracing must never be a
+        # parity variable.
+        a.trace_dir = (str(getattr(a, "trace_dir", "") or "")
+                       or os.path.join(workdir, "trace"))
     grpc_failover = (kill_phase and str(
         getattr(a, "transport", "loopback")).lower() == "grpc")
     client_spawner = None
@@ -551,6 +571,13 @@ def orchestrate(a) -> int:
     if bad_cohorts:
         problems.append(f"rounds aggregated a partial cohort: {bad_cohorts}")
 
+    flight_verdict = None
+    trace_spans = None
+    trace_orphans = None
+    if kill_phase:
+        flight_verdict, trace_spans, trace_orphans = _trace_verdict(
+            str(a.trace_dir), kill_phase, kill_round, problems)
+
     verdict = {
         "ok": not problems,
         "parity": not any("leaf" in p or "arity" in p for p in problems),
@@ -566,9 +593,63 @@ def orchestrate(a) -> int:
                                           or "") or None},
         "problems": problems,
         "workdir": workdir,
+        "flight_recorder": flight_verdict,
+        "trace_spans": trace_spans,
+        "trace_orphans": trace_orphans,
     }
     print(json.dumps(verdict, indent=2, sort_keys=True))
     return 0 if verdict["ok"] else 1
+
+
+def _trace_verdict(trace_dir: str, kill_phase: str, kill_round: int,
+                   problems: List[str]):
+    """Traced kill-leg verdict half: (a) a pre-SIGKILL flight-recorder
+    post-mortem exists and its last phase mark names EXACTLY the armed
+    kill phase+round; (b) the killed and restarted legs' spans merge into
+    one orphan-free trace (flight rings recover the dead process's tail).
+    Appends failures to ``problems``; returns the verdict fields."""
+    import glob as glob_mod
+
+    from fedml_tpu.core.mlops import tracing
+
+    flight = None
+    for path in sorted(glob_mod.glob(
+            os.path.join(trace_dir, "flight_*_rank_0.json"))):
+        try:
+            with open(path, encoding="utf-8") as f:
+                post = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if str(post.get("reason", "")).startswith("kill_server:"):
+            flight = post
+            break
+    flight_verdict: Optional[Dict] = None
+    if flight is None:
+        problems.append(
+            "no pre-SIGKILL flight-recorder post-mortem in trace dir")
+    else:
+        last = flight.get("last_phase") or {}
+        flight_verdict = {"reason": flight.get("reason"),
+                          "phase": last.get("phase"),
+                          "round": last.get("round"),
+                          "open_spans": len(flight.get("open_spans") or [])}
+        if last.get("phase") != kill_phase:
+            problems.append(
+                f"post-mortem names phase {last.get('phase')!r}, "
+                f"expected {kill_phase!r}")
+        elif int(last.get("round", -1)) != int(kill_round):
+            problems.append(
+                f"post-mortem names round {last.get('round')}, "
+                f"expected {kill_round}")
+    spans, clocks = tracing.read_trace(
+        tracing.collect_trace_files(trace_dir))
+    merged = tracing.merge_trace(spans, clocks)
+    if not merged["spans"]:
+        problems.append("traced kill leg produced no spans")
+    if merged["orphans"]:
+        problems.append(
+            f"merged trace has orphan spans: {merged['orphans'][:5]}")
+    return flight_verdict, len(merged["spans"]), len(merged["orphans"])
 
 
 def run_client_worker(a) -> int:

@@ -187,7 +187,35 @@ def cmd_top(args) -> int:
         _print_delta_summary(metrics)
         _print_wire_summary(metrics)
         _print_recovery_summary(metrics)
+    _print_trace_summary(events)
     return 0
+
+
+def _print_trace_summary(events: list) -> None:
+    """The distributed-tracing story (docs/tracing.md): where the gating
+    milliseconds of each round went (critical-path segment shares) and
+    which clients gated rounds (straggler top-k). Reads the ``trace_span``
+    records riding the same JSONL file; silent when the run was untraced."""
+    from .core.mlops import tracing
+
+    spans = [e for e in events
+             if e.get("kind") == tracing.SPAN_KIND and "span" in e]
+    if not spans:
+        return
+    clocks = [e for e in events if e.get("kind") == tracing.CLOCK_KIND]
+    merged = tracing.merge_trace(spans, clocks)
+    shares = tracing.critical_path_shares(merged)
+    total = sum(shares.values())
+    print(f"\ntrace (critical path over {len(merged['rounds'])} rounds, "
+          f"{len(merged['spans'])} spans):")
+    for name, dur in sorted(shares.items(), key=lambda kv: -kv[1]):
+        pct = 100.0 * dur / total if total else 0.0
+        print(f"  {name:<18} {dur:>9.4f}s {pct:>6.1f}%")
+    stragglers = tracing.straggler_attribution(merged, k=5)
+    if stragglers:
+        print("  stragglers: " + "   ".join(
+            f"client {s['client']} (+{s['wait_s']:.3f}s, "
+            f"gated {s['rounds_gated']})" for s in stragglers))
 
 
 def _print_wire_summary(metrics: dict) -> None:
@@ -310,6 +338,79 @@ def _print_traffic_summary(metrics: dict) -> None:
         print(f"  {label}: p50 {h['p50']:.3f}{unit}   "
               f"p95 {h['p95']:.3f}{unit}   p99 {h['p99']:.3f}{unit} "
               f"(n={h['count']:.0f})")
+
+
+def cmd_trace(args) -> int:
+    """Merge a federation's per-process span files into ONE clock-aligned
+    causal trace (docs/tracing.md): collect every run JSONL sink + flight-
+    recorder post-mortem in the trace dir, align each process's monotonic
+    timeline (heartbeat probe offsets, wall-anchor fallback), and print the
+    per-round critical path, segment shares, and straggler attribution —
+    or export Chrome trace-event JSON for Perfetto (``--chrome``)."""
+    from .core.mlops import tracing
+
+    trace_dir = args.dir or ".fedml_tpu_runs"
+    files = tracing.collect_trace_files(trace_dir,
+                                        run_id=args.run_id or None)
+    if not files:
+        print(f"no trace files in {trace_dir} "
+              "(run with --enable_tracing + --enable_tracking)")
+        return 1
+    spans, clocks = tracing.read_trace(files)
+    merged = tracing.merge_trace(spans, clocks)
+    if not merged["spans"]:
+        print(f"{len(files)} files in {trace_dir} but no trace_span "
+              "records (was the run traced?)")
+        return 1
+    if args.chrome:
+        with open(args.chrome, "w", encoding="utf-8") as f:
+            json.dump(tracing.to_chrome(merged), f)
+    shares = tracing.critical_path_shares(merged)
+    stragglers = tracing.straggler_attribution(merged, k=args.top)
+    round_idx = (args.round if args.round >= 0
+                 else (merged["rounds"][-1] if merged["rounds"] else -1))
+    path = tracing.critical_path(merged, round_idx) if round_idx >= 0 else []
+    if args.json:
+        print(json.dumps({
+            "files": len(files), "spans": len(merged["spans"]),
+            "procs": [list(p) for p in merged["procs"]],
+            "rounds": merged["rounds"], "orphans": merged["orphans"],
+            "critical_path_round": round_idx,
+            "critical_path": path,
+            "critical_path_segments": shares,
+            "stragglers": stragglers,
+        }, indent=2, sort_keys=True))
+        return 0
+    print(f"trace dir: {trace_dir}   files: {len(files)}")
+    print(f"spans: {len(merged['spans'])}   "
+          f"processes: {len(merged['procs'])}   "
+          f"rounds: {len(merged['rounds'])}   "
+          f"orphans: {len(merged['orphans'])}")
+    if args.chrome:
+        print(f"chrome trace: {args.chrome} "
+              "(load in Perfetto or chrome://tracing)")
+    if path:
+        print(f"\ncritical path (round {round_idx}):")
+        for seg in path:
+            who = (f"client {seg['client']}" if seg.get("client") is not None
+                   else f"rank {seg.get('rank')}")
+            label = seg["name"]
+            if label == "transit":
+                label = f"transit {seg.get('from')}→{seg.get('to')}"
+            print(f"  {label:<28} {1e3 * seg['dur_s']:>9.3f}ms  {who}")
+    total = sum(shares.values())
+    if shares:
+        print("\ncritical-path segment shares (all rounds):")
+        for name, dur in sorted(shares.items(), key=lambda kv: -kv[1]):
+            pct = 100.0 * dur / total if total else 0.0
+            print(f"  {name:<18} {dur:>9.4f}s {pct:>6.1f}%")
+    if stragglers:
+        print("\nstragglers (attributed wait vs the round's fastest "
+              "chain):")
+        for s in stragglers:
+            print(f"  client {s['client']:<4} +{s['wait_s']:.4f}s  "
+                  f"gated {s['rounds_gated']} rounds")
+    return 0
 
 
 def cmd_build(args) -> int:
@@ -619,6 +720,29 @@ def main(argv=None) -> int:
     p_top.add_argument("file", nargs="?", default="",
                        help="run JSONL event file (default: newest run)")
 
+    p_trace = sub.add_parser(
+        "trace",
+        help="merge per-process span files into one clock-aligned trace: "
+        "round critical path, segment shares, straggler attribution, "
+        "Perfetto export (docs/tracing.md)",
+    )
+    p_trace.add_argument("dir", nargs="?", default="",
+                         help="trace dir holding run_*.jsonl sinks + "
+                         "flight_*.json post-mortems "
+                         "(default: .fedml_tpu_runs)")
+    p_trace.add_argument("--run_id", default="",
+                         help="only merge this run's files")
+    p_trace.add_argument("--round", type=int, default=-1,
+                         help="print the critical path of this round "
+                         "(default: the last traced round)")
+    p_trace.add_argument("--chrome", default="", metavar="OUT.json",
+                         help="also write Chrome trace-event JSON "
+                         "(Perfetto / chrome://tracing)")
+    p_trace.add_argument("--top", type=int, default=5,
+                         help="straggler top-k")
+    p_trace.add_argument("--json", action="store_true",
+                         help="machine-readable output")
+
     p_build = sub.add_parser("build", help="package a training dir")
     p_build.add_argument("--type", "-t", choices=("client", "server"),
                          default="client")
@@ -776,6 +900,11 @@ def main(argv=None) -> int:
     p_chaos.add_argument("--heartbeat_s", type=float, default=0.0,
                          help="client heartbeat interval for the soak "
                          "(0 = auto: on for kill legs, off otherwise)")
+    p_chaos.add_argument("--trace_dir", default="",
+                         help="distributed-tracing span/flight dir for the "
+                         "faulty legs (kill-phase legs default to "
+                         "WORKDIR/trace and verify the pre-SIGKILL "
+                         "post-mortem + orphan-free merge)")
     # internal: run ONE chaos leg in this process (the orchestrator's child)
     p_chaos.add_argument("--worker", action="store_true",
                          help=argparse.SUPPRESS)
@@ -850,6 +979,19 @@ def main(argv=None) -> int:
                          "device only on a real accelerator")
     p_swarm.add_argument("--timeout", type=float, default=300.0)
     p_swarm.add_argument("--run_id", default="swarm")
+    p_swarm.add_argument("--trace", action="store_true",
+                         help="distributed tracing for the soak: every "
+                         "process records causal spans, and the report "
+                         "gains trace_spans / critical_path_segments plus "
+                         "the traced dispatch→ready sum (reconciles with "
+                         "the traffic.dispatch_ready_s histogram)")
+    p_swarm.add_argument("--trace_sample", type=float, default=1.0,
+                         metavar="P",
+                         help="fraction of rounds traced (deterministic "
+                         "per-round hash; 1.0 = every round)")
+    p_swarm.add_argument("--trace_dir", default="",
+                         help="span/flight dir (default: "
+                         ".fedml_tpu_runs/trace_RUN_ID)")
     # internal: one gRPC device-host process (the orchestrator's child)
     p_swarm.add_argument("--worker", action="store_true",
                          help=argparse.SUPPRESS)
@@ -877,6 +1019,7 @@ def main(argv=None) -> int:
         "status": cmd_status,
         "logs": cmd_logs,
         "top": cmd_top,
+        "trace": cmd_trace,
         "build": cmd_build,
         "login": cmd_login,
         "logout": cmd_logout,

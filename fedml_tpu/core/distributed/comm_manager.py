@@ -104,6 +104,16 @@ class FedMLCommManager(Observer):
         if message.get(Message.MSG_ARG_KEY_SEQ) is None:
             message.add(Message.MSG_ARG_KEY_SEQ, self._stamp.next_seq())
             message.add(Message.MSG_ARG_KEY_EPOCH, self._stamp.epoch)
+        # causal trace context (docs/tracing.md): the innermost open span
+        # on this thread — or the context adopted from the message being
+        # handled — rides the header, so the receiver's spans continue
+        # THIS trace. Handlers that stamped an explicit context (fan-out
+        # dispatch) win; like the seq stamp, it survives retries unchanged.
+        if (self.world.trace.enabled
+                and message.get(Message.MSG_ARG_KEY_TRACE) is None):
+            ctx = self.world.trace.current_context()
+            if ctx is not None:
+                message.add(Message.MSG_ARG_KEY_TRACE, ctx.to_wire())
         if (
             self.payload_store is not None
             and message.arrays
@@ -133,6 +143,12 @@ class FedMLCommManager(Observer):
                 is_transient=lambda e: isinstance(e, TransientSendError),
                 on_retry=lambda attempt, e: (
                     self.world.telemetry.counter_inc("comm.send_retries"),
+                    # a retry is an EVENT inside the enclosing span (the
+                    # upload/dispatch that is retrying) — never a new span,
+                    # so retried frames can't duplicate trace nodes
+                    self.world.trace.event(
+                        "send_retry", attempt=attempt,
+                        msg_type=message.get_type()),
                     logger.info(
                         "rank %d: transient send failure for %r (%s) — "
                         "retry %d", self.rank, message.get_type(), e, attempt,
@@ -194,6 +210,11 @@ class FedMLCommManager(Observer):
             )
             if verdict == "duplicate":
                 self.world.telemetry.counter_inc("comm.dedup_drops")
+                # the drop is an ANNOTATION on the receive timeline, not a
+                # span: the original delivery already owns the trace node
+                self.world.trace.event(
+                    "dedup_drop", msg_type=str(msg_type),
+                    sender=msg.get_sender_id(), seq=int(seq))
                 logger.info(
                     "rank %d: duplicate %r from %d (seq %s) dropped",
                     self.rank, msg_type, msg.get_sender_id(), seq,
@@ -201,6 +222,9 @@ class FedMLCommManager(Observer):
                 return
             if verdict == "stale_epoch":
                 self.world.telemetry.counter_inc("comm.stale_epoch_drops")
+                self.world.trace.event(
+                    "stale_epoch_drop", msg_type=str(msg_type),
+                    sender=msg.get_sender_id())
                 logger.info(
                     "rank %d: stale-epoch %r from %d dropped (sender "
                     "restarted)", self.rank, msg_type, msg.get_sender_id(),
@@ -209,6 +233,20 @@ class FedMLCommManager(Observer):
         handler = self.message_handler_dict.get(str(msg_type))
         if handler is None:
             logger.debug("rank %d: no handler for %r", self.rank, msg_type)
+            return
+        if self.world.trace.enabled:
+            # adopt the sender's causal context for the handler's duration:
+            # spans opened inside — and messages sent from — the handler
+            # continue the sender's trace across the process boundary
+            from ..mlops.tracing import TraceContext
+
+            wire_ctx = TraceContext.from_wire(
+                msg.get(Message.MSG_ARG_KEY_TRACE))
+            self.world.trace.adopt(wire_ctx)
+            try:
+                handler(msg)
+            finally:
+                self.world.trace.adopt(None)
             return
         handler(msg)
 

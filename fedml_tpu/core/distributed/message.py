@@ -32,6 +32,13 @@ class Message:
     MSG_ARG_KEY_SEQ = "_seq"
     MSG_ARG_KEY_EPOCH = "_epoch"
     MSG_ARG_KEY_PAYLOAD_SHA256 = "_sha256"
+    # W3C-traceparent-style causal context (core/mlops/tracing.py): a
+    # compact [run_id, round, span_id, parent] list stamped by the comm
+    # manager on send and adopted on receive — rides the JSON header, so
+    # it survives every transport, the payload-store offload, and the
+    # retry/dedup layer unchanged (a retried frame carries the SAME
+    # context: never a duplicate span)
+    MSG_ARG_KEY_TRACE = "_trace"
 
     def __init__(self, type: str = "default", sender_id: int = 0, receiver_id: int = 0):
         self.type = str(type)

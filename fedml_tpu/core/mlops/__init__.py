@@ -19,20 +19,39 @@ import atexit
 import json
 import logging
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
 logger = logging.getLogger("fedml_tpu.mlops")
 
+# write-behind sink bounds: a flush happens when the buffer holds this many
+# events regardless of the interval knob, so a burst can never grow the
+# buffer unboundedly between interval ticks
+BUFFER_EVENT_LIMIT = 256
+
 
 class MLOpsStore:
-    """Process-wide sink registry (reference: MLOpsStore at __init__.py:46)."""
+    """Process-wide sink registry (reference: MLOpsStore at __init__.py:46).
 
+    The JSONL sink is write-behind: ``_emit`` appends to ``_buffer`` and the
+    emitting thread drains it to disk when ``flush_interval_s`` has elapsed
+    since the last drain (or the buffer hits :data:`BUFFER_EVENT_LIMIT`, or
+    someone calls :func:`flush`). ``flush_interval_s == 0`` restores the
+    legacy syscall-per-event behavior. Zero-loss is guaranteed through the
+    atexit-registered :func:`close` — including the preemption-drain exit 75
+    path, which leaves via ``sys.exit`` and therefore runs atexit hooks.
+    """
+
+    _sink_lock = threading.Lock()
     enabled: bool = False
     run_id: str = "0"
     edge_id: int = 0
     jsonl_path: Optional[str] = None
     _jsonl_file = None
+    _buffer: List[str] = []
+    flush_interval_s: float = 0.5
+    _last_flush: float = 0.0
     use_wandb: bool = False
     _wandb = None
     _atexit_registered: bool = False
@@ -49,6 +68,12 @@ def init(args) -> None:
     MLOpsStore.edge_id = int(getattr(args, "rank", 0))
     MLOpsStore.jsonl_path = None  # never point at a previous run's file
     MLOpsStore.use_wandb = False
+    raw_interval = getattr(args, "tracking_flush_s", None)
+    MLOpsStore.flush_interval_s = (
+        0.5 if raw_interval is None else max(0.0, float(raw_interval)))
+    with MLOpsStore._sink_lock:
+        MLOpsStore._buffer = []
+        MLOpsStore._last_flush = time.monotonic()
     if MLOpsStore.enabled:
         out_dir = str(getattr(args, "tracking_dir", "") or ".fedml_tpu_runs")
         os.makedirs(out_dir, exist_ok=True)
@@ -89,13 +114,37 @@ def close() -> None:
         telemetry.close()  # summary event must land before the file shuts
     except Exception:  # pragma: no cover - shutdown must never raise
         logger.exception("telemetry close failed")
-    if MLOpsStore._jsonl_file is not None:
+    with MLOpsStore._sink_lock:
         f, MLOpsStore._jsonl_file = MLOpsStore._jsonl_file, None
+        pending, MLOpsStore._buffer = MLOpsStore._buffer, []
+    if f is not None:
         try:
+            if pending:
+                f.write("".join(pending))
             f.flush()
             f.close()
         except OSError:
             pass
+
+
+def flush() -> None:
+    """Drain the write-behind buffer to disk now (shutdown paths, readers
+    of the live file, and the flight recorder's post-mortem flush)."""
+    with MLOpsStore._sink_lock:
+        _flush_locked()
+
+
+def _flush_locked() -> None:
+    if MLOpsStore._jsonl_file is None or not MLOpsStore._buffer:
+        MLOpsStore._last_flush = time.monotonic()
+        return
+    pending, MLOpsStore._buffer = MLOpsStore._buffer, []
+    try:
+        MLOpsStore._jsonl_file.write("".join(pending))
+        MLOpsStore._jsonl_file.flush()
+    except OSError:  # pragma: no cover - disk-full etc.; keep serving
+        pass
+    MLOpsStore._last_flush = time.monotonic()
 
 
 def _emit(record: Dict[str, Any]) -> None:
@@ -104,9 +153,14 @@ def _emit(record: Dict[str, Any]) -> None:
     record.setdefault("run_id", MLOpsStore.run_id)
     record.setdefault("edge_id", MLOpsStore.edge_id)
     record.setdefault("time", time.time())
-    if MLOpsStore._jsonl_file is not None:
-        MLOpsStore._jsonl_file.write(json.dumps(record) + "\n")
-        MLOpsStore._jsonl_file.flush()
+    with MLOpsStore._sink_lock:
+        if MLOpsStore._jsonl_file is not None:
+            MLOpsStore._buffer.append(json.dumps(record) + "\n")
+            now = time.monotonic()
+            if (len(MLOpsStore._buffer) >= BUFFER_EVENT_LIMIT
+                    or now - MLOpsStore._last_flush
+                    >= MLOpsStore.flush_interval_s):
+                _flush_locked()
     logger.debug("mlops: %s", record)
 
 
@@ -220,6 +274,8 @@ def profile_trace(log_dir: str):
 def read_events(path: Optional[str] = None) -> List[Dict[str, Any]]:
     """Load a run's JSONL event log (test/debug helper)."""
     p = path or MLOpsStore.jsonl_path
+    if p is not None and p == MLOpsStore.jsonl_path:
+        flush()  # reading the live sink: drain the write-behind buffer first
     if p is None or not os.path.exists(p):
         return []
     with open(p) as f:

@@ -39,7 +39,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
-from .mlops import telemetry
+from .mlops import telemetry, tracing
 
 
 class WorldScope:
@@ -61,6 +61,14 @@ class WorldScope:
         # multi-tenant PR installs per-run scopes via
         # telemetry.install_scope(run_id) without touching call sites.
         self.telemetry = telemetry.scope_for(self.run_id)
+        # per-world span recorder + flight recorder (docs/tracing.md):
+        # handler code opens spans through ``world.trace`` — the same
+        # (run_id, rank) discriminator as everything else this scope owns.
+        # Disabled (a shared null-span per call site) unless the run's
+        # args arm it.
+        self.trace = tracing.tracer_for(self.run_id, self.rank)
+        if args is not None:
+            self.trace.configure(args)
         # world-keyed bulk channel (reference MQTT+S3 split): one store
         # per world, built from the run's args at construction — handlers
         # never read ambient config to find it
@@ -74,6 +82,10 @@ class WorldScope:
         self._timers: List[threading.Timer] = []
         self._hooks: List[Callable[[], None]] = []
         self._closed = False
+        if self.trace.enabled:
+            # the flight recorder's ring lands on every orderly teardown
+            # too (finish() → shutdown()), not just atexit/fault paths
+            self.add_shutdown(lambda: self.trace.flush_flight("shutdown"))
 
     # -- registry ------------------------------------------------------------
 

@@ -211,6 +211,9 @@ class ClientMasterManager(FedMLCommManager):
         elif running:
             hb = Message(MyMessage.MSG_TYPE_C2S_HEARTBEAT, self.rank, 0)
             hb.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
+            # clock probe (docs/tracing.md): our monotonic send time rides
+            # the heartbeat; the ack echoes it with the server's clocks
+            hb.add(MyMessage.MSG_ARG_KEY_HB_T_SEND, time.monotonic())
             try:
                 self.send_message(hb)
             except Exception as e:  # noqa: BLE001 — any send failure
@@ -275,6 +278,20 @@ class ClientMasterManager(FedMLCommManager):
 
     def _on_heartbeat_ack(self, msg: Message) -> None:
         self._note_server_traffic()
+        t_echo = msg.get(MyMessage.MSG_ARG_KEY_HB_T_ECHO)
+        t_recv = msg.get(MyMessage.MSG_ARG_KEY_HB_T_RECV)
+        t_reply = msg.get(MyMessage.MSG_ARG_KEY_HB_T_REPLY)
+        if t_echo is not None and t_recv is not None and t_reply is not None:
+            # close the NTP-style probe pair: (our send, server recv,
+            # server reply, our recv) → per-peer offset/uncertainty
+            est = self.world.trace.clock_probe(
+                peer=0, t_send=float(t_echo), t_peer_recv=float(t_recv),
+                t_peer_send=float(t_reply), t_recv=time.monotonic())
+            if est is not None:
+                self.world.telemetry.gauge_set(
+                    "trace.clock_offset_s", est[0])
+                self.world.telemetry.gauge_set(
+                    "trace.clock_uncertainty_s", est[1])
 
     def _on_resync_ack(self, msg: Message) -> None:
         """The handshake's answer: back to RUNNING, and replay the cached
@@ -328,6 +345,13 @@ class ClientMasterManager(FedMLCommManager):
 
     def _install_params(self, msg: Message,
                         version: Optional[int] = None) -> bool:
+        # span: wire decode + model install — parents to the dispatch span
+        # the comm layer adopted from the S2C message's trace context
+        with self.world.trace.span("decode", client=self.rank):
+            return self._install_params_traced(msg, version)
+
+    def _install_params_traced(self, msg: Message,
+                               version: Optional[int] = None) -> bool:
         """Install a dispatched model — a full leaf list, or an S2C delta
         frame decoded against the version we last held (docs/delivery.md).
         Returns False when a delta's base version is gone (a restarted
@@ -493,12 +517,14 @@ class ClientMasterManager(FedMLCommManager):
         """reference: __train + send_model_to_server (:109-127,160)."""
         self._last_trained_round = self.round_idx
         self.args.round_idx = self.round_idx
-        if self.silo_plane is not None:
-            params, n, metrics = self._train_hierarchical()
-        else:
-            x, y, n = self.ds.client_shard(self.client_index)
-            metrics = self.trainer.train((x, y, n), None, self.args)
-            params = self.trainer.get_model_params()
+        with self.world.trace.span("train", round_idx=self.round_idx,
+                                   client=self.rank):
+            if self.silo_plane is not None:
+                params, n, metrics = self._train_hierarchical()
+            else:
+                x, y, n = self.ds.client_shard(self.client_index)
+                metrics = self.trainer.train((x, y, n), None, self.args)
+                params = self.trainer.get_model_params()
         if self.dp is not None:
             key = jax.random.fold_in(
                 jax.random.PRNGKey(int(getattr(self.args, "random_seed", 0)) + self.rank),
@@ -543,7 +569,12 @@ class ClientMasterManager(FedMLCommManager):
                 "comm.delta.c2s_bytes_saved", max(raw_nbytes - sent, 0))
         self._last_model_msg = msg
         try:
-            self.send_message(msg)
+            # the upload span's context rides the C2S header (stamped by
+            # send_message while this span is innermost), so the server's
+            # admission span continues THIS trace
+            with self.world.trace.span("upload", round_idx=self.round_idx,
+                                       client=self.rank):
+                self.send_message(msg)
             if self._client_pull:
                 # client_pull dispatch (docs/delivery.md): ask for the next
                 # version now — the server answers as soon as it bumps past

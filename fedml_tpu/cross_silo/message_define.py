@@ -69,6 +69,16 @@ class MyMessage:
     # version the message is tagged with becomes the sender's last-ACKed
     # base for S2C delta encoding.
     MSG_ARG_KEY_DELTA_CAPABLE = "delta_capable"
+    # distributed-tracing clock probes (docs/tracing.md "Clock
+    # alignment"): NTP-style monotonic timestamp pairs piggybacked on the
+    # heartbeat exchange so the trace merge's offset estimator has samples
+    # even on quiet links. The client stamps T_SEND on c2s_heartbeat; the
+    # ack echoes it (T_ECHO) next to the server's receive/reply clocks
+    # (T_RECV / T_REPLY); the client closes the pair at ack receipt.
+    MSG_ARG_KEY_HB_T_SEND = "hb_t_send"
+    MSG_ARG_KEY_HB_T_ECHO = "hb_t_echo"
+    MSG_ARG_KEY_HB_T_RECV = "hb_t_recv"
+    MSG_ARG_KEY_HB_T_REPLY = "hb_t_reply"
 
     CLIENT_STATUS_ONLINE = "ONLINE"
     CLIENT_STATUS_OFFLINE = "OFFLINE"

@@ -50,6 +50,7 @@ import numpy as np
 from .. import constants
 from ..core.aggregate import stack_trees, weighted_average
 from ..core.distributed import FedMLCommManager, Message
+from ..core.mlops.tracing import NULL_SPAN
 from ..core.dp import FedPrivacyMechanism
 from ..core.security.defender import FedMLDefender
 from ..delivery import (
@@ -371,8 +372,18 @@ class FedMLServerManager(FedMLCommManager):
     def _maybe_kill(self, phase: str, round_idx: int) -> None:
         """Chaos kill switch (faults.FaultPlan.kill_server): SIGKILL this
         process at a protocol phase — the crash-failover soak's trigger."""
-        if self._fault_plan is not None:
-            self._fault_plan.maybe_kill_server(phase, round_idx)
+        # flight-recorder phase mark (docs/tracing.md): the post-mortem's
+        # ``last_phase`` names exactly where a no-drain SIGKILL landed
+        self.world.trace.note_phase(phase, round_idx)
+        plan = self._fault_plan
+        if plan is not None:
+            if (self.world.trace.enabled and plan.kill_phase == phase
+                    and plan.kill_round == int(round_idx)):
+                # the kill below is a TRUE fail-stop (no drain, no atexit):
+                # the post-mortem and the sink's buffered tail must land
+                # NOW, on this thread, before the signal
+                self.world.trace.flush_flight(f"kill_server:{phase}")
+            plan.maybe_kill_server(phase, round_idx)
 
     # -- FSM ----------------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -480,6 +491,7 @@ class FedMLServerManager(FedMLCommManager):
         and the restarted server's init barrier never completes."""
         if self.done.is_set():
             return
+        t_recv = time.monotonic()  # clock probe: our receive timestamp
         sender = msg.get_sender_id()
         with self._lock:
             # NB: a heartbeat does NOT clear a _dead mark — reviving a
@@ -496,6 +508,14 @@ class FedMLServerManager(FedMLCommManager):
         ack = Message(MyMessage.MSG_TYPE_S2C_HEARTBEAT_ACK, self.rank,
                       sender)
         ack.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, head)
+        t_send = msg.get(MyMessage.MSG_ARG_KEY_HB_T_SEND)
+        if t_send is not None:
+            # NTP-style probe echo (docs/tracing.md "Clock alignment"):
+            # the client's send stamp comes back next to our receive/reply
+            # clocks, closing one offset-estimation pair per heartbeat
+            ack.add(MyMessage.MSG_ARG_KEY_HB_T_ECHO, float(t_send))
+            ack.add(MyMessage.MSG_ARG_KEY_HB_T_RECV, t_recv)
+            ack.add(MyMessage.MSG_ARG_KEY_HB_T_REPLY, time.monotonic())
         self._send_or_mark_dead(sender, ack)
 
     def _on_resync(self, msg: Message) -> None:
@@ -620,12 +640,18 @@ class FedMLServerManager(FedMLCommManager):
     def _send_init_msg(self) -> None:
         """reference: fedml_server_manager.py:93-118 (online barrier → init)."""
         leaves = [np.asarray(l) for l in jax.tree.leaves(self.global_params)]
+        trc = self.world.trace
         for client_rank in range(1, self.size):
             msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, client_rank)
             msg.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
             msg.add(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, client_rank - 1)
             msg.set_arrays(leaves)
-            self._send_or_mark_dead(client_rank, msg)
+            # the INIT fan-out roots round 0's trace exactly like a SYNC
+            # dispatch roots every later round's
+            with (trc.span("dispatch", round_idx=self.round_idx,
+                           client=client_rank)
+                  if trc.sampled(self.round_idx) else NULL_SPAN):
+                self._send_or_mark_dead(client_rank, msg)
         logger.info("server: init sent to %d clients", self.client_num)
         self._arm_round_timer()
 
@@ -647,10 +673,17 @@ class FedMLServerManager(FedMLCommManager):
         from ..core.compression import UpdateCodec
 
         self._record_ack(msg)
-        params = self._reconstruct_update(
-            sender, msg_round, msg.get_arrays(),
-            msg.get(UpdateCodec.META_KEY), msg.get(FILTER_KEY),
-        )
+        # sync-mode fold: decode + staleness bookkeeping on the receive
+        # thread — continues the client's upload trace (adopted context)
+        tctx = self.world.trace.current_context()
+        sp = (self.world.trace.span("fold", round_idx=msg_round,
+                                    client=sender)
+              if tctx is not None else NULL_SPAN)
+        with sp:
+            params = self._reconstruct_update(
+                sender, msg_round, msg.get_arrays(),
+                msg.get(UpdateCodec.META_KEY), msg.get(FILTER_KEY),
+            )
         if params is None:
             # undecodable (filter mismatch / evicted base) — counted and
             # logged by _reconstruct_update. In sync mode a client whose
@@ -1063,14 +1096,26 @@ class FedMLServerManager(FedMLCommManager):
 
         sender = msg.get_sender_id()
         client_version = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, -1))
-        self._record_ack(msg)
-        item = (
-            time.monotonic(), sender, client_version,
-            float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0)),
-            msg.get_arrays(),
-            msg.get(UpdateCodec.META_KEY), msg.get(FILTER_KEY),
-        )
-        verdict = self.admission.offer(lambda: self._try_enqueue(item))
+        # admission span continues the client's upload trace (the comm
+        # layer adopted the wire context before dispatching here); its own
+        # context rides the queue item so the fold-side spans — running on
+        # the aggregator worker thread — keep the same causal chain
+        tctx = self.world.trace.current_context()
+        sp = (self.world.trace.span(
+            "admission", round_idx=client_version, client=sender)
+            if tctx is not None else NULL_SPAN)
+        with sp:
+            self._record_ack(msg)
+            item = (
+                time.monotonic(), sender, client_version,
+                float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0)),
+                msg.get_arrays(),
+                msg.get(UpdateCodec.META_KEY), msg.get(FILTER_KEY),
+                sp.context() if tctx is not None else None,
+            )
+            verdict = self.admission.offer(lambda: self._try_enqueue(item))
+            if not verdict.admitted:
+                sp.annotate("shed", verdict.reason)
         if not verdict.admitted:
             self._shed_reply(sender, client_version, verdict)
 
@@ -1158,32 +1203,55 @@ class FedMLServerManager(FedMLCommManager):
         the version-indexed store: staleness-weighted folding is unchanged,
         only the reference global is version-correct."""
         t_enq, sender, client_version, n, arrays, codec_meta, \
-            filter_meta = item
+            filter_meta, tctx = item
         self._maybe_kill("pre_fold", self.round_idx)
-        params = self._reconstruct_update(
-            sender, client_version, arrays, codec_meta, filter_meta)
-        if params is None:
-            # base version evicted from the store: the update is
-            # undecodable — same remedy as an over-stale update, the
-            # sender rejoins at version head with a fresh model
-            self._send_model_to(
-                sender, MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
-            return
-        verdict = self.buffer.fold(
-            sender, n, params, client_version, self.round_idx
-        )
-        with self._lock:
-            # an accepted (or even stale) update proves the client lives
-            self._dead.discard(sender)
-            self._offline_declared.discard(sender)
-        if verdict == "stale":
-            # beyond max_staleness: the update is discarded, but the
-            # sender rejoins at version head with a fresh model
-            self._send_model_to(
-                sender, MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
-            return
-        self.world.telemetry.observe(
-            "traffic.dispatch_ready_s", time.monotonic() - t_enq)
+        trc = self.world.trace
+        traced = trc.enabled and tctx is not None
+        fold_parent = None
+        if traced:
+            # fold-queue wait, measured retroactively from the enqueue
+            # stamp — the same t_enq the dispatch_ready histogram uses, so
+            # queue_wait + fold decompose that scalar additively
+            fold_parent = trc.record_span(
+                "queue_wait", t_enq, time.monotonic() - t_enq,
+                ctx=tctx, client=sender)
+        sp = (trc.span("fold", round_idx=tctx.round_idx,
+                       parent=fold_parent, client=sender)
+              if traced else NULL_SPAN)
+        with sp:
+            t_lookup = time.monotonic()
+            params = self._reconstruct_update(
+                sender, client_version, arrays, codec_meta, filter_meta)
+            if traced:
+                # the version-store lookup + C2S decode inside the fold
+                trc.record_span(
+                    "store_lookup", t_lookup, time.monotonic() - t_lookup,
+                    round_idx=tctx.round_idx, parent=sp.span_id,
+                    client=sender)
+            if params is None:
+                # base version evicted from the store: the update is
+                # undecodable — same remedy as an over-stale update, the
+                # sender rejoins at version head with a fresh model
+                sp.annotate("outcome", "undecodable")
+                self._send_model_to(
+                    sender, MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
+                return
+            verdict = self.buffer.fold(
+                sender, n, params, client_version, self.round_idx
+            )
+            with self._lock:
+                # an accepted (or even stale) update proves the client lives
+                self._dead.discard(sender)
+                self._offline_declared.discard(sender)
+            if verdict == "stale":
+                # beyond max_staleness: the update is discarded, but the
+                # sender rejoins at version head with a fresh model
+                sp.annotate("outcome", "stale")
+                self._send_model_to(
+                    sender, MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
+                return
+            self.world.telemetry.observe(
+                "traffic.dispatch_ready_s", time.monotonic() - t_enq)
 
     def _async_step(self) -> bool:
         """One FedBuff server step: drain the buffer, aggregate through the
@@ -1308,12 +1376,30 @@ class FedMLServerManager(FedMLCommManager):
             version = self.round_idx
         m = Message(msg_type, self.rank, client_rank)
         m.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, version)
-        arrays, delta_meta = self._encode_model_payload(
-            client_rank, leaves, vec, cache, version=version)
-        if delta_meta is not None:
-            m.add(DELTA_KEY, delta_meta)
-        m.set_arrays(arrays)
-        self._send_or_mark_dead(client_rank, m)
+        # dispatch span: the ROOT of round `version`'s causal trace — its
+        # context rides the S2C header (stamped by send_message while this
+        # span is innermost), so the client's decode/train/upload and the
+        # fold they feed all hang off it. Sampling is decided HERE, once
+        # per round, deterministically: an unsampled round stamps no
+        # context and the whole federation stays silent for it.
+        trc = self.world.trace
+        sp = (trc.span("dispatch", round_idx=int(version),
+                       client=client_rank)
+              if trc.sampled(int(version)) else NULL_SPAN)
+        with sp:
+            t_enc = time.monotonic()
+            arrays, delta_meta = self._encode_model_payload(
+                client_rank, leaves, vec, cache, version=version)
+            if sp.span_id is not None:
+                trc.record_span(
+                    "wire_encode", t_enc, time.monotonic() - t_enc,
+                    round_idx=int(version), parent=sp.span_id,
+                    client=client_rank,
+                    delta=bool(delta_meta is not None))
+            if delta_meta is not None:
+                m.add(DELTA_KEY, delta_meta)
+            m.set_arrays(arrays)
+            self._send_or_mark_dead(client_rank, m)
 
     def _prefill_encode_cache(self, targets, vec, cache, version) -> None:
         """Batched per-cohort encode (device wire path): ONE vmapped kernel

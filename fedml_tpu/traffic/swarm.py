@@ -42,6 +42,7 @@ from .. import constants
 from ..core import world as world_mod
 from ..core.distributed import FedMLCommManager, Message
 from ..core.mlops import telemetry
+from ..core.mlops.tracing import NULL_SPAN
 from ..cross_silo.message_define import MyMessage
 
 logger = logging.getLogger(__name__)
@@ -173,6 +174,12 @@ class SwarmClientManager(FedMLCommManager):
         self._state_lock = threading.Lock()
         self._version = -1
         self._arrays: List[np.ndarray] = []
+        # the dispatch's wire trace context, snapshotted WITH the version
+        # it arrived under: the ambient context is thread-local to the
+        # receive path, and the delayed send runs on the timer-wheel
+        # thread — without this hand-off the device's upload would start a
+        # fresh trace instead of continuing the server's dispatch span
+        self._trace_ctx = None
         self._dropped = False
         self._delta_on = bool(delta_capable)
         self._store = None
@@ -274,6 +281,7 @@ class SwarmClientManager(FedMLCommManager):
                 return  # a fresher dispatch landed during the decode
             self._version = version
             self._arrays = arrays
+            self._trace_ctx = self.world.trace.current_context()
         if self._dropped:
             return  # silent device: receives, never answers
         if self.schedule.drops_out():
@@ -292,6 +300,7 @@ class SwarmClientManager(FedMLCommManager):
             if version != self._version:
                 return  # a fresher dispatch superseded this one
             arrays = self._arrays
+            ctx = self._trace_ctx
         out = Message(
             MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         out.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, version)
@@ -300,8 +309,16 @@ class SwarmClientManager(FedMLCommManager):
             # ACK: this version becomes the server's S2C delta base for us
             out.add(MyMessage.MSG_ARG_KEY_DELTA_CAPABLE, 1)
         out.set_arrays(arrays)
-        self.world.telemetry.counter_inc("swarm.updates_sent")
-        self._send_quiet(out)
+        # continue the dispatch's trace across the think-time hop: the
+        # upload span parents to the server's dispatch span, and
+        # send_message stamps ITS context onto the C2S wire — a shed
+        # retry is a genuinely new upload attempt, so it gets a new span
+        # (transport-level retries inside send stay events, never spans)
+        sp = (self.world.trace.span("upload", ctx=ctx, client=self.rank)
+              if ctx is not None else NULL_SPAN)
+        with sp:
+            self.world.telemetry.counter_inc("swarm.updates_sent")
+            self._send_quiet(out)
 
     def _on_shed(self, msg: Message) -> None:
         shed_version = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, -1))
@@ -452,6 +469,41 @@ def _wire_path(a) -> str:
     return str(getattr(a, "wire_path", "auto") or "auto").lower()
 
 
+def _trace_on(a) -> bool:
+    return bool(getattr(a, "trace", False))
+
+
+def _trace_sample(a) -> float:
+    raw = getattr(a, "trace_sample", None)
+    return 1.0 if raw is None else max(0.0, min(1.0, float(raw)))
+
+
+def _trace_dir(a) -> str:
+    """Shared span-sink directory for the soak: every process (server,
+    loopback devices, gRPC device hosts) writes here so the merge sees one
+    federation. Per-run by default so stale files from earlier soaks can
+    never pollute the reconciliation."""
+    explicit = str(getattr(a, "trace_dir", "") or "")
+    if explicit:
+        return explicit
+    return os.path.join(".fedml_tpu_runs", f"trace_{a.run_id}")
+
+
+def _trace_overrides(a) -> Dict:
+    """Tracing knobs for a soak participant's Arguments: spans persist
+    through the PR 2 JSONL sink, so a traced soak also turns tracking on,
+    pointed at the shared trace dir."""
+    if not _trace_on(a):
+        return {}
+    return dict(
+        enable_tracing=True,
+        trace_sample=_trace_sample(a),
+        trace_dir=_trace_dir(a),
+        enable_tracking=True,
+        tracking_dir=_trace_dir(a),
+    )
+
+
 def _server_overrides(a) -> Dict:
     return dict(
         training_type="cross_silo", dataset="synthetic", model="lr",
@@ -473,6 +525,7 @@ def _server_overrides(a) -> Dict:
         # eval only the final step: the soak measures the traffic plane,
         # not the model
         frequency_of_the_test=10**9,
+        **_trace_overrides(a),
     )
 
 
@@ -488,6 +541,7 @@ def _device_args(a, rank: int, backend: str):
         run_id=str(a.run_id), backend=backend,
         random_seed=int(a.seed),
         wire_path=_wire_path(a),
+        **_trace_overrides(a),
     )
     if backend == constants.COMM_BACKEND_GRPC:
         overrides.update(
@@ -511,8 +565,10 @@ def _ranks_per_port(a) -> int:
 
 def _percentiles(hist_summary: Optional[dict]) -> Dict:
     if not hist_summary:
-        return {"count": 0, "p50": None, "p95": None, "p99": None}
-    return {k: hist_summary.get(k) for k in ("count", "p50", "p95", "p99")}
+        return {"count": 0, "sum": None,
+                "p50": None, "p95": None, "p99": None}
+    return {k: hist_summary.get(k)
+            for k in ("count", "sum", "p50", "p95", "p99")}
 
 
 def run_swarm(a) -> int:
@@ -590,7 +646,7 @@ def swarm_soak(a) -> Dict:
                 count = min(per, int(a.clients) - base + 1)
                 if count <= 0:
                     break
-                spawner.spawn(python_module_cmd(
+                cmd = python_module_cmd(
                     "fedml_tpu.cli", "swarm", "--worker",
                     "--rank_base", str(base), "--count", str(count),
                     "--clients", str(a.clients), "--steps", str(a.steps),
@@ -602,7 +658,14 @@ def swarm_soak(a) -> Dict:
                     "--ranks_per_port", str(_ranks_per_port(a)),
                     "--s2c_delta", _s2c_delta(a),
                     "--wire_path", _wire_path(a),
-                ))
+                )
+                if _trace_on(a):
+                    # device hosts join the same trace: the resolved dir is
+                    # passed explicitly so orchestrator and workers agree
+                    cmd += ["--trace",
+                            "--trace_sample", str(_trace_sample(a)),
+                            "--trace_dir", _trace_dir(a)]
+                spawner.spawn(cmd)
                 base += count
 
         server_thread = threading.Thread(target=server.run, daemon=True)
@@ -696,7 +759,41 @@ def swarm_soak(a) -> Dict:
         "step_s": _percentiles(hists.get("traffic.step_s")),
         "rss_peak_mb": round(rss_peak_mb(), 1),
     }
+    report.update(_trace_report(a))
     return report
+
+
+def _trace_report(a) -> Dict:
+    """Merge the soak's per-process span files and attach the trace block:
+    span count, per-segment critical-path shares, straggler top-k, and the
+    traced dispatch→ready sum the smoke reconciles (within 5%) against the
+    ``traffic.dispatch_ready_s`` histogram's measured sum."""
+    if not _trace_on(a):
+        return {"trace_spans": None, "critical_path_segments": None}
+    from ..core import mlops
+    from ..core.mlops import tracing
+
+    mlops.flush()  # the orchestrator's own buffered span tail
+    files = tracing.collect_trace_files(_trace_dir(a),
+                                        run_id=str(a.run_id))
+    spans, clocks = tracing.read_trace(files)
+    merged = tracing.merge_trace(spans, clocks)
+    shares = tracing.critical_path_shares(merged)
+    traced_total, traced_folds = tracing.dispatch_ready_from_trace(merged)
+    rounds_with_path = sum(
+        1 for r in merged["rounds"] if tracing.critical_path(merged, r))
+    return {
+        "trace_spans": len(merged["spans"]),
+        "trace_rounds": len(merged["rounds"]),
+        "trace_rounds_with_path": rounds_with_path,
+        "trace_orphans": len(merged["orphans"]),
+        "critical_path_segments": {
+            k: round(v, 6) for k, v in sorted(shares.items())},
+        "stragglers": tracing.straggler_attribution(merged, k=5),
+        "trace_dispatch_ready_s": round(traced_total, 6),
+        "trace_dispatch_ready_folds": traced_folds,
+        "trace_dir": _trace_dir(a),
+    }
 
 
 def run_device_worker(a) -> int:

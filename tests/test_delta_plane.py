@@ -315,7 +315,7 @@ class TestAsyncCompression:
         trained = base1 + np.linspace(0.0, 1.0, base1.size,
                                       dtype=np.float32)
         arrays, meta = codec.encode(base1, trained, 1)
-        item = (time.monotonic(), 2, 1, 5.0, arrays, meta, None)
+        item = (time.monotonic(), 2, 1, 5.0, arrays, meta, None, None)
         mgr._async_fold(item)
 
         entries = mgr.buffer.drain()
@@ -350,7 +350,8 @@ class TestAsyncCompression:
         mgr.round_idx = 6
         codec = UpdateCodec(args_s)
         arrays, meta = codec.encode(base0, base0 + 0.5, 0)
-        mgr._async_fold((time.monotonic(), 1, 0, 1.0, arrays, meta, None))
+        mgr._async_fold((time.monotonic(), 1, 0, 1.0, arrays, meta, None,
+                         None))
         assert mgr.buffer.occupancy() == 0
         assert reg.counter("comm.delta.c2s_base_missing") == missing0 + 1
 

@@ -295,7 +295,7 @@ class TestServingStateReconstruction:
 
         leaves = [np.asarray(l) for l in jax.tree.leaves(mgr.global_params)]
         return (time.monotonic(), sender, client_version, n, leaves,
-                None, None)
+                None, None, None)
 
     def test_store_ring_and_buffer_weights_survive_restart(self, tmp_path):
         """(a) the restarted store ring matches the pre-kill committed

@@ -438,6 +438,93 @@ class TestSinkDurability:
         mlops.log({"z": 1})  # must not raise with a closed sink
 
 
+class TestWriteBehindSink:
+    """The buffered JSONL sink (ISSUE 17 satellite): events buffer in
+    memory and drain on interval / buffer limit / explicit flush / close —
+    and NEVER get lost, including on a preemption exit(75)."""
+
+    def _init(self, tmp_path, run_id, flush_s):
+        import types
+
+        ns = types.SimpleNamespace(enable_tracking=True, run_id=run_id,
+                                   rank=0, tracking_dir=str(tmp_path),
+                                   tracking_flush_s=flush_s)
+        mlops.init(ns)
+        return mlops.MLOpsStore.jsonl_path
+
+    def _lines(self, path):
+        with open(path) as f:
+            return [ln for ln in f if ln.strip()]
+
+    def test_interval_buffering_holds_events_off_disk(self, tmp_path):
+        path = self._init(tmp_path, "wb1", flush_s=3600.0)
+        for i in range(5):
+            mlops.log({"i": i})
+        assert len(mlops.MLOpsStore._buffer) == 5
+        assert self._lines(path) == []  # nothing on disk yet
+        mlops.flush()
+        assert mlops.MLOpsStore._buffer == []
+        assert len(self._lines(path)) == 5
+
+    def test_buffer_limit_forces_drain(self, tmp_path):
+        path = self._init(tmp_path, "wb2", flush_s=3600.0)
+        for i in range(mlops.BUFFER_EVENT_LIMIT):
+            mlops.log({"i": i})
+        # hitting the cap drains synchronously — bounded memory
+        assert mlops.MLOpsStore._buffer == []
+        assert len(self._lines(path)) == mlops.BUFFER_EVENT_LIMIT
+
+    def test_zero_interval_restores_per_event_writes(self, tmp_path):
+        path = self._init(tmp_path, "wb3", flush_s=0.0)
+        mlops.log({"a": 1})
+        assert len(self._lines(path)) == 1
+        mlops.log({"b": 2})
+        assert len(self._lines(path)) == 2
+
+    def test_read_events_sees_buffered_tail(self, tmp_path):
+        self._init(tmp_path, "wb4", flush_s=3600.0)
+        mlops.log({"tail": True})
+        # live readers (fedml top, swarm reports) must not miss the buffer
+        assert any(e.get("tail") for e in mlops.read_events())
+
+    def test_close_drains_pending_buffer(self, tmp_path):
+        path = self._init(tmp_path, "wb5", flush_s=3600.0)
+        for i in range(7):
+            mlops.log({"i": i})
+        mlops.close()
+        # 7 logged events all land (close also appends its summary record)
+        recs = [json.loads(ln) for ln in self._lines(path)]
+        assert sorted(r["i"] for r in recs if "i" in r) == list(range(7))
+
+    def test_preemption_exit_75_loses_nothing(self, tmp_path):
+        """A preempted worker exits via sys.exit(EXIT_PREEMPTED), which DOES
+        run atexit hooks — every buffered event must reach disk."""
+        import subprocess
+        import sys
+
+        child = (
+            "import sys, types\n"
+            "from fedml_tpu.core import mlops\n"
+            "from fedml_tpu.core.runstate import EXIT_PREEMPTED\n"
+            "ns = types.SimpleNamespace(enable_tracking=True,\n"
+            "    run_id='exit75', rank=0, tracking_dir=sys.argv[1],\n"
+            "    tracking_flush_s=3600.0)\n"
+            "mlops.init(ns)\n"
+            "for i in range(25):\n"
+            "    mlops.log({'i': i})\n"
+            "assert len(mlops.MLOpsStore._buffer) == 25\n"
+            "sys.exit(EXIT_PREEMPTED)\n"
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run([sys.executable, "-c", child, str(tmp_path)],
+                              env=env, capture_output=True, text=True,
+                              timeout=120)
+        assert proc.returncode == 75, proc.stderr
+        recs = [json.loads(ln) for ln in
+                open(tmp_path / "run_exit75_edge_0.jsonl")]
+        assert sorted(r["i"] for r in recs if "i" in r) == list(range(25))
+
+
 # ---------------------------------------------------------------------------
 # log_daemon coverage (satellite: resume, sinks, batching bounds)
 # ---------------------------------------------------------------------------

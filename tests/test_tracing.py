@@ -1,0 +1,501 @@
+"""Distributed-tracing plane tests (core/mlops/tracing.py — docs/tracing.md).
+
+Pins the tracing plane's contracts:
+
+1. **Zero cost when disabled**: every entry point is one bool check that
+   returns the shared no-op span; an untraced federation's wire and sink
+   are bitwise-free of trace artifacts.
+2. **Causal propagation**: the wire context survives transport faults —
+   retries and dedup drops become span events/annotations, NEVER duplicate
+   spans — and a traced loopback federation merges into one orphan-free
+   trace whose fold chains walk back to their dispatch.
+3. **Clock alignment**: the NTP-style estimator recovers a synthetic skew
+   from probe pairs, preferring the minimum-delay pair.
+4. **Merge determinism**: identical span files produce byte-identical
+   merged output, regardless of file discovery order.
+5. **Flight recorder**: the post-mortem names the last protocol phase and
+   recovers still-open spans for the merge.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+import fedml_tpu as fedml
+from fedml_tpu import data as data_mod
+from fedml_tpu import models as model_mod
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.core import mlops
+from fedml_tpu.core.distributed.faults import FaultPlan
+from fedml_tpu.core.mlops import telemetry, tracing
+from fedml_tpu.core.mlops.tracing import (
+    ClockOffsetEstimator,
+    NULL_SPAN,
+    TraceContext,
+    Tracer,
+)
+from fedml_tpu.cross_silo import FedMLCrossSiloClient, FedMLCrossSiloServer
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    telemetry.registry().reset()
+    yield
+    mlops.close()
+    telemetry.registry().reset()
+    mlops.MLOpsStore.enabled = False
+    mlops.MLOpsStore.jsonl_path = None
+
+
+def tracer_args(tmp_path, enabled=True, sample=1.0):
+    return types.SimpleNamespace(enable_tracing=enabled,
+                                 trace_sample=sample,
+                                 trace_dir=str(tmp_path))
+
+
+def make_tracer(tmp_path, run_id, rank=0, **kw):
+    t = tracing.tracer_for(run_id, rank)
+    t.configure(tracer_args(tmp_path, **kw))
+    return t
+
+
+# ---------------------------------------------------------------------------
+# context + zero-cost
+# ---------------------------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_wire_roundtrip(self):
+        ctx = TraceContext("run9", 3, "0.123.7", parent="1.99.2")
+        back = TraceContext.from_wire(ctx.to_wire())
+        assert (back.run_id, back.round_idx, back.span_id, back.parent) == \
+            ("run9", 3, "0.123.7", "1.99.2")
+
+    def test_none_parent_survives(self):
+        back = TraceContext.from_wire(TraceContext("r", 0, "s").to_wire())
+        assert back.parent is None
+
+    def test_malformed_wire_drops_not_raises(self):
+        assert TraceContext.from_wire(None) is None
+        assert TraceContext.from_wire("garbage") is None
+        assert TraceContext.from_wire([1, 2]) is None
+        assert TraceContext.from_wire(["r", "not-an-int", "s", None]) is None
+
+    def test_child_links_parent(self):
+        ctx = TraceContext("r", 2, "a")
+        child = ctx.child("b")
+        assert child.parent == "a" and child.round_idx == 2
+
+
+class TestZeroCostDisabled:
+    def test_disabled_tracer_returns_shared_null_span(self, tmp_path):
+        t = make_tracer(tmp_path, "trc-off", enabled=False)
+        assert t.span("anything") is NULL_SPAN
+        assert t.span("nested", round_idx=3, client=1) is NULL_SPAN
+        assert t.record_span("x", time.monotonic(), 0.1) is None
+        assert t.current_context() is None
+        assert not t.sampled(0)
+        t.event("noop")  # must not raise, must not allocate a span
+        assert t.flush_flight("off") is None
+
+    def test_null_span_is_inert(self):
+        with NULL_SPAN as s:
+            s.event("e", k=1)
+            s.annotate("k", "v")
+            assert s.context() is None
+            assert s.span_id is None
+
+    def test_sampling_is_deterministic_across_instances(self, tmp_path):
+        a = make_tracer(tmp_path, "trc-samp", rank=0, sample=0.5)
+        b = make_tracer(tmp_path, "trc-samp", rank=1, sample=0.5)
+        decisions = [a.sampled(r) for r in range(64)]
+        assert decisions == [b.sampled(r) for r in range(64)]
+        assert any(decisions) and not all(decisions)
+        full = make_tracer(tmp_path, "trc-samp-full", sample=1.0)
+        assert all(full.sampled(r) for r in range(16))
+        off = make_tracer(tmp_path, "trc-samp-zero", sample=0.0)
+        assert not any(off.sampled(r) for r in range(16))
+
+
+# ---------------------------------------------------------------------------
+# clock alignment
+# ---------------------------------------------------------------------------
+
+
+class TestClockOffsetEstimator:
+    def test_recovers_synthetic_skew(self):
+        est = ClockOffsetEstimator()
+        skew = 1.7  # peer clock runs 1.7s ahead of ours
+        rng = np.random.RandomState(3)
+        t = 100.0
+        for _ in range(32):
+            up, down = rng.uniform(0.001, 0.05, size=2)
+            t_send = t
+            t_peer_recv = t + up + skew
+            t_peer_send = t_peer_recv + 0.002
+            t_recv = t_peer_send - skew + down
+            est.add_pair(t_send, t_peer_recv, t_peer_send, t_recv)
+            t += 0.5
+        offset, uncertainty = est.estimate()
+        # the min-delay pair bounds asymmetry error by delay/2
+        assert abs(offset - skew) <= uncertainty + 1e-9
+        assert abs(offset - skew) < 0.05
+
+    def test_min_delay_pair_wins(self):
+        est = ClockOffsetEstimator()
+        # a tight, symmetric pair: exact offset, tiny delay
+        est.add_pair(0.0, 2.001, 2.002, 0.003)
+        # a wildly asymmetric, slow pair that would mis-estimate
+        est.add_pair(10.0, 12.9, 12.901, 10.902)
+        offset, uncertainty = est.estimate()
+        assert abs(offset - 2.0) < 0.01
+        assert uncertainty < 0.01
+
+    def test_window_slides(self):
+        est = ClockOffsetEstimator(window=4)
+        for i in range(10):
+            est.add_pair(i, i + 1.0, i + 1.001, i + 0.01)
+        assert est.n == 4
+
+    def test_empty_estimate_is_none(self):
+        assert ClockOffsetEstimator().estimate() is None
+
+
+# ---------------------------------------------------------------------------
+# span recording + flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestSpans:
+    def test_nested_spans_parent_on_same_thread(self, tmp_path):
+        t = make_tracer(tmp_path, "trc-nest")
+        with t.span("outer", round_idx=1) as outer:
+            with t.span("inner") as inner:
+                assert inner.parent == outer.span_id
+                assert inner.round_idx == 1  # inherited from ambient parent
+        recs = [r for r in t._ring if r.get("kind") == tracing.SPAN_KIND]
+        assert [r["name"] for r in recs] == ["inner", "outer"]
+
+    def test_explicit_end_is_idempotent(self, tmp_path):
+        t = make_tracer(tmp_path, "trc-idem")
+        with t.span("s") as s:
+            s.end()
+        spans = [r for r in t._ring if r.get("kind") == tracing.SPAN_KIND]
+        assert len(spans) == 1
+
+    def test_adopted_context_parents_new_spans(self, tmp_path):
+        t = make_tracer(tmp_path, "trc-adopt")
+        t.adopt(TraceContext("trc-adopt", 5, "9.9.9"))
+        try:
+            with t.span("handler_work") as s:
+                assert s.parent == "9.9.9" and s.round_idx == 5
+            assert t.current_context().span_id == "9.9.9"
+        finally:
+            t.adopt(None)
+        assert t.current_context() is None
+
+    def test_event_attaches_to_open_span_never_a_span(self, tmp_path):
+        t = make_tracer(tmp_path, "trc-ev")
+        with t.span("upload") as s:
+            t.event("send_retry", attempt=1)
+        rec = next(r for r in t._ring if r.get("name") == "upload")
+        assert rec["events"][0]["name"] == "send_retry"
+        assert not any(r.get("kind") == tracing.SPAN_KIND
+                       and r.get("name") == "send_retry" for r in t._ring)
+
+    def test_flight_postmortem_names_phase_and_open_spans(self, tmp_path):
+        t = make_tracer(tmp_path, "trc-flight")
+        t.note_phase("mid_fold", 7)
+        open_span = t.span("fold", round_idx=7)
+        path = t.flush_flight("kill_server:mid_fold")
+        open_span.end()
+        post = tracing.read_postmortem(str(tmp_path), "trc-flight", 0)
+        assert post is not None and path is not None
+        assert post["reason"] == "kill_server:mid_fold"
+        assert post["last_phase"]["phase"] == "mid_fold"
+        assert post["last_phase"]["round"] == 7
+        assert [s["name"] for s in post["open_spans"]] == ["fold"]
+        # the merge recovers the open span from the flight ring
+        spans, _clocks = tracing.read_trace([path])
+        assert any(s["name"] == "fold" for s in spans)
+
+
+# ---------------------------------------------------------------------------
+# analysis plane
+# ---------------------------------------------------------------------------
+
+
+def synth_span(span, name, t0, dur, rank=0, pid=100, parent=None,
+               round_idx=0, client=None, annot=None):
+    rec = {"kind": tracing.SPAN_KIND, "v": 1, "run": "synth", "rank": rank,
+           "pid": pid, "span": span, "parent": parent, "name": name,
+           "round": round_idx, "ts": 1000.0 + t0, "mono": t0,
+           "dur": dur}
+    if client is not None:
+        rec["client"] = client
+    if annot:
+        rec["annot"] = annot
+    return rec
+
+
+def synth_chain(round_idx=0, client=1, base=0.0, slow=0.0):
+    """dispatch → upload → admission → queue_wait → fold, one client."""
+    cpid = 200 + client
+    tag = f"r{round_idx}c{client}"
+    return [
+        synth_span(f"0.100.d{tag}", "dispatch", base + 0.0, 0.01,
+                   round_idx=round_idx, client=client),
+        synth_span(f"{client}.{cpid}.u{tag}", "upload", base + 0.05 + slow,
+                   0.01, rank=client, pid=cpid,
+                   parent=f"0.100.d{tag}", round_idx=round_idx,
+                   client=client),
+        synth_span(f"0.100.a{tag}", "admission", base + 0.07 + slow, 0.002,
+                   parent=f"{client}.{cpid}.u{tag}", round_idx=round_idx,
+                   client=client),
+        synth_span(f"0.100.q{tag}", "queue_wait", base + 0.073 + slow,
+                   0.004, parent=f"0.100.a{tag}", round_idx=round_idx,
+                   client=client),
+        synth_span(f"0.100.f{tag}", "fold", base + 0.077 + slow, 0.006,
+                   parent=f"0.100.q{tag}", round_idx=round_idx,
+                   client=client),
+    ]
+
+
+class TestAnalysis:
+    def test_critical_path_walks_chain_with_transit_gaps(self):
+        merged = tracing.merge_trace(synth_chain())
+        path = tracing.critical_path(merged, 0)
+        names = [s["name"] for s in path]
+        assert names == ["dispatch", "transit", "upload", "transit",
+                         "admission", "transit", "queue_wait", "fold"]
+        # the think-time gap dominates, and segment durations are exact
+        transit = sum(s["dur_s"] for s in path if s["name"] == "transit")
+        assert transit == pytest.approx(0.051, abs=1e-9)
+        assert tracing.critical_path(merged, 99) == []
+
+    def test_straggler_attribution_blames_the_slow_client(self):
+        spans = (synth_chain(client=1) + synth_chain(client=2, slow=0.4)
+                 + synth_chain(round_idx=1, client=1, base=1.0)
+                 + synth_chain(round_idx=1, client=2, base=1.0, slow=0.4))
+        merged = tracing.merge_trace(spans)
+        top = tracing.straggler_attribution(merged, k=2)
+        assert top[0]["client"] == 2
+        assert top[0]["rounds_gated"] == 2
+        assert top[0]["wait_s"] == pytest.approx(0.8, abs=1e-6)
+
+    def test_dispatch_ready_sums_fold_plus_queue_wait(self):
+        spans = synth_chain(client=1) + synth_chain(client=2, slow=0.2)
+        merged = tracing.merge_trace(spans)
+        total, folds = tracing.dispatch_ready_from_trace(merged)
+        assert folds == 2
+        assert total == pytest.approx(2 * (0.006 + 0.004), abs=1e-9)
+
+    def test_dispatch_ready_excludes_unobserved_folds(self):
+        spans = synth_chain(client=1)
+        stale = synth_chain(client=2)
+        stale[-1]["annot"] = {"outcome": "stale"}
+        merged = tracing.merge_trace(spans + stale)
+        total, folds = tracing.dispatch_ready_from_trace(merged)
+        assert folds == 1
+        assert total == pytest.approx(0.010, abs=1e-9)
+
+    def test_wall_anchor_alignment_rebases_cross_process_spans(self):
+        # the client process's monotonic clock starts 500s apart from the
+        # server's, but both share a wall clock (same host): the anchor
+        # fallback must land the upload INSIDE its causal window
+        server = synth_span("0.100.d", "dispatch", 1000.0, 0.01)
+        client = synth_span("1.201.u", "upload", 1500.05, 0.01, rank=1,
+                            pid=201, parent="0.100.d")
+        client["ts"] = 1000.05 + 1000.0  # wall: 50ms after dispatch t0
+        merged = tracing.merge_trace([server, client])
+        by_name = {m["name"]: m for m in merged["spans"]}
+        assert by_name["upload"]["t0"] == pytest.approx(0.05, abs=1e-6)
+        assert merged["orphans"] == []
+
+    def test_chrome_export_shape(self):
+        merged = tracing.merge_trace(synth_chain())
+        chrome = tracing.to_chrome(merged)
+        evs = chrome["traceEvents"]
+        assert sum(1 for e in evs if e["ph"] == "X") == 5
+        assert all(e["ts"] >= 0 for e in evs if e["ph"] == "X")
+        assert any(e["ph"] == "M" for e in evs)
+
+
+class TestMergeDeterminism:
+    def _write_files(self, tmp_path):
+        spans = (synth_chain(client=1) + synth_chain(client=2, slow=0.1))
+        f1 = tmp_path / "run_synth_edge_0.jsonl"
+        f2 = tmp_path / "run_synth_edge_1.jsonl"
+        with open(f1, "w") as f:
+            for rec in spans[:4]:
+                f.write(json.dumps(rec) + "\n")
+        with open(f2, "w") as f:
+            for rec in spans[4:]:
+                f.write(json.dumps(rec) + "\n")
+        return [str(f1), str(f2)]
+
+    def test_merge_is_byte_identical_regardless_of_file_order(
+            self, tmp_path):
+        paths = self._write_files(tmp_path)
+        outs = []
+        for order in (paths, list(reversed(paths)), paths):
+            spans, clocks = tracing.read_trace(order)
+            outs.append(json.dumps(tracing.merge_trace(spans, clocks),
+                                   sort_keys=True))
+        assert outs[0] == outs[1] == outs[2]
+
+    def test_duplicate_records_dedupe_on_span_identity(self, tmp_path):
+        paths = self._write_files(tmp_path)
+        # a flight-recorder ring replays the same spans the sink already
+        # holds: the merge must not double-count
+        spans1, _ = tracing.read_trace(paths)
+        spans2, _ = tracing.read_trace(paths + paths)
+        assert len(spans1) == len(spans2)
+
+    def test_torn_jsonl_tail_is_tolerated(self, tmp_path):
+        paths = self._write_files(tmp_path)
+        with open(paths[0], "a") as f:
+            f.write('{"kind": "trace_span", "truncated')  # crashed writer
+        spans, _ = tracing.read_trace(paths)
+        assert len(spans) == 10
+
+
+# ---------------------------------------------------------------------------
+# traced federation end-to-end (loopback, under transport faults)
+# ---------------------------------------------------------------------------
+
+
+def make_args(tmp_path, run_id, **kw):
+    base = dict(
+        training_type="cross_silo", dataset="synthetic", model="lr",
+        client_num_in_total=2, client_num_per_round=2, comm_round=3,
+        epochs=1, batch_size=8, learning_rate=0.2, backend="LOOPBACK",
+        run_id=run_id, frequency_of_the_test=1000,
+        enable_tracing=True, trace_sample=1.0, trace_dir=str(tmp_path),
+        enable_tracking=True, tracking_dir=str(tmp_path),
+    )
+    base.update(kw)
+    return fedml.init(Arguments(overrides=base), should_init_logs=False)
+
+
+def run_traced_world(tmp_path, run_id, faulty=False, **kw):
+    args_s = make_args(tmp_path, run_id, role="server", **kw)
+    ds, od = data_mod.load(args_s)
+    bundle = model_mod.create(args_s, od)
+    server = FedMLCrossSiloServer(args_s, None, ds, bundle)
+    clients = []
+    for rank in (1, 2):
+        args_c = make_args(tmp_path, run_id, role="client", rank=rank, **kw)
+        if faulty:
+            plan = FaultPlan()
+            plan.loss(0.25, seed=100 + rank, visible=True)
+            plan.duplicate(p=0.4, seed=200 + rank)
+            args_c.fault_plan = plan
+        clients.append(FedMLCrossSiloClient(args_c, None, ds, bundle))
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    server.run()
+    for t in threads:
+        t.join(timeout=30)
+    for c in clients:
+        assert c.manager.done.is_set()
+    mlops.flush()
+    return server
+
+
+class TestTracedFederation:
+    def test_faulty_wire_never_duplicates_spans(self, tmp_path):
+        """Retries and dedup drops must stay events/annotations: under
+        visible loss + wire duplication, span ids stay globally unique and
+        every fold chain walks back to its dispatch (no orphans)."""
+        run_traced_world(tmp_path, "trc-fault", faulty=True)
+        files = tracing.collect_trace_files(str(tmp_path), "trc-fault")
+        spans, clocks = tracing.read_trace(files)
+        assert spans, "traced run produced no spans"
+        # raw (pre-dedup) records in the sink: globally unique span ids
+        raw_ids = []
+        for path in files:
+            if not path.endswith(".jsonl"):
+                continue
+            with open(path) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    if rec.get("kind") == tracing.SPAN_KIND:
+                        raw_ids.append(
+                            (rec["rank"], rec["pid"], rec["span"]))
+        assert len(raw_ids) == len(set(raw_ids))
+        names = {s["name"] for s in spans}
+        assert {"dispatch", "decode", "train", "upload", "fold"} <= names
+        # faults surface as events/annotations, never span names
+        assert not names & {"send_retry", "dedup_drop", "stale_epoch_drop"}
+        merged = tracing.merge_trace(spans, clocks)
+        assert merged["orphans"] == []
+        assert merged["rounds"] == [0, 1, 2]
+        for r in merged["rounds"]:
+            path = tracing.critical_path(merged, r)
+            assert path, f"round {r} has no critical path"
+
+    def test_untraced_run_is_bitwise_invisible(self, tmp_path):
+        server = run_traced_world(tmp_path, "trc-silent",
+                                  enable_tracing=False)
+        assert server.manager.world.trace.enabled is False
+        assert server.manager.world.trace.span("x") is NULL_SPAN
+        for path in tracing.collect_trace_files(str(tmp_path),
+                                                "trc-silent"):
+            if not path.endswith(".jsonl"):
+                continue
+            with open(path) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    assert rec.get("kind") not in (tracing.SPAN_KIND,
+                                                   tracing.CLOCK_KIND)
+                    assert "_trace" not in json.dumps(rec)
+
+    def test_heartbeat_probes_feed_clock_gauges(self, tmp_path):
+        run_traced_world(tmp_path, "trc-hb", heartbeat_s=0.1,
+                         heartbeat_miss_limit=10)
+        files = tracing.collect_trace_files(str(tmp_path), "trc-hb")
+        _spans, clocks = tracing.read_trace(files)
+        assert clocks, "heartbeat exchange emitted no trace_clock records"
+        for rec in clocks:
+            # same-host loopback: the offset estimate must be ~zero and
+            # bounded by the probe's own uncertainty claim
+            assert abs(rec["offset_s"]) < 0.5
+            assert rec["uncertainty_s"] >= 0
+        gauges = telemetry.registry().snapshot()["gauges"]
+        assert "trace.clock_offset_s" in gauges
+        assert "trace.clock_uncertainty_s" in gauges
+
+
+class TestTraceCLI:
+    def test_trace_cmd_merges_and_exports_chrome(self, tmp_path, capsys):
+        spans = synth_chain(client=1) + synth_chain(client=2, slow=0.1)
+        with open(tmp_path / "run_synth_edge_0.jsonl", "w") as f:
+            for rec in spans:
+                f.write(json.dumps(rec) + "\n")
+        from fedml_tpu.cli import main as cli_main
+
+        chrome = tmp_path / "out.chrome.json"
+        rc = cli_main(["trace", str(tmp_path), "--json",
+                       "--chrome", str(chrome)])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["spans"] == 10
+        assert out["orphans"] == []
+        assert out["critical_path"]
+        assert set(out["critical_path_segments"]) >= {"dispatch", "fold"}
+        assert json.load(open(chrome))["traceEvents"]
+
+    def test_trace_cmd_empty_dir_fails_cleanly(self, tmp_path, capsys):
+        from fedml_tpu.cli import main as cli_main
+
+        assert cli_main(["trace", str(tmp_path)]) == 1
+        assert "no trace files" in capsys.readouterr().out

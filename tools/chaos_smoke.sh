@@ -160,8 +160,18 @@ verdict = json.loads(sys.argv[1])
 assert verdict["ok"], verdict["problems"]
 assert verdict["parity"], verdict["problems"]
 assert verdict["preemption_exercised"], "the SIGKILL never fired"
+# the flight recorder's post-mortem must name the exact kill phase+round
+# (docs/tracing.md), and the merged trace must be orphan-free across the
+# kill+restart
+fr = verdict["flight_recorder"]
+assert fr and fr["phase"] == "mid_fold", fr
+assert fr["round"] == 1, fr
+assert verdict["trace_spans"] > 0, verdict
+assert verdict["trace_orphans"] == 0, verdict
 print("chaos_smoke: server-kill (loopback, mid_fold) OK —",
-      f"{verdict['rounds']} rounds x {verdict['clients']} clients")
+      f"{verdict['rounds']} rounds x {verdict['clients']} clients,",
+      f"post-mortem names {fr['phase']}@r{fr['round']},",
+      f"{verdict['trace_spans']} spans 0 orphans")
 EOF
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -199,8 +209,12 @@ verdict = json.loads(sys.argv[1])
 assert verdict["ok"], verdict["problems"]
 assert verdict["parity"], verdict["problems"]
 assert verdict["preemption_exercised"], "the SIGKILL never fired"
+fr = verdict["flight_recorder"]
+assert fr and fr["phase"] == "post_commit", fr
+assert verdict["trace_orphans"] == 0, verdict
 print("chaos_smoke: server-kill (gRPC failover, post_commit) OK —",
-      "surviving client procs resynced across the restart")
+      "surviving client procs resynced across the restart,",
+      f"post-mortem names {fr['phase']}@r{fr['round']}")
 EOF
 rc=$?
 if [ "$rc" -ne 0 ]; then

@@ -298,14 +298,7 @@ def _collect_accesses(func_node, owner: str, thread: bool, setup: bool,
                     walk(child, guarded, cur_owner, cur_setup)
                 continue
             if isinstance(child, (ast.With, ast.AsyncWith)):
-                g = guarded or any(
-                    _is_lock_expr(_dotted(i.context_expr), lock_attrs)
-                    for i in child.items
-                )
-                for i in child.items:
-                    walk(i.context_expr, guarded, cur_owner, cur_setup)
-                for stmt in child.body:
-                    walk(stmt, g, cur_owner, cur_setup)
+                _walk_with(child, guarded, cur_owner, cur_setup)
                 continue
             if isinstance(child, ast.Call):
                 ds = _dotted(child.func)
@@ -337,6 +330,24 @@ def _collect_accesses(func_node, owner: str, thread: bool, setup: bool,
                         cur_setup)
                 continue
             walk(child, guarded, cur_owner, cur_setup)
+
+    def _walk_with(w, guarded: bool, cur_owner: str,
+                   cur_setup: bool) -> None:
+        # dispatch on the With node ITSELF: body statements that are
+        # themselves With nodes must keep accumulating guards — walking
+        # their children directly would skip this branch and lose a
+        # ``with self._lock:`` nested inside another context manager
+        g = guarded or any(
+            _is_lock_expr(_dotted(i.context_expr), lock_attrs)
+            for i in w.items
+        )
+        for i in w.items:
+            walk(i.context_expr, guarded, cur_owner, cur_setup)
+        for stmt in w.body:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                _walk_with(stmt, g, cur_owner, cur_setup)
+            else:
+                walk(stmt, g, cur_owner, cur_setup)
 
     def _target_access(t: ast.expr, guarded: bool, cur_owner: str,
                        cur_setup: bool) -> None:

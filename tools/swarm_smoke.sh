@@ -19,6 +19,12 @@
 #     must serve the soak's encodes AND decodes (nonzero
 #     comm.wire.device_encodes / device_decodes, ZERO host fallbacks)
 #     while every step still completes — same protocol, different engine.
+#  leg 5 (traced grpc): the multiprocess gRPC soak again with --trace
+#     (docs/tracing.md): the merged cross-process trace must be orphan-
+#     free with a non-empty critical path for EVERY committed round, and
+#     the trace's Σ(fold + queue_wait) must reconcile with the
+#     traffic.dispatch_ready_s histogram sum within 5% — two instruments,
+#     one truth.
 #
 # This is the executable form of the traffic-plane contract;
 # tests/test_traffic.py is the fine-grained half.
@@ -152,5 +158,48 @@ print("swarm_smoke: device-wire OK —",
       f"{r['wire_device_decodes']:.0f} dev decodes, 0 fallbacks")
 EOF
 [ $? -ne 0 ] && { echo "swarm_smoke: FAIL — device-wire verdict" >&2; exit 1; }
+
+trace_dir=$(mktemp -d /tmp/swarm_smoke_trace.XXXXXX)
+traced=$(run_leg --clients 12 --steps 4 --buffer 6 --think_s 0.02 \
+    --backend grpc --procs 2 --ranks_per_port 6 --port 18973 \
+    --trace --trace_dir "$trace_dir" --seed 7 --timeout 200 \
+    --run_id swarm-smoke-traced)
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "swarm_smoke: FAIL — traced-grpc leg exited rc=$rc" >&2
+    printf '%s\n' "$traced" >&2
+    rm -rf "$trace_dir"
+    exit 1
+fi
+
+python - "$traced" <<'EOF'
+import json
+import sys
+
+r = json.loads(sys.argv[1])
+assert r["ok"], r
+assert all(rc == 0 for rc in r["worker_exit_codes"]), r["worker_exit_codes"]
+assert r["trace_spans"] and r["trace_spans"] > 0, r
+assert r["trace_orphans"] == 0, f"orphaned spans: {r['trace_orphans']}"
+assert r["critical_path_segments"], r
+# every committed round has a walkable critical path
+assert r["trace_rounds_with_path"] == r["trace_rounds"] > 0, r
+# the trace and the histogram measured the SAME dispatch→ready time
+hist_sum = r["dispatch_ready_s"]["sum"]
+trace_sum = r["trace_dispatch_ready_s"]
+assert hist_sum and hist_sum > 0, r
+rel = abs(hist_sum - trace_sum) / hist_sum
+assert rel <= 0.05, (
+    f"trace/telemetry divergence {100 * rel:.1f}%: "
+    f"hist {hist_sum:.4f}s vs trace {trace_sum:.4f}s")
+segs = ", ".join(f"{k} {100 * v:.0f}%"
+                 for k, v in sorted(r["critical_path_segments"].items(),
+                                    key=lambda kv: -kv[1])[:3])
+print("swarm_smoke: traced-grpc OK —",
+      f"{r['trace_spans']} spans / {r['trace_rounds']} rounds, 0 orphans,",
+      f"reconciles within {100 * rel:.1f}%, critical path: {segs}")
+EOF
+[ $? -ne 0 ] && { echo "swarm_smoke: FAIL — traced-grpc verdict" >&2; rm -rf "$trace_dir"; exit 1; }
+rm -rf "$trace_dir"
 
 echo "swarm_smoke: PASS"
