@@ -53,11 +53,14 @@ def _world_overrides(a) -> Dict:
         learning_rate=0.2, backend="LOOPBACK", frequency_of_the_test=1000,
         random_seed=int(a.seed),
     )
-    if _kill_phase(a) or float(getattr(a, "heartbeat_s", 0.0) or 0.0) > 0:
+    if _kill_phase(a) or _edge_fault(a) \
+            or float(getattr(a, "heartbeat_s", 0.0) or 0.0) > 0:
         # server-kill legs need the client liveness/resync FSM: a fast
         # lease so a dead server is detected within ~a second, and a
         # patient reconnect budget that rides out the restart leg's
-        # process spawn + jax import (tens of seconds on a cold host)
+        # process spawn + jax import (tens of seconds on a cold host).
+        # Edge-fault legs run the same FSM one tier down (client↔edge,
+        # edge↔root).
         over.update(
             heartbeat_s=float(getattr(a, "heartbeat_s", 0.0) or 0.3),
             heartbeat_miss_limit=2,
@@ -65,7 +68,13 @@ def _world_overrides(a) -> Dict:
             resync_backoff_max_s=2.0,
             resync_max_attempts=90,
         )
-    if _partition_window(a) is not None:
+    if _edge_fault(a):
+        # a killed edge's orphans must give up on the corpse quickly and
+        # re-home to a sibling (docs/robustness.md "Edge tier failure
+        # domains") instead of burning the whole resync budget on it
+        over.update(rehome_after_attempts=2)
+    if _partition_window(a) is not None \
+            or _edge_partition_window(a) is not None:
         # a healed partition must cost backoff, not contributions: give
         # the at-least-once layer enough retry budget to outlast the cut
         over.update(comm_retry_max_attempts=10)
@@ -98,6 +107,33 @@ def _world_overrides(a) -> Dict:
 
 def _kill_phase(a) -> str:
     return str(getattr(a, "kill_phase", "") or "")
+
+
+def _edge_count(a) -> int:
+    return int(getattr(a, "edges", 0) or 0)
+
+
+def _edge_kill_phase(a) -> str:
+    return str(getattr(a, "kill_edge", "") or "")
+
+
+def _edge_partition_window(a):
+    """Parse ``--edge-partition START:DURATION`` — the root–edge cut — or
+    None when unset."""
+    raw = str(getattr(a, "edge_partition", "") or "")
+    if not raw:
+        return None
+    try:
+        start_s, dur_s = raw.split(":", 1)
+        return float(start_s), float(dur_s)
+    except ValueError as e:
+        raise ValueError(
+            f"--edge-partition wants START:DURATION seconds, got {raw!r}"
+        ) from e
+
+
+def _edge_fault(a) -> bool:
+    return bool(_edge_kill_phase(a) or _edge_partition_window(a) is not None)
 
 
 def _partition_window(a):
@@ -223,6 +259,13 @@ def run_world(a, run_id: str, checkpoint_dir: str, faulty: bool,
     grpc_leg = (faulty and not server_only and str(
         getattr(a, "transport", "loopback")).lower() == "grpc")
     port = free_port() if grpc_leg else int(getattr(a, "port", 0) or 0)
+    # the edge tier rides the FAULTY leg only: the reference leg stays a
+    # flat fault-free federation, so the bitwise verdict proves a 2-tier
+    # chaos run converges to EXACTLY the flat FedBuff params
+    tiered = faulty and _edge_count(a) > 0
+    if tiered and (grpc_leg or server_only):
+        raise ValueError(
+            "chaos --edges composes with the loopback transport only")
 
     def mk(role, rank=0):
         overrides = dict(
@@ -230,6 +273,11 @@ def run_world(a, run_id: str, checkpoint_dir: str, faulty: bool,
             checkpoint_dir=checkpoint_dir,
             checkpoint_rounds=int(a.checkpoint_rounds),
         )
+        if tiered:
+            overrides.update(
+                hierarchy_edges=_edge_count(a),
+                hierarchy_edge_rank_base=int(a.clients) + 1,
+            )
         if grpc_leg or server_only:
             overrides.update(backend="GRPC", comm_port=port,
                              comm_host="127.0.0.1")
@@ -244,6 +292,32 @@ def run_world(a, run_id: str, checkpoint_dir: str, faulty: bool,
     ds, od = data_mod.load(args_s)
     bundle = model_mod.create(args_s, od)
     server = FedMLCrossSiloServer(args_s, None, ds, bundle)
+
+    edge_managers: List = []
+    if tiered:
+        from fedml_tpu.core.distributed.faults import FaultPlan
+        from fedml_tpu.hierarchy import EdgeAggregatorManager, Topology
+
+        topo = Topology.from_args(args_s)
+        ekill = _edge_kill_phase(a)
+        ewin = _edge_partition_window(a)
+        for i, er in enumerate(topo.edge_ranks):
+            args_e = mk("client", er)
+            if i == 0 and (ekill or ewin is not None):
+                # the FIRST edge is the designated failure domain: it takes
+                # the kill switch (in-process fail-stop at the armed phase,
+                # first hit) and/or the root-link cut; its siblings stay
+                # healthy so orphaned clients have somewhere to re-home
+                plan = FaultPlan()
+                if ekill:
+                    plan.kill_edge(ekill, -1)
+                if ewin is not None:
+                    plan.partition({0}, start_s=ewin[0], duration_s=ewin[1])
+                args_e.fault_plan = plan
+            edge = EdgeAggregatorManager(args_e, rank=er,
+                                         size=topo.world_size)
+            edge.run_async()
+            edge_managers.append(edge)
 
     partition = _partition_window(a) if faulty else None
     clients = []
@@ -310,6 +384,11 @@ def run_world(a, run_id: str, checkpoint_dir: str, faulty: bool,
         deadline = time.monotonic() + 5.0
         for t in threads:
             t.join(timeout=max(deadline - time.monotonic(), 0.05))
+        for em in edge_managers:
+            # clean FINISH already tore these down via _on_root_finish;
+            # a killed edge's world is drained here instead
+            em.done.set()
+            em.finish()
     if kill_round >= 0:
         stop_watch.set()
         watcher.join(timeout=5.0)
@@ -321,6 +400,7 @@ def run_world(a, run_id: str, checkpoint_dir: str, faulty: bool,
         "params": leaves,
         "server": server.manager,
         "preempted": bool(server.manager.preempted),
+        "edges": edge_managers,
     }
 
 
@@ -351,6 +431,34 @@ def run_worker(a) -> int:
             for r, per in result["server"].contrib_counts.items()
         },
     }
+    if result.get("edges"):
+        # the tiered leg's edge verdict half: which edges the fault plan
+        # actually fail-stopped, plus the re-homing/dedup counters the
+        # orchestrator gates on (everything runs in THIS process under
+        # loopback, so the registry sees all tiers)
+        from fedml_tpu.core.mlops import telemetry
+
+        counters = telemetry.registry().snapshot()["counters"]
+        report["edge_tier"] = {
+            "edges": len(result["edges"]),
+            "killed_edges": sorted(
+                e.rank for e in result["edges"] if e.killed),
+            "edge_kill_exercised": any(e.killed for e in result["edges"]),
+            "rehomed_clients": counters.get("comm.rehomes", 0.0),
+            "root_adoptions": counters.get("edge.root_adoptions", 0.0),
+            "edge_rehome_adoptions": counters.get(
+                "edge.rehomed_clients", 0.0),
+            "resolicited_updates": counters.get(
+                "edge.resolicited_updates", 0.0),
+            "edge_resyncs": counters.get("comm.edge_resyncs", 0.0),
+            "heartbeat_misses": counters.get("comm.heartbeat_misses", 0.0),
+            "resync_replays": counters.get("comm.resync_replays", 0.0),
+            "replay_dedup_drops": counters.get(
+                "traffic.replay_dedup_drops", 0.0),
+            "summaries_folded": counters.get("edge.summaries_folded", 0.0),
+            "direct_client_updates": counters.get(
+                "edge.direct_client_updates", 0.0),
+        }
     with open(os.path.join(a.out, REPORT_FILE), "w") as f:
         json.dump(report, f, indent=2, sort_keys=True)
     if not result["preempted"]:
@@ -377,6 +485,9 @@ def _worker_cmd(a, out: str, ckpt_dir: str, kill_round: int,
         "--kill-round", str(kill_round),
         "--kill-phase", kill_phase,
         "--partition", str(getattr(a, "partition", "") or ""),
+        "--edges", str(_edge_count(a)),
+        "--kill-edge", _edge_kill_phase(a),
+        "--edge-partition", str(getattr(a, "edge_partition", "") or ""),
         "--transport", str(getattr(a, "transport", "loopback")),
         "--compression", str(getattr(a, "compression", "") or ""),
         "--compression_ratio", str(getattr(a, "compression_ratio", 0.1)),
@@ -439,6 +550,12 @@ def orchestrate(a) -> int:
 
     kill_round = int(a.kill_round)
     kill_phase = _kill_phase(a)
+    if _edge_fault(a) and not kill_phase:
+        # edge-fault legs complete in ONE worker process: the edge dies (or
+        # rides out its partition) in-process and the federation must
+        # survive it — the default self-SIGTERM would add an unrelated
+        # server preemption on top
+        kill_round = -1
     if kill_phase:
         # server-kill legs run traced: the pre-SIGKILL flight-recorder
         # flush must leave a post-mortem naming the kill phase, and the
@@ -571,6 +688,46 @@ def orchestrate(a) -> int:
     if bad_cohorts:
         problems.append(f"rounds aggregated a partial cohort: {bad_cohorts}")
 
+    edge_block = None
+    if _edge_count(a) > 0:
+        # tiered-leg verdict half: the worker's report must show the armed
+        # edge fault actually fired AND the orphans found a new home —
+        # a leg that never exercised the failure domain proves nothing
+        try:
+            with open(os.path.join(chaos_out, REPORT_FILE),
+                      encoding="utf-8") as f:
+                edge_block = (json.load(f) or {}).get("edge_tier")
+        except (OSError, ValueError):
+            edge_block = None
+        if not edge_block:
+            problems.append("tiered leg wrote no edge_tier report block")
+        else:
+            if float(edge_block.get("direct_client_updates", 0) or 0) > 0 \
+                    and not _edge_kill_phase(a):
+                # direct updates are LEGAL only as the degraded mode an
+                # edge death forces; any other leg must stay two-tier
+                problems.append("root folded direct client updates in a "
+                                "fault-free edge tier")
+            if _edge_kill_phase(a):
+                if not edge_block.get("edge_kill_exercised"):
+                    problems.append(
+                        f"edge kill phase {_edge_kill_phase(a)!r} never "
+                        "fired — the armed phase was not reached")
+                rehomed = (float(edge_block.get("rehomed_clients", 0) or 0)
+                           + float(edge_block.get("root_adoptions", 0)
+                                   or 0))
+                if rehomed <= 0:
+                    problems.append(
+                        "edge kill leg saw no client re-homing")
+            if _edge_partition_window(a) is not None:
+                cut_seen = (
+                    float(edge_block.get("heartbeat_misses", 0) or 0)
+                    + float(edge_block.get("resync_replays", 0) or 0))
+                if cut_seen <= 0:
+                    problems.append(
+                        "root–edge partition leg never exercised the "
+                        "edge resync FSM (no heartbeat miss, no replay)")
+
     flight_verdict = None
     trace_spans = None
     trace_orphans = None
@@ -581,7 +738,7 @@ def orchestrate(a) -> int:
     verdict = {
         "ok": not problems,
         "parity": not any("leaf" in p or "arity" in p for p in problems),
-        "preemption_exercised": killed,
+        "preemption_exercised": bool(killed),
         "rounds": int(a.rounds),
         "clients": int(a.clients),
         "fault_matrix": {"loss": float(a.loss),
@@ -590,7 +747,12 @@ def orchestrate(a) -> int:
                          "seed": int(a.seed),
                          "kill_phase": kill_phase or None,
                          "partition": str(getattr(a, "partition", "")
-                                          or "") or None},
+                                          or "") or None,
+                         "edges": _edge_count(a) or None,
+                         "kill_edge": _edge_kill_phase(a) or None,
+                         "edge_partition": str(getattr(a, "edge_partition",
+                                                       "") or "") or None},
+        "edge_tier": edge_block,
         "problems": problems,
         "workdir": workdir,
         "flight_recorder": flight_verdict,

@@ -187,6 +187,7 @@ def cmd_top(args) -> int:
         _print_delta_summary(metrics)
         _print_wire_summary(metrics)
         _print_recovery_summary(metrics)
+        _print_edge_summary(metrics)
     _print_trace_summary(events)
     return 0
 
@@ -273,6 +274,38 @@ def _print_recovery_summary(metrics: dict) -> None:
         print(f"  partial rounds: {partial:.0f}   late folds: {late:.0f}"
               f"   late superseded: "
               f"{counters.get('traffic.late_superseded', 0):.0f}")
+
+
+def _print_edge_summary(metrics: dict) -> None:
+    """The hierarchical-tier story (edge.* family, docs/traffic.md
+    "Hierarchical edge tier"): how many pre-folded summaries the root
+    consumed instead of raw client updates, and what the edge failure
+    domains absorbed (re-homing, re-solicited replays, degraded-mode
+    adoptions). Silent when the run was flat."""
+    counters = metrics.get("counters", {})
+    folded = counters.get("edge.summaries_folded", 0)
+    folds = counters.get("edge.folds", 0)
+    if not (folded or folds):
+        return
+    print("\nedge tier (hierarchical aggregation):")
+    print(f"  summaries folded at root: {folded:.0f} "
+          f"({counters.get('edge.summary_entries', 0):.0f} client entries)"
+          f"   edge folds: {folds:.0f}   direct client updates: "
+          f"{counters.get('edge.direct_client_updates', 0):.0f}")
+    rehomed = counters.get("comm.rehomes", 0)
+    adopted = counters.get("edge.rehomed_clients", 0)
+    root_adopt = counters.get("edge.root_adoptions", 0)
+    resolicited = counters.get("edge.resolicited_updates", 0)
+    dedup = counters.get("edge.buffer_dedup_drops", 0)
+    replay_drops = counters.get("traffic.replay_dedup_drops", 0)
+    if rehomed or adopted or root_adopt or resolicited or dedup \
+            or replay_drops:
+        print(f"  re-homed clients: {rehomed:.0f} "
+              f"(edge adoptions {adopted:.0f}, root adoptions "
+              f"{root_adopt:.0f})   re-solicited replays: "
+              f"{resolicited:.0f}")
+        print(f"  dedup drops: {dedup:.0f} edge buffer / "
+              f"{replay_drops:.0f} root replay")
 
 
 def _print_delta_summary(metrics: dict) -> None:
@@ -891,6 +924,28 @@ def main(argv=None) -> int:
                          "with --transport grpc the client processes "
                          "SURVIVE the kill and resync onto the restarted "
                          "server (heartbeat miss -> c2s_resync -> replay)")
+    p_chaos.add_argument("--edges", type=int, default=0, metavar="E",
+                         help="hierarchical edge-aggregation tier for the "
+                         "FAULTY leg: E edge aggregators between clients "
+                         "and root (the reference leg stays flat — the "
+                         "bitwise verdict proves 2-tier ≡ flat). Loopback "
+                         "transport only")
+    p_chaos.add_argument("--kill-edge", dest="kill_edge", default="",
+                         choices=("", "pre_fold", "mid_fold",
+                                  "post_commit"),
+                         help="fail-stop the FIRST edge aggregator at this "
+                         "protocol phase (first hit): its clients must "
+                         "detect the death, re-home to a sibling edge (or "
+                         "the root), replay their cached updates, and the "
+                         "run must still finish bitwise-equal with "
+                         "exactly-once contributions. Needs --edges >= 2")
+    p_chaos.add_argument("--edge-partition", dest="edge_partition",
+                         default="", metavar="START:DURATION",
+                         help="cut the FIRST edge off from the root for "
+                         "the window (seconds since leg start) — the edge "
+                         "rides it out on its resync FSM and re-ships its "
+                         "cached summary; dedup + the committed-round "
+                         "guard keep contributions exactly-once")
     p_chaos.add_argument("--partition", default="",
                          metavar="START:DURATION",
                          help="cut the server off from every client for "
@@ -955,6 +1010,15 @@ def main(argv=None) -> int:
     p_swarm.add_argument("--dropout", type=float, default=0.0,
                          help="per-dispatch device dropout probability")
     p_swarm.add_argument("--seed", type=int, default=7)
+    p_swarm.add_argument("--tiers", type=int, default=1,
+                         help="aggregation tiers: 2 inserts an edge-"
+                         "aggregator tier between devices and root "
+                         "(~1 edge per 100 devices unless --edges is "
+                         "given); root then folds E pre-folded summaries "
+                         "per bump instead of N raw updates")
+    p_swarm.add_argument("--edges", type=int, default=0, metavar="E",
+                         help="explicit edge-aggregator count for the "
+                         "tiered soak (implies --tiers 2)")
     p_swarm.add_argument("--backend", choices=("loopback", "grpc"),
                          default="loopback")
     p_swarm.add_argument("--procs", type=int, default=2,

@@ -95,6 +95,21 @@ class FedMLCommManager(Observer):
         self._thread.start()
         return self._thread
 
+    def bump_epoch(self) -> None:
+        """Start a fresh delivery epoch (new SenderStamp: new epoch, seq
+        from 0). A client RE-HOMING to a sibling edge calls this before
+        replaying its cached update: the stamp's seq counter is shared
+        across receivers, so by re-home time the cached update's original
+        seq sits far below the adoptive edge's window floor — a fresh
+        window would misclassify the replay as a duplicate. Under a NEW
+        epoch the adoptive edge's window resets and accepts it, while the
+        old (live, merely partitioned) edge still holds the ORIGINAL
+        stamped copy and dedups any straggler of it — both sides pinned in
+        tests/test_delivery.py."""
+        from .delivery import SenderStamp
+
+        self._stamp = SenderStamp()
+
     def send_message(self, message: Message) -> None:
         from .delivery import TransientSendError, arrays_digest
         from .payload_store import PAYLOAD_REF_KEY

@@ -47,7 +47,14 @@ and applies a ``FaultPlan``:
   cross-silo server SIGKILLs its own process (no drain, no atexit — the
   true crash) when its protocol reaches ``phase`` ∈ {``pre_fold``,
   ``mid_fold``, ``post_commit``} of round ``round``. The chaos harness
-  restarts it with ``--resume auto`` and the surviving clients resync.
+  restarts it with ``--resume auto`` and the surviving clients resync;
+- ``kill_edge(phase, round)`` — the edge-aggregator analog, attached to
+  the EDGE's own plan: the edge fail-stops in-process via
+  :meth:`FaultyComm.kill` (sends vanish, receive loop goes dark, the
+  entry buffer dies unshipped) when its protocol reaches ``phase``
+  (pre_fold = a client update arrives; mid_fold = summary built but not
+  sent; post_commit = summary sent). Its orphaned clients heartbeat-miss
+  and re-home (docs/robustness.md "Edge tier failure domains").
 
 Rules match on the Message header only (sender/receiver/round), never on
 payloads, so injection composes with compression/encryption layers.
@@ -86,6 +93,11 @@ class FaultPlan:
     # phase of this round
     kill_phase: Optional[str] = None
     kill_round: int = -1
+    # edge kill switch (consumed by hierarchy/edge_manager.py): in-process
+    # fail-stop of the edge aggregator at this protocol phase — attach to
+    # the edge's OWN plan (the hook carries no rank)
+    edge_kill_phase: Optional[str] = None
+    edge_kill_round: int = -1
 
     KILL_PHASES = ("pre_fold", "mid_fold", "post_commit")
 
@@ -170,6 +182,31 @@ class FaultPlan:
         self.kill_phase = str(phase)
         self.kill_round = int(round_idx)
         return self
+
+    def kill_edge(self, phase: str, round_idx: int = -1) -> "FaultPlan":
+        """Arm the edge kill switch: the edge aggregator fail-stops
+        in-process (``FaultyComm.kill`` — sends vanish, receive loop goes
+        dark, the entry buffer is never drained) when ITS protocol
+        reaches ``phase`` at replica version ``round_idx`` — or at the
+        first time ``phase`` is reached when ``round_idx`` is -1."""
+        if phase not in self.KILL_PHASES:
+            raise ValueError(
+                f"kill_edge phase must be one of {self.KILL_PHASES}, "
+                f"got {phase!r}"
+            )
+        self.edge_kill_phase = str(phase)
+        self.edge_kill_round = int(round_idx)
+        return self
+
+    def maybe_kill_edge(self, phase: str, round_idx: int) -> bool:
+        """True exactly when the armed edge kill matches (phase, round).
+        Unlike :meth:`maybe_kill_server` this returns instead of
+        SIGKILLing — the edge manager performs the in-process fail-stop
+        itself (and latches, so the switch fires once)."""
+        if self.edge_kill_phase != phase:
+            return False
+        return (self.edge_kill_round < 0
+                or self.edge_kill_round == int(round_idx))
 
     def maybe_kill_server(self, phase: str, round_idx: int) -> None:
         """SIGKILL this process if the switch is armed for (phase, round).

@@ -12,6 +12,18 @@ from ..ml.aggregator import create_server_aggregator
 from ..ml.trainer import create_model_trainer
 
 
+def _world_size(args) -> int:
+    """Comm world size: server + clients in a flat world; the full rank
+    space [root, clients, edge aggregators] in a tiered one
+    (fedml_tpu/hierarchy/topology.py)."""
+    from ..hierarchy import Topology
+
+    topo = Topology.from_args(args)
+    if topo is not None:
+        return topo.world_size
+    return int(getattr(args, "client_num_in_total", 1)) + 1
+
+
 class FedMLCrossSiloServer:
     def __init__(self, args, device, dataset, model, server_aggregator=None):
         from .server_manager import FedMLServerManager
@@ -20,7 +32,7 @@ class FedMLCrossSiloServer:
         aggregator = server_aggregator or create_server_aggregator(model, args)
         aggregator.set_id(0)
         opt = str(getattr(args, "federated_optimizer", "FedAvg"))
-        size = int(getattr(args, "client_num_in_total", 1)) + 1
+        size = _world_size(args)
         if opt == constants.FEDML_FEDERATED_OPTIMIZER_LSA:
             from .lightsecagg.lsa_server_manager import LightSecAggServerManager
 
@@ -66,7 +78,7 @@ class FedMLCrossSiloClient:
         trainer = client_trainer or create_model_trainer(model, args)
         rank = int(getattr(args, "rank", 1))
         trainer.set_id(rank)
-        size = int(getattr(args, "client_num_in_total", 1)) + 1
+        size = _world_size(args)
         backend = str(getattr(args, "backend", constants.COMM_BACKEND_LOOPBACK))
         opt = str(getattr(args, "federated_optimizer", "FedAvg"))
 

@@ -124,6 +124,30 @@ class ClientMasterManager(FedMLCommManager):
         self._fsm_state = "running"   # running | resync | lost
         self._resync_attempt = 0
         self._last_server_traffic = time.monotonic()
+        # seeded backoff jitter (docs/robustness.md "thundering herd"):
+        # an edge kill orphans a whole lease block at once — bare
+        # exponential backoff would retry every orphan on the same
+        # schedule against the adoptive edge. U[0.5,1.5) per attempt,
+        # deterministic per (world seed, rank).
+        seed = int(getattr(args, "random_seed", 0) or 0)
+        self._backoff_rng = np.random.RandomState(
+            (seed * 1_000_003 + rank * 7919) % (2 ** 31 - 1))
+        # -- hierarchical edge tier (docs/robustness.md "Edge tier failure
+        # domains"): this client's serving target is its HOME EDGE, not the
+        # root; on edge death the resync budget against the corpse runs out
+        # and the client re-homes around the sibling ring, then to the root
+        from ..hierarchy import Topology
+
+        topo = Topology.from_args(args)
+        if topo is not None and topo.is_client(rank):
+            self._server_rank = topo.home_edge(rank)
+            self._rehome_targets = topo.rehome_targets(rank)
+            self._rehome_after = int(
+                getattr(args, "rehome_after_attempts", 3) or 3)
+        else:
+            self._server_rank = 0
+            self._rehome_targets = []
+            self._rehome_after = 0
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -147,6 +171,9 @@ class ClientMasterManager(FedMLCommManager):
         self.register_message_receive_handler(
             MyMessage.MSG_TYPE_S2C_RESYNC_ACK, self._on_resync_ack
         )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_E2C_RESOLICIT, self._on_resolicit
+        )
 
     def _on_connection_ready(self, msg: Message) -> None:
         self._note_server_traffic()
@@ -164,7 +191,8 @@ class ClientMasterManager(FedMLCommManager):
         """The ONE ONLINE announcement (connection-ready AND the delta
         base-missing recovery both send it — the server resets this
         client's liveness and ACK state on receipt)."""
-        status = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
+        status = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank,
+                         self._server_rank)
         status.add(MyMessage.MSG_ARG_KEY_CLIENT_STATUS,
                    MyMessage.CLIENT_STATUS_ONLINE)
         self.send_message(status)
@@ -209,7 +237,8 @@ class ClientMasterManager(FedMLCommManager):
             )
             self._attempt_resync()
         elif running:
-            hb = Message(MyMessage.MSG_TYPE_C2S_HEARTBEAT, self.rank, 0)
+            hb = Message(MyMessage.MSG_TYPE_C2S_HEARTBEAT, self.rank,
+                         self._server_rank)
             hb.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
             # clock probe (docs/tracing.md): our monotonic send time rides
             # the heartbeat; the ack echoes it with the server's clocks
@@ -256,8 +285,16 @@ class ClientMasterManager(FedMLCommManager):
                 "never came back", self.rank, self._resync_max_attempts,
             )
             return
+        if self._rehome_after > 0 and attempt > self._rehome_after \
+                and self._rehome_targets:
+            # the resync budget against this edge ran out and siblings
+            # remain: abandon the corpse instead of burning the rest of
+            # the attempt budget on it
+            self._rehome()
+            return
         self.world.telemetry.counter_inc("comm.reconnects")
-        msg = Message(MyMessage.MSG_TYPE_C2S_RESYNC, self.rank, 0)
+        msg = Message(MyMessage.MSG_TYPE_C2S_RESYNC, self.rank,
+                      self._server_rank)
         msg.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self._last_trained_round)
         if self._s2c_delta_on:
             # the resync doubles as a delta ACK: this client still holds
@@ -271,10 +308,95 @@ class ClientMasterManager(FedMLCommManager):
                         self.rank, attempt, e)
         delay = min(self._resync_base_s * (2.0 ** (attempt - 1)),
                     self._resync_max_s)
+        # seeded jitter x U[0.5,1.5): de-synchronizes a lease block's worth
+        # of orphans without breaking per-world determinism
+        delay *= 0.5 + self._backoff_rng.rand()
         t = threading.Timer(delay, self._attempt_resync)
         t.daemon = True
         self.world.register_timer(t)
         t.start()
+
+    def _rehome(self) -> None:
+        """Adopt the next failover target (sibling ring, then root): bump
+        the delivery epoch, re-target the cached update, and send
+        ``c2e_rehome`` — its ``s2c_resync_ack`` flips us back to RUNNING
+        and replays the cached update iff the adoptive edge's committed
+        record does not cover it.
+
+        The epoch bump is what makes the replay land exactly once: the
+        stamp's seq counter is shared across receivers, so the cached
+        update's ORIGINAL seq sits below the adoptive edge's dedup-window
+        floor (a false duplicate), while the old — possibly merely
+        partitioned — edge still dedups the original-stamped copy it
+        already accepted. Fresh epoch: new window at the adoptive edge,
+        stale-epoch drops for any late sends to nobody."""
+        with self._fsm_lock:
+            if self._fsm_state != "resync" or not self._rehome_targets:
+                return
+            old = self._server_rank
+            target = self._rehome_targets.pop(0)
+            self._server_rank = target
+            self._resync_attempt = 0
+        self.world.telemetry.counter_inc("comm.rehomes")
+        logger.warning(
+            "client %d: edge %d unreachable — re-homing to %s %d",
+            self.rank, old, "root" if target == 0 else "edge", target,
+        )
+        self.bump_epoch()
+        cached = self._last_model_msg
+        if cached is not None:
+            # re-target the cached round result and strip its stamp: the
+            # replay (resync-ack path) restamps it under the new epoch
+            params = {
+                k: v for k, v in cached.get_params().items()
+                if k not in (Message.MSG_ARG_KEY_SEQ,
+                             Message.MSG_ARG_KEY_EPOCH)
+            }
+            params[Message.MSG_ARG_KEY_RECEIVER] = target
+            fresh = Message()
+            fresh.init(params)
+            fresh.set_arrays(cached.get_arrays())
+            self._last_model_msg = fresh
+        msg = Message(MyMessage.MSG_TYPE_C2E_REHOME, self.rank, target)
+        msg.add(MyMessage.MSG_ARG_KEY_OLD_EDGE, old)
+        msg.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self._last_trained_round)
+        if self._s2c_delta_on and self._last_trained_round >= 0:
+            # delta ACK: the globals we hold came from the root's single
+            # source of truth — the adoptive edge's replica has the same
+            # bytes, so S2C deltas can resume against our last version
+            msg.add(MyMessage.MSG_ARG_KEY_DELTA_CAPABLE, 1)
+        try:
+            self.send_message(msg)
+        except Exception as e:  # noqa: BLE001 — next attempt retries
+            logger.info("client %d: rehome send failed (%s)", self.rank, e)
+        delay = self._resync_base_s * (0.5 + self._backoff_rng.rand())
+        t = threading.Timer(delay, self._attempt_resync)
+        t.daemon = True
+        self.world.register_timer(t)
+        t.start()
+
+    def _on_resolicit(self, msg: Message) -> None:
+        """A restarted home edge recovering its fold buffer
+        (``e2c_resolicit``): re-offer the cached still-stamped update
+        verbatim — the restarted edge's fresh dedup window accepts it, the
+        root's committed-round guard drops it if the dead edge had already
+        shipped it. An edge we re-homed AWAY from gets nothing (our
+        contribution rides the adoptive edge now)."""
+        if msg.get_sender_id() != self._server_rank:
+            return
+        self._note_server_traffic()
+        cached = self._last_model_msg
+        if cached is None:
+            return
+        self.world.telemetry.counter_inc("comm.resolicit_replays")
+        logger.info(
+            "client %d: edge %d re-solicited — re-offering round-%d update",
+            self.rank, msg.get_sender_id(), self._last_trained_round,
+        )
+        try:
+            self.send_message(cached)
+        except Exception as e:  # noqa: BLE001
+            self._suspect_connection(f"resolicit replay failed: {e}")
 
     def _on_heartbeat_ack(self, msg: Message) -> None:
         self._note_server_traffic()
@@ -285,7 +407,8 @@ class ClientMasterManager(FedMLCommManager):
             # close the NTP-style probe pair: (our send, server recv,
             # server reply, our recv) → per-peer offset/uncertainty
             est = self.world.trace.clock_probe(
-                peer=0, t_send=float(t_echo), t_peer_recv=float(t_recv),
+                peer=self._server_rank, t_send=float(t_echo),
+                t_peer_recv=float(t_recv),
                 t_peer_send=float(t_reply), t_recv=time.monotonic())
             if est is not None:
                 self.world.telemetry.gauge_set(
@@ -322,7 +445,7 @@ class ClientMasterManager(FedMLCommManager):
                 # server lost the parking, and a live one parks the
                 # fresh pull idempotently (it is a set)
                 pull = Message(MyMessage.MSG_TYPE_C2S_PULL_REQUEST,
-                               self.rank, 0)
+                               self.rank, self._server_rank)
                 pull.add(MyMessage.MSG_ARG_KEY_ROUND_IDX,
                          self._last_trained_round)
                 if self._s2c_delta_on:
@@ -496,11 +619,15 @@ class ClientMasterManager(FedMLCommManager):
         cached = self._last_model_msg
         if cached is None or shed_round != self._last_trained_round:
             return  # superseded while we backed off
-        fresh = Message(cached.get_type(), self.rank, 0)
-        fresh.init({
+        params = {
             k: v for k, v in cached.get_params().items()
             if k not in (Message.MSG_ARG_KEY_SEQ, Message.MSG_ARG_KEY_EPOCH)
-        })
+        }
+        # re-target in the dict BEFORE init() — init re-derives receiver_id
+        # from the params (a re-home may have moved us since the shed)
+        params[Message.MSG_ARG_KEY_RECEIVER] = self._server_rank
+        fresh = Message()
+        fresh.init(params)
         fresh.set_arrays(cached.get_arrays())
         self.send_message(fresh)
 
@@ -531,7 +658,8 @@ class ClientMasterManager(FedMLCommManager):
                 self.round_idx,
             )
             params = self.dp.randomize(params, key)
-        msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
+        msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank,
+                      self._server_rank)
         msg.add(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(n))
         msg.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
         msg.add(MyMessage.MSG_ARG_KEY_TRAIN_LOSS,
@@ -580,7 +708,7 @@ class ClientMasterManager(FedMLCommManager):
                 # version now — the server answers as soon as it bumps past
                 # the round we just trained
                 pull = Message(MyMessage.MSG_TYPE_C2S_PULL_REQUEST,
-                               self.rank, 0)
+                               self.rank, self._server_rank)
                 pull.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
                 if self._s2c_delta_on:
                     pull.add(MyMessage.MSG_ARG_KEY_DELTA_CAPABLE, 1)

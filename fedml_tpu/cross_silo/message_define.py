@@ -48,6 +48,26 @@ class MyMessage:
     MSG_TYPE_SILO_RESULT = "silo_s2m_result"
     MSG_TYPE_SILO_FINISH = "silo_m2s_finish"
 
+    # hierarchical edge tier (docs/traffic.md "Hierarchical edge tier",
+    # docs/robustness.md "Edge tier failure domains"): an edge aggregator
+    # pre-folds its clients' updates CONTROL-PLANE-ONLY (admission, dedup,
+    # staleness annotation, canonical ordering) and ships the buffered
+    # entries up as ONE batched summary frame; the root expands the
+    # entries through the exact flat fold, which is what makes a 2-tier
+    # run bitwise-equal to flat FedBuff.
+    MSG_TYPE_E2S_EDGE_SUMMARY = "e2s_edge_summary"
+    # an edge (re)joining the root — same idempotent handshake shape as
+    # c2s_resync; the ack re-seeds the edge's model-store replica
+    MSG_TYPE_E2S_EDGE_RESYNC = "e2s_edge_resync"
+    # edge-death re-homing: an orphaned client adopting a sibling edge
+    # (or the root in degraded mode) after its resync budget ran out on
+    # the dead home edge; the ack is a plain s2c_resync_ack
+    MSG_TYPE_C2E_REHOME = "c2e_rehome"
+    # a restarted edge re-soliciting its leased clients' uncommitted
+    # updates (the edge-tier analog of _recover_serving_state): clients
+    # answer by re-offering their cached still-stamped update
+    MSG_TYPE_E2C_RESOLICIT = "e2c_resolicit"
+
     MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
     MSG_ARG_KEY_ROUND_IDX = "round_idx"
     MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
@@ -79,6 +99,16 @@ class MyMessage:
     MSG_ARG_KEY_HB_T_ECHO = "hb_t_echo"
     MSG_ARG_KEY_HB_T_RECV = "hb_t_recv"
     MSG_ARG_KEY_HB_T_REPLY = "hb_t_reply"
+
+    # hierarchical edge tier: the summary's per-entry control-plane
+    # metadata (sender/client_version/num_samples/codec meta per buffered
+    # update, JSON-encoded) and the edge's piggybacked health stats
+    # (folds, re-homed clients, staleness histogram) — stats ride the
+    # summary so they survive process boundaries under gRPC
+    MSG_ARG_KEY_SUMMARY_META = "edge_summary_meta"
+    MSG_ARG_KEY_EDGE_STATS = "edge_stats"
+    # c2e_rehome: the rank of the dead edge the client is abandoning
+    MSG_ARG_KEY_OLD_EDGE = "old_edge"
 
     CLIENT_STATUS_ONLINE = "ONLINE"
     CLIENT_STATUS_OFFLINE = "OFFLINE"

@@ -58,6 +58,7 @@ from ..delivery import (
 )
 from ..delivery.delta_codec import DELTA_KEY, payload_nbytes
 from ..delivery.payload_filter import FILTER_KEY, filter_from_args
+from ..hierarchy import Topology, unpack_summary
 from ..ml.evaluate import make_eval_fn
 from ..utils.tree import tree_flatten_to_vector, tree_unflatten_from_vector
 from .message_define import MyMessage
@@ -75,7 +76,23 @@ class FedMLServerManager(FedMLCommManager):
         self.bundle = model
         self.round_num = int(args.comm_round)
         self.round_idx = 0
-        self.client_num = self.size - 1
+        # hierarchical edge tier (fedml_tpu/hierarchy/, docs/traffic.md
+        # "Hierarchical edge tier"): in a tiered world the rank space is
+        # [root, clients 1..N, edges base..base+E-1] — client_num counts
+        # the CLIENTS, never the edge ranks
+        self.topology = Topology.from_args(args)
+        self.client_num = (self.topology.clients if self.topology is not None
+                           else self.size - 1)
+        # tiered serving state (all guarded by self._lock): which edges
+        # completed their handshake (the tiered init barrier), each edge's
+        # last piggybacked health stats, clients adopted DIRECTLY after
+        # exhausting their sibling ring (degraded mode), and the async
+        # in-flight (sender, client_version) set — with _committed_client_
+        # round it makes at-least-once summary delivery exactly-once
+        self._edge_online: set = set()
+        self._edge_stats: Dict[int, dict] = {}
+        self._direct_clients: set = set()
+        self._pending_folds: set = set()
         self._online = set()
         self._dead = set()  # clients that went OFFLINE or timed out
         self._offline_declared = set()  # explicit departures (never resync)
@@ -161,9 +178,14 @@ class FedMLServerManager(FedMLCommManager):
                               scoped=self.world.telemetry)
         # with the plane fully opted out (--s2c_delta off, no
         # --compression) the store never serves a decode or encode — skip
-        # the per-version full-vector copy + digest entirely
-        self._store_active = self.s2c_delta_on or bool(
+        # the per-version full-vector copy + digest entirely. A tiered
+        # world always keeps the store: edges delta-encode their summary
+        # entries against replica versions, and OUR copy of those versions
+        # is what decodes them (root and edge stores hold bitwise-equal
+        # vectors — both installed from the same dispatch).
+        self._store_active = (self.s2c_delta_on or bool(
             str(getattr(args, "compression", "") or ""))
+            or self.topology is not None)
         self.async_dispatch = str(
             getattr(args, "async_dispatch", "sync_on_consume")
             or "sync_on_consume").lower()
@@ -399,6 +421,18 @@ class FedMLServerManager(FedMLCommManager):
         self.register_message_receive_handler(
             MyMessage.MSG_TYPE_C2S_RESYNC, self._on_resync
         )
+        # hierarchical edge tier: summaries + the edge handshake, and the
+        # degraded-mode direct adoption of a client whose sibling ring is
+        # exhausted (c2e_rehome addressed to rank 0)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_E2S_EDGE_SUMMARY, self._on_edge_summary
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_E2S_EDGE_RESYNC, self._on_edge_resync
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2E_REHOME, self._on_rehome_root
+        )
         if self.async_mode:
             self.register_message_receive_handler(
                 MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
@@ -454,7 +488,11 @@ class FedMLServerManager(FedMLCommManager):
     def _barrier_ready_locked(self) -> bool:
         """Caller holds the lock. The init barrier counts the dead as
         resolved — a client that died during startup must not stall the
-        federation forever."""
+        federation forever. A tiered world barriers on its E edges
+        instead: clients announce ONLINE to their edge, never here."""
+        if self.topology is not None:
+            return (len(self._edge_online) >= self.topology.edges
+                    and not self._init_sent)
         return (
             len(self._online) + len(self._dead) >= self.client_num
             and len(self._online) > 0
@@ -546,6 +584,11 @@ class FedMLServerManager(FedMLCommManager):
             self._online.add(sender)
             self._dead.discard(sender)
             self._offline_declared.discard(sender)
+            if (self.topology is not None
+                    and self.topology.is_client(sender)):
+                # a client resyncing DIRECTLY against the root in a tiered
+                # world is already re-homed here — keep serving it
+                self._direct_clients.add(sender)
             # a parked client_pull survives the resync (unlike ONLINE,
             # which drops it — a restarted client re-pulls after INIT):
             # the reconnecting client is still waiting for the version
@@ -574,7 +617,12 @@ class FedMLServerManager(FedMLCommManager):
         client must not have its round discarded because someone else both
         contributed and left."""
         live_models = sum(1 for s in self._models if s not in self._dead)
-        expected = self.client_num - len(self._dead)
+        # only CLIENT deaths shrink the quorum — a dead edge rank (tiered
+        # worlds mark unreachable edges dead too) is a transport failure
+        # domain, not a missing contribution
+        dead_clients = sum(1 for d in self._dead
+                           if 1 <= d <= self.client_num)
+        expected = self.client_num - dead_clients
         return live_models >= max(expected, self.min_clients) > 0
 
     def _arm_round_timer(self) -> None:
@@ -616,7 +664,8 @@ class FedMLServerManager(FedMLCommManager):
                 self._arm_round_timer()  # keep the deadline alive
                 return
             missing = (
-                set(range(1, self.size)) - set(self._models) - self._dead
+                set(range(1, self.client_num + 1)) - set(self._models)
+                - self._dead
             )
             if not self._late_fold:
                 self._dead.update(missing)
@@ -637,11 +686,23 @@ class FedMLServerManager(FedMLCommManager):
             )
         self._finish_round(round_idx)
 
+    def _dispatch_targets(self) -> List[int]:
+        """Ranks a model fan-out addresses: every client in a flat world;
+        in a tiered one the E edges (each relays to its lease block from
+        its replica) plus any degraded-mode direct clients — the root's
+        fan-out cost is O(E), which is the entire scalability story."""
+        if self.topology is None:
+            return list(range(1, self.size))
+        with self._lock:
+            direct = sorted(self._direct_clients)
+        return self.topology.edge_ranks + direct
+
     def _send_init_msg(self) -> None:
         """reference: fedml_server_manager.py:93-118 (online barrier → init)."""
         leaves = [np.asarray(l) for l in jax.tree.leaves(self.global_params)]
         trc = self.world.trace
-        for client_rank in range(1, self.size):
+        targets = self._dispatch_targets()
+        for client_rank in targets:
             msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, client_rank)
             msg.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
             msg.add(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, client_rank - 1)
@@ -652,11 +713,15 @@ class FedMLServerManager(FedMLCommManager):
                            client=client_rank)
                   if trc.sampled(self.round_idx) else NULL_SPAN):
                 self._send_or_mark_dead(client_rank, msg)
-        logger.info("server: init sent to %d clients", self.client_num)
+        logger.info("server: init sent to %d ranks", len(targets))
         self._arm_round_timer()
 
     def _on_model_received(self, msg: Message) -> None:
         sender = msg.get_sender_id()
+        if self.topology is not None:
+            # tiered worlds fold summaries; a per-client update at the
+            # root means degraded mode (the swarm smoke asserts zero)
+            self.world.telemetry.counter_inc("edge.direct_client_updates")
         msg_round = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX,
                                 self.round_idx))
         with self._lock:
@@ -698,9 +763,30 @@ class FedMLServerManager(FedMLCommManager):
                 self._finish_round(msg_round)
             return
         n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0))
+        self._fold_sync_update(sender, msg_round, params, n)
+
+    def _fold_sync_update(self, sender: int, msg_round: int, params,
+                          n: float) -> None:
+        """Fold ONE decoded update into the open sync round — the shared
+        tail of the flat C2S path and the tiered edge-summary path (a
+        summary batches transport only; every entry folds through HERE,
+        which is the load-bearing half of the bitwise-parity argument).
+
+        The committed-round guard turns at-least-once delivery into
+        exactly-once at the ledger: a (client, round) contribution that
+        already aggregated — a re-homed client's replay racing the dead
+        edge's shipped summary, or a partition-healed edge re-shipping its
+        last summary verbatim — drops here instead of double-counting."""
         late = False
         staleness = 0
         with self._lock:
+            if msg_round <= self._committed_client_round.get(sender, -1):
+                self.world.telemetry.counter_inc(
+                    "traffic.replay_dedup_drops")
+                logger.info(
+                    "server: round-%d update from client %d already "
+                    "aggregated — replay dropped", msg_round, sender)
+                return
             staleness = self.round_idx - msg_round
             if staleness < 0:
                 return  # a round tag from the future: corrupt header
@@ -752,6 +838,204 @@ class FedMLServerManager(FedMLCommManager):
             )
         if have_all:
             self._finish_round(fold_round)
+
+    # -- hierarchical edge tier (fedml_tpu/hierarchy/, docs/traffic.md) -----
+
+    def _on_edge_summary(self, msg: Message) -> None:
+        """One pre-folded edge summary: expand its entry list and run
+        every entry through the SAME decode + fold path a flat client
+        message takes (entry-preserving parity, hierarchy/summary.py).
+        The root folds E summaries per bump instead of N messages — the
+        transport scales, the math never changes. Admission composes per
+        tier: the whole summary is offered once; a shed NACKs the EDGE,
+        which re-offers it freshly stamped after retry_after_s."""
+        edge = msg.get_sender_id()
+        self._record_ack(msg)
+        meta = msg.get(MyMessage.MSG_ARG_KEY_SUMMARY_META) or {}
+        try:
+            entries = unpack_summary(meta, msg.get_arrays())
+        except ValueError as e:
+            self.world.telemetry.counter_inc("edge.summary_decode_errors")
+            logger.error("server: undecodable summary from edge %d: %s",
+                         edge, e)
+            return
+        with self._lock:
+            # a summary proves the edge lives (partition heal without a
+            # separate handshake) and refreshes its piggybacked stats
+            self._edge_online.add(edge)
+            self._dead.discard(edge)
+            stats = meta.get("stats")
+            if stats:
+                self._edge_stats[edge] = stats
+            head = self.round_idx
+        self.world.telemetry.counter_inc("edge.summaries_folded")
+        self.world.telemetry.counter_inc("edge.summary_entries",
+                                         len(entries))
+        if self.async_mode:
+            self._enqueue_summary_entries(edge, head, entries)
+            return
+        self._maybe_kill("pre_fold", head)
+        for e in entries:
+            params = self._reconstruct_entry(e)
+            if params is None:
+                continue
+            self._fold_sync_update(int(e["sender"]),
+                                   int(e["client_version"]), params,
+                                   float(e["num_samples"]))
+
+    def _reconstruct_entry(self, e: Dict):
+        """Decode one summary entry into a full params pytree. An edge's
+        lossless delta re-encode (``dmeta``) decodes against OUR store —
+        root and edge replicas hold bitwise-equal version vectors, both
+        installed from the same dispatch, so the round-trip is exact.
+        Client-encoded entries (compression codec / payload filter) and
+        plain frames go through the flat ``_reconstruct_update``."""
+        dmeta = e.get("dmeta")
+        if dmeta is None:
+            return self._reconstruct_update(
+                int(e["sender"]), int(e["client_version"]), e["arrays"],
+                e.get("codec_meta"), e.get("filter_meta"))
+        base = self.store.get(int(dmeta["base_version"]))
+        if base is None:
+            self.world.telemetry.counter_inc("comm.delta.c2s_base_missing")
+            logger.warning(
+                "server: edge summary entry references version %s the "
+                "store evicted — dropping the entry (client %s resyncs)",
+                dmeta.get("base_version"), e.get("sender"))
+            return None
+        vec = self.wire.decode(base, e["arrays"], dmeta)
+        return tree_unflatten_from_vector(jnp.asarray(vec), self._treedef,
+                                          self._shapes)
+
+    def _enqueue_summary_entries(self, edge: int, head: int,
+                                 entries: List[Dict]) -> None:
+        """Async tiered ingest: expand a summary into per-entry fold-queue
+        items (edge delta frames decode HERE, on the comm thread, against
+        the store — losslessly back to plain leaves — so the aggregator
+        worker's flat decode path applies unchanged). One admission offer
+        covers the whole summary: all entries enqueue or none do."""
+        items = []
+        for e in entries:
+            arrays = e["arrays"]
+            codec_meta, filter_meta = e.get("codec_meta"), e.get("filter_meta")
+            dmeta = e.get("dmeta")
+            if dmeta is not None:
+                base = self.store.get(int(dmeta["base_version"]))
+                if base is None:
+                    self.world.telemetry.counter_inc(
+                        "comm.delta.c2s_base_missing")
+                    logger.warning(
+                        "server: edge summary entry references version %s "
+                        "the store evicted — dropping the entry",
+                        dmeta.get("base_version"))
+                    continue
+                vec = np.asarray(self.wire.decode(base, arrays, dmeta))
+                sizes = [int(np.prod(s)) if s else 1 for s in self._shapes]
+                arrays = [seg.reshape(s) for seg, s in zip(
+                    np.split(vec, np.cumsum(sizes)[:-1]), self._shapes)]
+                codec_meta = filter_meta = None
+            items.append((
+                time.monotonic(), int(e["sender"]),
+                int(e["client_version"]), float(e["num_samples"]),
+                arrays, codec_meta, filter_meta, None,
+            ))
+        if not items:
+            return
+        verdict = self.admission.offer(lambda: self._try_enqueue_many(items))
+        if not verdict.admitted:
+            self._shed_reply(edge, head, verdict)
+
+    def _try_enqueue_many(self, items: List) -> bool:
+        """All-or-nothing enqueue for one summary's entries. The comm
+        receive thread is the only producer, so the capacity probe cannot
+        race another enqueue."""
+        if (self._rx.maxsize > 0
+                and self._rx.qsize() + len(items) > self._rx.maxsize):
+            return False
+        for it in items:
+            self._rx.put_nowait(it)
+        return True
+
+    def _on_edge_resync(self, msg: Message) -> None:
+        """The edge handshake — ONLINE announcement, partition-heal resync
+        and restart re-seed in one idempotent message (the client resync
+        one tier up). The ack's head round doubles as the edge's restart
+        detector: an edge holding a fresh replica (version < 0) in an
+        already-running world re-solicits its lease block's cached
+        updates instead of losing the buffer its predecessor held."""
+        edge = msg.get_sender_id()
+        edge_version = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, -1))
+        self.world.telemetry.counter_inc("comm.edge_resyncs")
+        self._record_ack(msg)
+        if self.done.is_set():
+            fin = Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, edge)
+            fin.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
+            fin.set_arrays(
+                [np.asarray(l) for l in jax.tree.leaves(self.global_params)])
+            self._send_or_mark_dead(edge, fin)
+            return
+        with self._lock:
+            self._edge_online.add(edge)
+            self._dead.discard(edge)
+            head = self.round_idx
+            init_sent = self._init_sent
+            ready = self._barrier_ready_locked()
+            if ready:
+                self._init_sent = True
+        logger.info("server: edge %d resynced (replica at %d, head %d)",
+                    edge, edge_version, head)
+        ack = Message(MyMessage.MSG_TYPE_S2C_RESYNC_ACK, self.rank, edge)
+        ack.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, head)
+        ack.add(MyMessage.MSG_ARG_KEY_COMMITTED_ROUND, -1)
+        self._send_or_mark_dead(edge, ack)
+        if ready:
+            self._post_barrier()
+        elif init_sent and edge_version < head:
+            # partition-healed or mid-world edge: re-seed its replica with
+            # the head (delta against an ACKed base when it echoed one)
+            self._send_model_to(
+                edge, MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
+
+    def _on_rehome_root(self, msg: Message) -> None:
+        """Degraded mode: a client that exhausted its sibling ring homes
+        directly on the root, which serves it exactly like a flat client
+        from here on (the fan-out adds it alongside the edges)."""
+        sender = msg.get_sender_id()
+        self.world.telemetry.counter_inc("edge.root_adoptions")
+        self._record_ack(msg)
+        if self.done.is_set():
+            fin = Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, sender)
+            fin.set_arrays(
+                [np.asarray(l) for l in jax.tree.leaves(self.global_params)])
+            self._send_or_mark_dead(sender, fin)
+            return
+        with self._lock:
+            self._direct_clients.add(sender)
+            self._online.add(sender)
+            self._dead.discard(sender)
+            self._offline_declared.discard(sender)
+            committed = self._committed_client_round.get(sender, -1)
+            head = self.round_idx
+            init_sent = self._init_sent
+        logger.info("server: adopted re-homed client %d (old edge %s)",
+                    sender, msg.get(MyMessage.MSG_ARG_KEY_OLD_EDGE))
+        ack = Message(MyMessage.MSG_TYPE_S2C_RESYNC_ACK, self.rank, sender)
+        ack.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, head)
+        ack.add(MyMessage.MSG_ARG_KEY_COMMITTED_ROUND, committed)
+        self._send_or_mark_dead(sender, ack)
+        # re-engage: the ack's committed round decides the client's replay;
+        # a missed version bump restarts its round loop
+        client_round = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, -1))
+        if init_sent and client_round < head:
+            self._send_model_to(
+                sender, MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
+
+    def edge_report(self) -> Dict[str, dict]:
+        """Per-edge health block for the swarm report / `fedml_tpu top`:
+        each edge's last piggybacked stats snapshot (fold count, re-homed
+        clients, re-solicited updates, summary staleness histogram)."""
+        with self._lock:
+            return {str(k): dict(v) for k, v in self._edge_stats.items()}
 
     # -- delta delivery plane: C2S decode (fedml_tpu/delivery/) -------------
 
@@ -981,7 +1265,7 @@ class FedMLServerManager(FedMLCommManager):
             if self._store_active:
                 self.store.put(version, vec)  # graftlint: disable=G005
             cache: Dict[int, tuple] = {}
-            targets = [r for r in range(1, self.size)
+            targets = [r for r in self._dispatch_targets()
                        if r not in self._offline_declared]
             self._prefill_encode_cache(targets, vec, cache, version)
             for client_rank in targets:
@@ -1053,7 +1337,9 @@ class FedMLServerManager(FedMLCommManager):
 
     def _broadcast_finish(self, log_msg: str) -> None:
         leaves = [np.asarray(l) for l in jax.tree.leaves(self.global_params)]
-        for client_rank in range(1, self.size):
+        for client_rank in self._dispatch_targets():
+            # tiered worlds address the edges, each of which relays the
+            # FINISH (with the final arrays) to its whole lease block
             msg = Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank,
                           client_rank)
             msg.set_arrays(leaves)
@@ -1095,6 +1381,8 @@ class FedMLServerManager(FedMLCommManager):
         from ..core.compression import UpdateCodec
 
         sender = msg.get_sender_id()
+        if self.topology is not None:
+            self.world.telemetry.counter_inc("edge.direct_client_updates")
         client_version = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, -1))
         # admission span continues the client's upload trace (the comm
         # layer adopted the wire context before dispatching here); its own
@@ -1205,6 +1493,21 @@ class FedMLServerManager(FedMLCommManager):
         t_enq, sender, client_version, n, arrays, codec_meta, \
             filter_meta, tctx = item
         self._maybe_kill("pre_fold", self.round_idx)
+        with self._lock:
+            # exactly-once under at-least-once delivery (tiered replays:
+            # a re-homed client's cached update racing the dead edge's
+            # shipped summary, or a healed edge re-shipping verbatim):
+            # drop a (client, version) already committed to a step — or
+            # already sitting in the fold buffer awaiting one
+            dup = (client_version <= self._committed_client_round.get(
+                       sender, -1)
+                   or (sender, client_version) in self._pending_folds)
+        if dup:
+            self.world.telemetry.counter_inc("traffic.replay_dedup_drops")
+            logger.info(
+                "server: version-%d update from client %d already "
+                "folded/committed — replay dropped", client_version, sender)
+            return
         trc = self.world.trace
         traced = trc.enabled and tctx is not None
         fold_parent = None
@@ -1243,6 +1546,10 @@ class FedMLServerManager(FedMLCommManager):
                 # an accepted (or even stale) update proves the client lives
                 self._dead.discard(sender)
                 self._offline_declared.discard(sender)
+                if verdict == "buffered":
+                    # in-buffer half of the exactly-once guard — cleared
+                    # when the step that drains this entry commits
+                    self._pending_folds.add((sender, client_version))
             if verdict == "stale":
                 # beyond max_staleness: the update is discarded, but the
                 # sender rejoins at version head with a fresh model
@@ -1271,6 +1578,7 @@ class FedMLServerManager(FedMLCommManager):
             per_round = self.contrib_counts.setdefault(round_r, {})
             for e in entries:
                 per_round[e.sender] = per_round.get(e.sender, 0) + 1
+                self._pending_folds.discard((e.sender, e.client_version))
                 # what the resync ack reports: the client's last trained
                 # version whose update entered a server step
                 if e.client_version > self._committed_client_round.get(
@@ -1312,7 +1620,14 @@ class FedMLServerManager(FedMLCommManager):
         vec = flatten_leaves(leaves)
         if self._store_active:
             self.store.put(version, vec)
-        if self.async_dispatch == "server_push":
+        if self.topology is not None:
+            # tiered: every version bump goes to every edge — each relays
+            # to its whole lease block from its replica (client replay
+            # guards absorb repeats) — plus the degraded-mode directs.
+            # No client→edge map at the root, by design: re-homing moves
+            # a lease without telling us.
+            targets = [r for r in self._dispatch_targets() if r not in skip]
+        elif self.async_dispatch == "server_push":
             targets = [r for r in range(1, self.size) if r not in skip]
         elif self.async_dispatch == "client_pull":
             targets = sorted(pulls - skip)

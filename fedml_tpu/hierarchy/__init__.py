@@ -1,0 +1,34 @@
+"""Hierarchical edge-aggregation tier (ISSUE 19, docs/traffic.md
+"Hierarchical edge tier" / docs/robustness.md "Edge tier failure domains").
+
+A two-tier federation: E edge aggregators lease blocks of clients, run the
+FedBuff admission/dedup/staleness control plane locally against their own
+:class:`~fedml_tpu.delivery.VersionedModelStore` replica, and ship
+*entry-preserving* buffer summaries up to the root — one batched frame per
+summary instead of one message per client. The root expands the entries
+through the exact same decode + fold + aggregate code the flat path uses,
+which is what makes a 2-tier run bitwise-equal to flat FedBuff (float
+addition is non-associative, so any numerically pre-folded two-tier
+reduction could not be).
+
+reference: the shape named by ``cross_silo/client/process_group_manager.py``
+and the Beehive cross-device pillar — re-founded here as a failure-domain
+tier: edges crash, partition and straggle as first-class chaos subjects
+(clients re-home, edges resync, contributions fold exactly once).
+"""
+
+from .topology import Topology
+from .summary import pack_summary, unpack_summary
+
+__all__ = ["Topology", "pack_summary", "unpack_summary",
+           "EdgeAggregatorManager"]
+
+
+def __getattr__(name):
+    # EdgeAggregatorManager pulls in the comm stack (jax, transports);
+    # keep `from fedml_tpu.hierarchy import Topology` import-light
+    if name == "EdgeAggregatorManager":
+        from .edge_manager import EdgeAggregatorManager
+
+        return EdgeAggregatorManager
+    raise AttributeError(name)

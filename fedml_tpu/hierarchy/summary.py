@@ -1,0 +1,71 @@
+"""Entry-preserving edge buffer summaries (docs/traffic.md).
+
+An edge summary is ONE message carrying every update the edge's FedBuff
+buffer drained, as a list of *entries*. Each entry keeps the client's
+original control-plane identity — sender rank, the model version it
+trained against (``client_version``), its sample weight — next to its
+payload frame, verbatim or re-encoded as a lossless delta against the
+edge's model-store replica. The root expands the entries and runs the
+exact same decode + fold + aggregate code a flat world runs per client
+message; the summary only batches the *transport*, never the math. That
+is the entire bitwise-parity argument: float addition is non-associative,
+so a numerically pre-folded summary could not reproduce the flat
+trajectory — an entry-preserving one cannot fail to.
+
+Wire layout: the message's array list is the concatenation of the
+entries' frames; ``MSG_ARG_KEY_SUMMARY_META`` carries the JSON-safe
+per-entry metadata (including each entry's frame count, so unpacking is
+pure slicing) plus the edge's piggybacked health stats.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def pack_summary(entries: Sequence[Dict], stats: Optional[Dict] = None,
+                 seq: int = 0) -> Tuple[Dict, List]:
+    """``entries`` → ``(meta, arrays)`` for one summary message.
+
+    Each entry is a dict with ``sender`` / ``client_version`` /
+    ``num_samples`` / ``arrays`` and optionally ``codec_meta`` /
+    ``filter_meta`` (the client's own C2S encodings, forwarded untouched),
+    ``dmeta`` (an edge-side lossless delta re-encode of a plain frame
+    against the replica store) and ``staleness`` (edge-view annotation).
+    """
+    meta_entries = []
+    arrays: List = []
+    for e in entries:
+        frames = list(e["arrays"])
+        meta_entries.append({
+            "sender": int(e["sender"]),
+            "client_version": int(e["client_version"]),
+            "num_samples": float(e["num_samples"]),
+            "codec_meta": e.get("codec_meta"),
+            "filter_meta": e.get("filter_meta"),
+            "dmeta": e.get("dmeta"),
+            "staleness": int(e.get("staleness", 0)),
+            "k": len(frames),
+        })
+        arrays.extend(frames)
+    meta = {"seq": int(seq), "entries": meta_entries}
+    if stats is not None:
+        meta["stats"] = stats
+    return meta, arrays
+
+
+def unpack_summary(meta: Dict, arrays: Sequence) -> List[Dict]:
+    """Inverse of :func:`pack_summary`: slice the concatenated frame list
+    back into per-entry dicts (``arrays`` per entry, metadata inlined)."""
+    out: List[Dict] = []
+    i = 0
+    for m in meta.get("entries", ()):
+        k = int(m["k"])
+        e = dict(m)
+        e["arrays"] = list(arrays[i:i + k])
+        i += k
+        out.append(e)
+    if i != len(arrays):
+        raise ValueError(
+            f"edge summary: {len(arrays)} frames but entries consume {i}")
+    return out

@@ -1,0 +1,105 @@
+"""Rank topology of the hierarchical edge tier (docs/traffic.md).
+
+Flat worlds are rank 0 = server, ranks 1..N = clients. A tiered world
+keeps BOTH of those assignments untouched — clients keep the exact ranks,
+data shards (``client_index = rank - 1``) and sender ids they have in a
+flat world, which is what lets the chaos harness compare a tiered run
+bitwise against a flat reference — and appends E edge-aggregator ranks
+after the clients:
+
+    rank 0                      root server
+    ranks 1..N                  clients (unchanged from flat)
+    ranks base..base+E-1        edge aggregators (base = N+1 by default)
+
+``edge_rank_base`` may be pushed past N+1 to align edges onto their own
+gRPC port group when N is not a multiple of ``grpc_ranks_per_port``
+(port_for_rank maps contiguous rank blocks onto ports; an unaligned edge
+rank would land in the last device-host process's port). The padding
+ranks are simply never used.
+
+Clients are leased to edges in contiguous blocks (``home_edge``), and an
+orphaned client re-homes around the sibling ring — then to the root in
+degraded mode (``rehome_targets``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+
+@dataclass(frozen=True)
+class Topology:
+    clients: int
+    edges: int
+    edge_rank_base: int = 0  # 0 → clients + 1
+
+    def __post_init__(self):
+        if self.clients <= 0:
+            raise ValueError(f"clients must be positive, got {self.clients}")
+        if self.edges <= 0:
+            raise ValueError(f"edges must be positive, got {self.edges}")
+        if self.edges > self.clients:
+            raise ValueError(
+                f"more edges ({self.edges}) than clients ({self.clients})")
+        base = self.edge_rank_base or self.clients + 1
+        if base < self.clients + 1:
+            raise ValueError(
+                f"edge_rank_base {base} overlaps client ranks 1..{self.clients}")
+        object.__setattr__(self, "edge_rank_base", base)
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_args(cls, args) -> Optional["Topology"]:
+        """The tiered topology this world runs under, or None when flat.
+
+        ``hierarchy_edges`` is the single on/off knob (``--tiers 2`` at the
+        CLI resolves to a concrete edge count before args reach here).
+        """
+        edges = int(getattr(args, "hierarchy_edges", 0) or 0)
+        if edges <= 0:
+            return None
+        clients = int(getattr(args, "client_num_in_total", 0) or 0)
+        base = int(getattr(args, "hierarchy_edge_rank_base", 0) or 0)
+        return cls(clients=clients, edges=edges, edge_rank_base=base)
+
+    # -- rank classification -------------------------------------------------
+
+    @property
+    def world_size(self) -> int:
+        return self.edge_rank_base + self.edges
+
+    @property
+    def edge_ranks(self) -> List[int]:
+        return list(range(self.edge_rank_base, self.edge_rank_base + self.edges))
+
+    def is_client(self, rank: int) -> bool:
+        return 1 <= rank <= self.clients
+
+    def is_edge(self, rank: int) -> bool:
+        return self.edge_rank_base <= rank < self.edge_rank_base + self.edges
+
+    # -- leasing -------------------------------------------------------------
+
+    def home_edge(self, client_rank: int) -> int:
+        """The edge a client initially leases against (contiguous blocks)."""
+        if not self.is_client(client_rank):
+            raise ValueError(f"rank {client_rank} is not a client")
+        return self.edge_rank_base + ((client_rank - 1) * self.edges) // self.clients
+
+    def edge_clients(self, edge_rank: int) -> List[int]:
+        """The initial lease block of an edge (inverse of home_edge)."""
+        if not self.is_edge(edge_rank):
+            raise ValueError(f"rank {edge_rank} is not an edge")
+        return [c for c in range(1, self.clients + 1)
+                if self.home_edge(c) == edge_rank]
+
+    def rehome_targets(self, client_rank: int) -> List[int]:
+        """Failover order for an orphaned client: the sibling ring starting
+        just past its home edge, then rank 0 (root, degraded mode)."""
+        home = self.home_edge(client_rank)
+        ring = self.edge_ranks
+        i = ring.index(home)
+        siblings = ring[i + 1:] + ring[:i]
+        return siblings + [0]

@@ -165,6 +165,14 @@ class SwarmClientManager(FedMLCommManager):
         self.schedule = schedule
         self.timers = timers
         self.done = threading.Event()
+        # tiered worlds: a device speaks to its home edge aggregator, not
+        # the root — the same wire protocol, one hop down
+        from ..hierarchy import Topology
+
+        topo = Topology.from_args(args)
+        self._server_rank = (topo.home_edge(rank)
+                             if topo is not None and topo.is_client(rank)
+                             else 0)
         # (_version, _arrays) is a PAIR: the receive thread updates it on
         # dispatch while the timer wheel snapshots it at send time — the
         # lock keeps a delayed send from tagging version v on version
@@ -216,7 +224,8 @@ class SwarmClientManager(FedMLCommManager):
         """ONLINE announcement — also the delta-base-missing recovery (the
         server clears this device's ACK on receipt, so the next dispatch
         falls back to a full frame)."""
-        status = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
+        status = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank,
+                         self._server_rank)
         status.add(MyMessage.MSG_ARG_KEY_CLIENT_STATUS,
                    MyMessage.CLIENT_STATUS_ONLINE)
         self._send_quiet(status)
@@ -302,7 +311,8 @@ class SwarmClientManager(FedMLCommManager):
             arrays = self._arrays
             ctx = self._trace_ctx
         out = Message(
-            MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
+            MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank,
+            self._server_rank)
         out.add(MyMessage.MSG_ARG_KEY_ROUND_IDX, version)
         out.add(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0)
         if self._delta_on:
@@ -541,6 +551,7 @@ def _device_args(a, rank: int, backend: str):
         run_id=str(a.run_id), backend=backend,
         random_seed=int(a.seed),
         wire_path=_wire_path(a),
+        **_hierarchy_overrides(a, backend),
         **_trace_overrides(a),
     )
     if backend == constants.COMM_BACKEND_GRPC:
@@ -561,6 +572,66 @@ def _ranks_per_port(a) -> int:
         return explicit
     procs = max(int(getattr(a, "procs", 1) or 1), 1)
     return max((int(a.clients) + procs - 1) // procs, 1)
+
+
+def _edge_count(a) -> int:
+    """Edge aggregators for this soak: 0 = flat FedBuff. An explicit
+    ``--edges`` wins; a bare ``--tiers 2`` derives roughly one edge per
+    100 devices (min 2 so failover always has a sibling, max 64)."""
+    explicit = int(getattr(a, "edges", 0) or 0)
+    if explicit > 0:
+        return explicit
+    if int(getattr(a, "tiers", 1) or 1) < 2:
+        return 0
+    return max(2, min(int(a.clients) // 100, 64))
+
+
+def _edge_rank_base(a, backend: str) -> int:
+    """First edge rank: clients+1, pushed up to the next rank→port block
+    boundary under gRPC so the edge ranks (which live in the orchestrator
+    process) never share a port group with a device-host process."""
+    n = int(a.clients)
+    if backend != constants.COMM_BACKEND_GRPC:
+        return n + 1
+    per = _ranks_per_port(a)
+    return ((n + per - 1) // per) * per + 1
+
+
+def _hierarchy_overrides(a, backend: str) -> Dict:
+    """Topology knobs every tiered-soak participant (root, edges, devices)
+    must agree on — Topology.from_args keys off these."""
+    edges = _edge_count(a)
+    if edges <= 0:
+        return {}
+    return dict(hierarchy_edges=edges,
+                hierarchy_edge_rank_base=_edge_rank_base(a, backend))
+
+
+def _edge_args(a, rank: int, backend: str):
+    """Arguments for one in-orchestrator edge aggregator: async mode to
+    mirror the root's fold plane, plus the shared topology knobs."""
+    import fedml_tpu as fedml
+    from ..arguments import Arguments
+
+    overrides = dict(
+        training_type="cross_silo", dataset="synthetic", model="lr",
+        client_num_in_total=int(a.clients),
+        client_num_per_round=int(a.clients),
+        comm_round=int(a.steps), role="client", rank=int(rank),
+        run_id=str(a.run_id), backend=backend,
+        random_seed=int(a.seed),
+        wire_path=_wire_path(a),
+        aggregation_mode="async",
+        async_buffer_size=int(a.buffer),
+        **_hierarchy_overrides(a, backend),
+        **_trace_overrides(a),
+    )
+    if backend == constants.COMM_BACKEND_GRPC:
+        overrides.update(
+            comm_port=int(a.port), comm_host="127.0.0.1",
+            grpc_ranks_per_port=_ranks_per_port(a),
+        )
+    return fedml.init(Arguments(overrides=overrides), should_init_logs=False)
 
 
 def _percentiles(hist_summary: Optional[dict]) -> Dict:
@@ -602,7 +673,12 @@ def swarm_soak(a) -> Dict:
     threads_before = world_mod.thread_snapshot()
     t0 = time.monotonic()
 
-    server_over = dict(_server_overrides(a), backend=backend)
+    edges_n = _edge_count(a)
+    edge_base = _edge_rank_base(a, backend)
+    world_size = (edge_base + edges_n) if edges_n else int(a.clients) + 1
+
+    server_over = dict(_server_overrides(a), backend=backend,
+                       **_hierarchy_overrides(a, backend))
     if backend == constants.COMM_BACKEND_GRPC:
         server_over.update(comm_port=int(a.port), comm_host="127.0.0.1",
                            grpc_ranks_per_port=_ranks_per_port(a))
@@ -616,14 +692,42 @@ def swarm_soak(a) -> Dict:
     pump: Optional[LoopbackPump] = None
     spawner: Optional[ProcSpawner] = None
     devices: List[SwarmClientManager] = []
+    edge_managers: List = []
     server_thread: Optional[threading.Thread] = None
     try:
+        if edges_n:
+            # the edge tier lives in the orchestrator process: E is small
+            # (devices are the thing that scales), and keeping the edges
+            # here lets the report read their counters directly. Each edge
+            # is a first-class manager with its own receive loop.
+            from ..hierarchy import EdgeAggregatorManager
+
+            for er in range(edge_base, edge_base + edges_n):
+                eargs = _edge_args(a, er, backend)
+                if backend == constants.COMM_BACKEND_LOOPBACK:
+                    from ..core.distributed.loopback import (
+                        LoopbackCommManager,
+                    )
+
+                    edge = EdgeAggregatorManager(
+                        eargs,
+                        comm=LoopbackCommManager(er, world_size,
+                                                 str(a.run_id)),
+                        rank=er, size=world_size,
+                    )
+                else:
+                    edge = EdgeAggregatorManager(
+                        eargs, rank=er, size=world_size,
+                        backend=constants.COMM_BACKEND_GRPC,
+                    )
+                edge.run_async()
+                edge_managers.append(edge)
+
         if backend == constants.COMM_BACKEND_LOOPBACK:
             from ..core.distributed.loopback import LoopbackCommManager
 
             pump = LoopbackPump(str(a.run_id))
             n = int(a.clients)
-            world_size = n + 1
             for rank in range(1, n + 1):
                 dev = SwarmClientManager(
                     _device_args(a, rank, backend),
@@ -659,6 +763,10 @@ def swarm_soak(a) -> Dict:
                     "--s2c_delta", _s2c_delta(a),
                     "--wire_path", _wire_path(a),
                 )
+                if edges_n:
+                    # explicit count so worker processes resolve the same
+                    # topology (edge count + rank base) as the orchestrator
+                    cmd += ["--edges", str(edges_n)]
                 if _trace_on(a):
                     # device hosts join the same trace: the resolved dir is
                     # passed explicitly so orchestrator and workers agree
@@ -673,8 +781,10 @@ def swarm_soak(a) -> Dict:
             pump.start()
         server_thread.start()
         completed = server.manager.done.wait(timeout=float(a.timeout))
-        # let FINISH drain to the devices
+        # let FINISH drain to the edges, and through them to the devices
         deadline = time.monotonic() + 10.0
+        for edge in edge_managers:
+            edge.done.wait(timeout=max(deadline - time.monotonic(), 0.05))
         for dev in devices:
             dev.done.wait(timeout=max(deadline - time.monotonic(), 0.05))
         worker_rcs: List[Optional[int]] = []
@@ -687,6 +797,9 @@ def swarm_soak(a) -> Dict:
         if spawner is not None:
             spawner.kill_all()
         server.manager.done.set()  # unblock the worker on a timed-out soak
+        for edge in edge_managers:
+            edge.done.set()
+            edge.finish()
         server.manager.finish()
         if server_thread is not None:
             server_thread.join(timeout=10.0)
@@ -759,6 +872,30 @@ def swarm_soak(a) -> Dict:
         "step_s": _percentiles(hists.get("traffic.step_s")),
         "rss_peak_mb": round(rss_peak_mb(), 1),
     }
+    if edges_n:
+        # edge tier block (docs/traffic.md): the root must fold ONLY edge
+        # summaries — direct_client_updates > 0 means a device bypassed
+        # its home edge, and the swarm smoke gates on it staying 0
+        report["edge_tier"] = {
+            "edges": edges_n,
+            "edge_rank_base": edge_base,
+            "edges_finished": sum(
+                1 for e in edge_managers if e.done.is_set()),
+            "summaries_folded": counters.get("edge.summaries_folded", 0.0),
+            "summary_entries": counters.get("edge.summary_entries", 0.0),
+            "direct_client_updates": counters.get(
+                "edge.direct_client_updates", 0.0),
+            "edge_folds": counters.get("edge.folds", 0.0),
+            "summaries_sent": counters.get("edge.summaries_sent", 0.0),
+            "rehomed_clients": counters.get("edge.rehomed_clients", 0.0),
+            "resolicited_updates": counters.get(
+                "edge.resolicited_updates", 0.0),
+            "summary_decode_errors": counters.get(
+                "edge.summary_decode_errors", 0.0),
+            "per_edge": server.manager.edge_report(),
+        }
+    else:
+        report["edge_tier"] = None
     report.update(_trace_report(a))
     return report
 
@@ -801,7 +938,9 @@ def run_device_worker(a) -> int:
     [rank_base, rank_base+count) as real gRPC endpoints against the
     orchestrator's server. Spawned via :class:`ProcSpawner`."""
     n = int(a.clients)
-    world_size = n + 1
+    edges_n = _edge_count(a)
+    world_size = (_edge_rank_base(a, constants.COMM_BACKEND_GRPC) + edges_n
+                  if edges_n else n + 1)
     devices = []
     threads_before = world_mod.thread_snapshot()
     timers = TimerWheel()

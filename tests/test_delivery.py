@@ -125,6 +125,48 @@ class TestDedupWindow:
         assert w.accept(1, 1, 3) == "duplicate"
 
 
+class TestRehomeEpochBump:
+    """Why re-homing calls ``FedMLCommManager.bump_epoch`` (docs/
+    robustness.md "Edge tier failure domains"): a client's SenderStamp seq
+    counter is shared across receivers, so by the time an orphan re-homes,
+    the cached update it must replay carries a seq far below whatever
+    window its adoptive edge has accumulated — without a fresh epoch the
+    replay is indistinguishable from a replay attack and gets dropped."""
+
+    def test_old_seq_below_floor_is_false_duplicate_without_bump(self):
+        w = DedupWindow(window=8)
+        # the adoptive edge has been hearing this sender (heartbeats,
+        # resync probes) long enough to fill its window...
+        for seq in range(100, 108):
+            assert w.accept(1, 10, seq) == "accept"
+        # ...so the cached update's ORIGINAL early seq reads as a replay:
+        # this is the misclassification bump_epoch exists to prevent
+        assert w.accept(1, 10, 3) == "duplicate"
+
+    def test_bumped_epoch_resets_the_window_and_accepts(self):
+        w = DedupWindow(window=8)
+        for seq in range(100, 108):
+            assert w.accept(1, 10, seq) == "accept"
+        # re-home: the client starts a fresh epoch (new SenderStamp, seq
+        # from 0) and re-stamps the replay under it — accepted, and the
+        # new life's window is clean
+        assert w.accept(1, 11, 1) == "accept"
+        assert w.accept(1, 11, 1) == "duplicate"  # at-least-once retry
+
+    def test_old_home_edge_still_dedups_the_original_stamp(self):
+        # the OTHER half of the invariant: the old edge (live, merely
+        # partitioned away) already holds the original stamped copy —
+        # a straggler duplicate of it must still drop there, so the same
+        # logical update can never count at two edges
+        w = DedupWindow(window=8)
+        assert w.accept(1, 10, 3) == "accept"      # original delivery
+        assert w.accept(1, 10, 3) == "duplicate"   # straggler copy
+        # and the old life's stragglers stay dead even after the client's
+        # re-home epoch reaches this edge too
+        assert w.accept(1, 11, 1) == "accept"
+        assert w.accept(1, 10, 4) == "stale_epoch"
+
+
 class TestPayloadChecksum:
     def test_digest_is_canonical(self):
         a = [np.arange(6, dtype=np.float32).reshape(2, 3)]

@@ -310,8 +310,13 @@ class TestServingStateReconstruction:
                     self._update_item(mgr_a, sender, mgr_a.round_idx))
             assert mgr_a._async_step()
         assert mgr_a.round_idx == 2
-        # one MID-BUFFER (uncommitted, in-flight) fold: stale by 1 version
-        mgr_a._async_fold(self._update_item(mgr_a, 1, 1))
+        # one MID-BUFFER (uncommitted, in-flight) fold: stale by 1 version.
+        # sender 3 has no committed contribution at version 1 — since
+        # ISSUE 19 the root's committed-round guard drops a replayed
+        # (sender, client_version) pair that already entered a committed
+        # aggregation, so the in-flight update must come from a pair the
+        # ledger does NOT cover
+        mgr_a._async_fold(self._update_item(mgr_a, 3, 1))
         pre_entries = list(mgr_a.buffer._entries)
         assert len(pre_entries) == 1 and pre_entries[0].staleness == 1
         pre_weight = pre_entries[0].weight
@@ -333,13 +338,20 @@ class TestServingStateReconstruction:
         assert mgr_b.buffer.occupancy() == 0
         # (b) the re-solicited update (the client replays the same vector
         # against the same version) folds with the same staleness weight
-        mgr_b._async_fold(self._update_item(mgr_b, 1, 1))
+        mgr_b._async_fold(self._update_item(mgr_b, 3, 1))
         post = list(mgr_b.buffer._entries)
         assert len(post) == 1
         assert post[0].staleness == pre_entries[0].staleness
         assert post[0].weight == pre_weight
         # the committed-contribution map came back from the ledger
         assert mgr_b._committed_client_round == {1: 1, 2: 1}
+        # ...and it guards the fold path: a replay of sender 1's COMMITTED
+        # version-1 contribution is dropped, never double-counted
+        drops0 = mgr_b.world.telemetry.counter("traffic.replay_dedup_drops")
+        mgr_b._async_fold(self._update_item(mgr_b, 1, 1))
+        assert len(list(mgr_b.buffer._entries)) == 1
+        assert mgr_b.world.telemetry.counter(
+            "traffic.replay_dedup_drops") == drops0 + 1
         mgr_a._ckpt.close()
         mgr_b._ckpt.close()
 

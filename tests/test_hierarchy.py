@@ -1,0 +1,250 @@
+"""Hierarchical edge-aggregation tier (docs/traffic.md "Hierarchical edge
+tier", docs/robustness.md "Edge tier failure domains").
+
+The load-bearing claim: a 2-tier world is a TRANSPORT optimization, never a
+math change — edges pre-fold only the control plane and ship
+entry-preserving summaries, so the root runs the exact flat decode + fold +
+aggregate code per client entry. That makes "2-tier ≡ flat, bitwise" an
+executable invariant, which these tests pin fault-free and under the tier's
+own failure matrix (edge fail-stop at each protocol phase, root–edge
+partition) with exactly-once contribution accounting throughout.
+"""
+
+import os
+import types
+
+import numpy as np
+import pytest
+
+from fedml_tpu import chaos
+from fedml_tpu.core.mlops import telemetry
+from fedml_tpu.hierarchy import Topology, pack_summary, unpack_summary
+from fedml_tpu.traffic.async_aggregator import (
+    AsyncConfig,
+    AsyncUpdateBuffer,
+    staleness_weight,
+)
+
+
+class TestTopology:
+    def test_flat_rank_space_is_preserved(self):
+        """Clients keep the EXACT ranks (and therefore data shards and
+        sender ids) they have in a flat world — the bitwise-parity
+        precondition."""
+        topo = Topology(clients=10, edges=3)
+        assert [r for r in range(20) if topo.is_client(r)] == list(
+            range(1, 11))
+        assert topo.edge_ranks == [11, 12, 13]
+        assert topo.world_size == 14
+        assert not topo.is_edge(10) and not topo.is_client(11)
+
+    def test_home_edge_partitions_clients_in_contiguous_blocks(self):
+        topo = Topology(clients=10, edges=3)
+        homes = [topo.home_edge(c) for c in range(1, 11)]
+        assert homes == sorted(homes)  # contiguous blocks
+        for e in topo.edge_ranks:
+            assert topo.edge_clients(e) == [
+                c for c in range(1, 11) if topo.home_edge(c) == e]
+        # every client has exactly one home
+        assert sum(len(topo.edge_clients(e)) for e in topo.edge_ranks) == 10
+
+    def test_rehome_ring_ends_at_root_and_skips_home(self):
+        topo = Topology(clients=10, edges=3)
+        for c in range(1, 11):
+            targets = topo.rehome_targets(c)
+            assert targets[-1] == 0
+            assert topo.home_edge(c) not in targets
+            assert sorted(targets[:-1] + [topo.home_edge(c)]) == \
+                topo.edge_ranks
+
+    def test_aligned_rank_base_pads_not_overlaps(self):
+        topo = Topology(clients=10, edges=2, edge_rank_base=17)
+        assert topo.edge_ranks == [17, 18]
+        assert topo.world_size == 19
+        with pytest.raises(ValueError):
+            Topology(clients=10, edges=2, edge_rank_base=5)
+
+    def test_from_args_is_the_single_knob(self):
+        flat = types.SimpleNamespace(client_num_in_total=8)
+        assert Topology.from_args(flat) is None
+        tiered = types.SimpleNamespace(client_num_in_total=8,
+                                       hierarchy_edges=2)
+        topo = Topology.from_args(tiered)
+        assert topo is not None and topo.edge_rank_base == 9
+
+
+class TestSummaryCodec:
+    def test_roundtrip_is_entry_preserving(self):
+        """Frames come back VERBATIM (same objects, no float touched) with
+        the per-client control-plane identity intact — the transport
+        batches, the math never changes."""
+        frames_a = [np.arange(6, dtype=np.float32),
+                    np.ones(3, dtype=np.float32)]
+        frames_b = [np.full(6, 2.5, dtype=np.float32)]
+        meta, arrays = pack_summary([
+            {"sender": 3, "client_version": 7, "num_samples": 11.0,
+             "arrays": frames_a, "staleness": 1},
+            {"sender": 1, "client_version": 8, "num_samples": 4.0,
+             "arrays": frames_b, "dmeta": {"base_version": 7}},
+        ], stats={"folds": 2}, seq=5)
+        assert meta["seq"] == 5 and meta["stats"] == {"folds": 2}
+        entries = unpack_summary(meta, arrays)
+        assert [e["sender"] for e in entries] == [3, 1]
+        assert entries[0]["arrays"][0] is frames_a[0]
+        assert entries[0]["arrays"][1] is frames_a[1]
+        assert entries[1]["arrays"] == frames_b
+        assert entries[1]["dmeta"] == {"base_version": 7}
+        assert entries[0]["num_samples"] == 11.0
+
+    def test_frame_count_mismatch_rejected(self):
+        meta, arrays = pack_summary([
+            {"sender": 1, "client_version": 0, "num_samples": 1.0,
+             "arrays": [np.zeros(2, dtype=np.float32)]}])
+        with pytest.raises(ValueError):
+            unpack_summary(meta, arrays + [np.zeros(1, dtype=np.float32)])
+
+
+class TestStalenessComposition:
+    """Tier composition of the FedBuff staleness math: an entry's weight at
+    the root depends ONLY on (root head − client_version) — both of which a
+    summary entry carries verbatim — so the edge hop cannot perturb it."""
+
+    def test_alpha_zero_weights_are_exactly_one(self):
+        for s in (0, 1, 5, 1000):
+            assert staleness_weight(s, 0.0) == 1.0
+
+    def test_root_weight_identical_through_summary_roundtrip(self):
+        alpha = 0.5
+        cfg = AsyncConfig(buffer_size=3, staleness_alpha=alpha)
+        flat = AsyncUpdateBuffer(cfg)
+        root = AsyncUpdateBuffer(cfg)
+        head = 9
+        updates = [(4, 3.0, 7), (2, 5.0, 9), (6, 1.0, 5)]
+        for sender, n, v in updates:
+            params = {"w": np.full(4, sender, dtype=np.float32)}
+            assert flat.fold(sender, n, params, v, head) == "buffered"
+            # tiered path: the entry rides a summary, then folds at root
+            meta, arrays = pack_summary([
+                {"sender": sender, "client_version": v, "num_samples": n,
+                 "arrays": [params["w"]]}])
+            (e,) = unpack_summary(meta, arrays)
+            assert root.fold(e["sender"], e["num_samples"],
+                             {"w": e["arrays"][0]}, e["client_version"],
+                             head) == "buffered"
+        for f, r in zip(flat.drain(), root.drain()):
+            assert f.sender == r.sender
+            assert f.staleness == r.staleness == max(head - f.client_version,
+                                                     0)
+            # exact float equality, not approx: same inputs, same formula
+            assert f.weight == r.weight == f.num_samples * staleness_weight(
+                f.staleness, alpha)
+            assert np.array_equal(f.params["w"], r.params["w"])
+
+
+def _cfg(tmp_path, **kw):
+    a = types.SimpleNamespace(
+        clients=4, rounds=2, epochs=1, seed=7, loss=0.0, duplicate=0.0,
+        corrupt=0.0, kill_round=-1, checkpoint_rounds=1,
+        workdir=str(tmp_path), timeout=240.0, worker=False, out="",
+        checkpoint_dir="", edges=2,
+    )
+    for k, v in kw.items():
+        setattr(a, k, v)
+    return a
+
+
+def _assert_bitwise(ref, tiered):
+    assert len(ref["params"]) == len(tiered["params"])
+    for i, (x, y) in enumerate(zip(ref["params"], tiered["params"])):
+        assert x.dtype == y.dtype and np.array_equal(x, y), \
+            f"leaf {i} diverged through the edge tier"
+
+
+def _assert_exactly_once(result, clients):
+    for rnd, per in result["server"].contrib_counts.items():
+        assert sorted(per) == list(range(1, clients + 1)), (rnd, per)
+        assert all(v == 1 for v in per.values()), (rnd, per)
+
+
+class TestTieredWorld:
+    def test_fault_free_two_tier_equals_flat_bitwise(self, tmp_path):
+        """The tentpole invariant: same seeds, same shards — a 2-tier world
+        (clients → 2 edges → root) finishes with EXACTLY the flat world's
+        final params, every contribution counted once."""
+        a = _cfg(tmp_path)
+        ref = chaos.run_world(
+            a, run_id=f"hier-ref-{os.getpid()}",
+            checkpoint_dir=str(tmp_path / "ref"), faulty=False)
+        tiered = chaos.run_world(
+            a, run_id=f"hier-2t-{os.getpid()}",
+            checkpoint_dir=str(tmp_path / "tier"), faulty=True)
+        assert len(tiered["edges"]) == 2
+        assert not any(e.killed for e in tiered["edges"])
+        _assert_bitwise(ref, tiered)
+        _assert_exactly_once(tiered, 4)
+
+    def test_edge_kill_pre_fold_rehomes_and_matches_flat(self, tmp_path):
+        """Kill the first edge the moment a client update reaches it: its
+        orphans must detect the corpse, re-home (sibling edge or root
+        degraded mode), replay their cached still-stamped updates, and the
+        run must STILL land bitwise on the flat params — with the dedup
+        window + committed-round guard keeping every (client, round)
+        contribution exactly-once."""
+        telemetry.registry().reset()
+        a = _cfg(tmp_path, kill_edge="pre_fold",
+                 loss=0.05, duplicate=0.1, corrupt=0.1)
+        ref = chaos.run_world(
+            a, run_id=f"hier-kref-{os.getpid()}",
+            checkpoint_dir=str(tmp_path / "ref"), faulty=False)
+        tiered = chaos.run_world(
+            a, run_id=f"hier-kill-{os.getpid()}",
+            checkpoint_dir=str(tmp_path / "kill"), faulty=True)
+        assert any(e.killed for e in tiered["edges"]), \
+            "armed pre_fold kill never fired"
+        counters = telemetry.registry().snapshot()["counters"]
+        assert counters.get("comm.rehomes", 0) > 0, \
+            "no orphan ever re-homed"
+        _assert_bitwise(ref, tiered)
+        _assert_exactly_once(tiered, 4)
+
+
+@pytest.mark.slow
+class TestTieredChaosMatrixSlow:
+    @pytest.mark.parametrize("phase", ["mid_fold", "post_commit"])
+    def test_edge_kill_phase_matches_flat(self, tmp_path, phase):
+        """The remaining kill phases: summary built-but-unsent (mid_fold —
+        the buffer dies with the edge, clients re-offer) and already-sent
+        (post_commit — the replay must dedup, not double-count)."""
+        telemetry.registry().reset()
+        a = _cfg(tmp_path, kill_edge=phase,
+                 loss=0.05, duplicate=0.1, corrupt=0.1)
+        ref = chaos.run_world(
+            a, run_id=f"hier-{phase}-ref-{os.getpid()}",
+            checkpoint_dir=str(tmp_path / "ref"), faulty=False)
+        tiered = chaos.run_world(
+            a, run_id=f"hier-{phase}-{os.getpid()}",
+            checkpoint_dir=str(tmp_path / "kill"), faulty=True)
+        assert any(e.killed for e in tiered["edges"])
+        _assert_bitwise(ref, tiered)
+        _assert_exactly_once(tiered, 4)
+
+    def test_root_edge_partition_heals_bitwise(self, tmp_path):
+        """Cut the first edge off from the root mid-run: the edge rides the
+        cut on its resync FSM and re-ships its cached summary on heal; the
+        committed-round guard absorbs whatever had already arrived."""
+        telemetry.registry().reset()
+        a = _cfg(tmp_path, edge_partition="1.0:2.0",
+                 loss=0.05, duplicate=0.1, corrupt=0.1, rounds=3)
+        ref = chaos.run_world(
+            a, run_id=f"hier-part-ref-{os.getpid()}",
+            checkpoint_dir=str(tmp_path / "ref"), faulty=False)
+        tiered = chaos.run_world(
+            a, run_id=f"hier-part-{os.getpid()}",
+            checkpoint_dir=str(tmp_path / "part"), faulty=True)
+        assert not any(e.killed for e in tiered["edges"])
+        counters = telemetry.registry().snapshot()["counters"]
+        assert (counters.get("comm.heartbeat_misses", 0)
+                + counters.get("comm.resync_replays", 0)) > 0, \
+            "partition window never bit"
+        _assert_bitwise(ref, tiered)
+        _assert_exactly_once(tiered, 4)

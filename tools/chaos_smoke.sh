@@ -252,4 +252,96 @@ if [ "$rc" -ne 0 ]; then
     echo "chaos_smoke: FAIL — partition verdict did not validate" >&2
     exit 1
 fi
+
+# ---- edge-kill leg (ISSUE 19 tentpole) -------------------------------------
+# the faulty world runs 2-TIER (clients → 2 edge aggregators → root) while
+# the reference stays FLAT and fault-free; the first edge is fail-stopped
+# the moment a client update reaches it (pre_fold). Its orphaned clients
+# must re-home to the sibling edge (or root degraded mode) and replay their
+# cached still-stamped updates — and the run must STILL land bitwise on the
+# flat reference params with exactly one ledger contribution per
+# (client, round). Parity here proves the tier is a transport, not a math
+# change, even while a whole failure domain dies.
+workdir_e=$(mktemp -d /tmp/fedml_chaos_smoke_edge.XXXXXX)
+trap 'rm -rf "$workdir" "$workdir_c" "$workdir2" "$workdir_k" "$workdir_kg" "$workdir_p" "$workdir_e"' EXIT
+out=$(timeout -k 10 300 env JAX_PLATFORMS=cpu python -m fedml_tpu.cli chaos \
+    --clients 4 --rounds 2 --seed 7 \
+    --loss 0.05 --duplicate 0.1 --corrupt 0.1 \
+    --kill-round -1 --edges 2 --kill-edge pre_fold \
+    --workdir "$workdir_e" 2>/dev/null)
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL — edge-kill leg exited rc=$rc" >&2
+    printf '%s\n' "$out" >&2
+    exit 1
+fi
+python - "$out" <<'EOF'
+import json
+import sys
+
+verdict = json.loads(sys.argv[1])
+assert verdict["ok"], verdict["problems"]
+assert verdict["parity"], verdict["problems"]
+et = verdict["edge_tier"]
+assert et, verdict
+assert et["edge_kill_exercised"], "armed pre_fold edge kill never fired"
+assert et["killed_edges"], et
+# the corpse's clients found a new home (sibling edge and/or root)
+assert et["rehomed_clients"] + et["root_adoptions"] > 0, et
+# cached-replay dedup accounting is visible, not silent
+assert et["direct_client_updates"] == 0 or et["root_adoptions"] > 0, et
+print("chaos_smoke: edge-kill (pre_fold) OK —",
+      f"killed edge(s) {et['killed_edges']},",
+      f"{et['rehomed_clients']:.0f} re-homed /",
+      f"{et['root_adoptions']:.0f} root-adopted, bitwise parity holds")
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL — edge-kill verdict did not validate" >&2
+    exit 1
+fi
+
+# ---- root–edge partition leg (ISSUE 19 tentpole) ---------------------------
+# cut the first edge off from the root for 2 s starting 1 s in: the edge
+# rides the cut on its resync FSM (heartbeat misses → suspect → resync →
+# replay its cached summary) and the root's committed-round guard + dedup
+# window absorb whatever had already crossed before the cut — bitwise
+# parity with the flat fault-free reference under at-least-once delivery
+workdir_ep=$(mktemp -d /tmp/fedml_chaos_smoke_epart.XXXXXX)
+trap 'rm -rf "$workdir" "$workdir_c" "$workdir2" "$workdir_k" "$workdir_kg" "$workdir_p" "$workdir_e" "$workdir_ep"' EXIT
+out=$(timeout -k 10 300 env JAX_PLATFORMS=cpu python -m fedml_tpu.cli chaos \
+    --clients 4 --rounds 3 --seed 7 \
+    --loss 0.05 --duplicate 0.1 --corrupt 0.1 \
+    --kill-round -1 --edges 2 --edge-partition 1.0:2.0 \
+    --workdir "$workdir_ep" 2>/dev/null)
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL — root-edge partition leg exited rc=$rc" >&2
+    printf '%s\n' "$out" >&2
+    exit 1
+fi
+python - "$out" <<'EOF'
+import json
+import sys
+
+verdict = json.loads(sys.argv[1])
+assert verdict["ok"], verdict["problems"]
+assert verdict["parity"], verdict["problems"]
+et = verdict["edge_tier"]
+assert et, verdict
+# no edge died — this leg is pure partition
+assert not et["killed_edges"], et
+# the cut actually bit: the edge missed heartbeats and/or replayed its
+# cached summary through the resync FSM
+assert et["heartbeat_misses"] + et["resync_replays"] > 0, et
+print("chaos_smoke: root-edge partition OK —",
+      f"window {verdict['fault_matrix']['edge_partition']} absorbed,",
+      f"{et['heartbeat_misses']:.0f} heartbeat misses /",
+      f"{et['resync_replays']:.0f} summary replays, bitwise parity holds")
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL — root-edge partition verdict did not validate" >&2
+    exit 1
+fi
 echo "chaos_smoke: PASS"

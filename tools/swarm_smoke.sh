@@ -25,6 +25,13 @@
 #     the trace's Σ(fold + queue_wait) must reconcile with the
 #     traffic.dispatch_ready_s histogram sum within 5% — two instruments,
 #     one truth.
+#  leg 6 (edge tier):  --tiers 2 at swarm scale (docs/traffic.md
+#     "Hierarchical edge tier"): ~200 devices homed onto 2 edge
+#     aggregators over REAL multiprocess gRPC. The root must fold ONLY
+#     edge summaries (edge_tier.direct_client_updates == 0 — a nonzero
+#     count means a device bypassed its home edge), summaries must
+#     actually flow, every device-host process must exit 0, and world
+#     shutdown must leak ZERO threads across the extra tier.
 #
 # This is the executable form of the traffic-plane contract;
 # tests/test_traffic.py is the fine-grained half.
@@ -201,5 +208,48 @@ print("swarm_smoke: traced-grpc OK —",
 EOF
 [ $? -ne 0 ] && { echo "swarm_smoke: FAIL — traced-grpc verdict" >&2; rm -rf "$trace_dir"; exit 1; }
 rm -rf "$trace_dir"
+
+tiered=$(run_leg --clients 200 --steps 4 --buffer 32 --think_s 0.01 \
+    --backend grpc --procs 4 --ranks_per_port 50 --port 18974 \
+    --tiers 2 --edges 2 --seed 7 --timeout 220 \
+    --run_id swarm-smoke-tiered)
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "swarm_smoke: FAIL — edge-tier leg exited rc=$rc" >&2
+    printf '%s\n' "$tiered" >&2
+    exit 1
+fi
+
+python - "$tiered" <<'EOF'
+import json
+import sys
+
+r = json.loads(sys.argv[1])
+assert r["ok"], r
+assert r["backend"] == "GRPC", r
+assert r["steps_completed"] == r["steps_requested"], r
+assert all(rc == 0 for rc in r["worker_exit_codes"]), r["worker_exit_codes"]
+et = r["edge_tier"]
+assert et and et["edges"] == 2, et
+assert et["edges_finished"] == et["edges"], et
+# the root folded ONLY edge summaries: summaries flowed, and not one
+# device update reached the root directly
+assert et["summaries_folded"] > 0, et
+assert et["summary_entries"] > 0, et
+assert et["direct_client_updates"] == 0, et
+assert et["summary_decode_errors"] == 0, et
+# every edge actually carried load (home assignment is contiguous blocks,
+# so an idle edge means homing broke)
+assert all(pe["folds"] > 0 for pe in et["per_edge"].values()), et["per_edge"]
+# the extra tier leaks nothing: edge manager threads must be gone
+assert not r["leaked_threads"], r["leaked_threads"]
+print("swarm_smoke: edge-tier OK —",
+      f"{r['clients']} devices / {et['edges']} edges /",
+      f"{len(r['worker_exit_codes'])} procs,",
+      f"{et['summaries_folded']:.0f} summaries",
+      f"({et['summary_entries']:.0f} entries) folded at root,",
+      "0 direct updates, 0 leaked threads")
+EOF
+[ $? -ne 0 ] && { echo "swarm_smoke: FAIL — edge-tier verdict" >&2; exit 1; }
 
 echo "swarm_smoke: PASS"
