@@ -188,6 +188,7 @@ def cmd_top(args) -> int:
         _print_wire_summary(metrics)
         _print_recovery_summary(metrics)
         _print_edge_summary(metrics)
+        _print_mem_summary(metrics)
     _print_trace_summary(events)
     return 0
 
@@ -306,6 +307,27 @@ def _print_edge_summary(metrics: dict) -> None:
               f"{resolicited:.0f}")
         print(f"  dedup drops: {dedup:.0f} edge buffer / "
               f"{replay_drops:.0f} root replay")
+
+
+def _print_mem_summary(metrics: dict) -> None:
+    """The retention story (mem.* family, docs/graftmem.md): per-container
+    occupancy and eviction counts from the serving plane's BoundedDicts —
+    the runtime face of the graftmem static gate. Silent when no bounded
+    container published (a run predating the mem.* family)."""
+    gauges = metrics.get("gauges", {})
+    counters = metrics.get("counters", {})
+    rows = []
+    for name in sorted(gauges):
+        if name.startswith("mem.") and name.endswith(".occupancy"):
+            container = name[len("mem."):-len(".occupancy")]
+            rows.append((container, gauges[name],
+                         counters.get(f"mem.{container}.evictions", 0.0)))
+    if not rows:
+        return
+    print("\nmemory (bounded serving-plane containers):")
+    for container, occ, ev in rows:
+        print(f"  {container:<28} occupancy {occ:>8.0f}   "
+              f"evictions {ev:>6.0f}")
 
 
 def _print_delta_summary(metrics: dict) -> None:
@@ -602,7 +624,10 @@ def cmd_lint(args) -> int:
     graftiso (tools/graftiso) — serving-plane state ownership (I001
     module-global state in handlers, I002 unscoped singleton access, I003
     class-level defaults & cross-instance aliasing, I004 ambient config,
-    I005 untethered thread lifecycle). Shells into
+    I005 untethered thread lifecycle). ``--mem``: graftmem (tools/graftmem)
+    — serving-plane retention (M001 unbounded keyed growth, M002
+    capacity-less caches, M003 telemetry cardinality explosion, M004
+    undrained parking, M005 payload retention past commit). Shells into
     the same entry points CI uses, anchored at the repo root so results
     are identical from any cwd.
 
@@ -610,17 +635,18 @@ def cmd_lint(args) -> int:
     crashed (or usage error) — CI failures are diagnosable at a glance."""
     import subprocess
 
-    picked = [flag for flag in ("proto", "shard", "rep", "iso")
+    picked = [flag for flag in ("proto", "shard", "rep", "iso", "mem")
               if getattr(args, flag, False)]
     if len(picked) > 1:
         print(f"fedml_tpu lint: --{picked[0]} and --{picked[1]} are "
-              "different suites — pick one (or run all five like "
+              "different suites — pick one (or run all six like "
               "tools/lint_smoke.sh does)")
         return 2
     suite = ("graftproto" if getattr(args, "proto", False)
              else "graftshard" if getattr(args, "shard", False)
              else "graftrep" if getattr(args, "rep", False)
              else "graftiso" if getattr(args, "iso", False)
+             else "graftmem" if getattr(args, "mem", False)
              else "graftlint")
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if not os.path.isdir(os.path.join(repo_root, "tools", suite)):
@@ -646,6 +672,11 @@ def cmd_lint(args) -> int:
             print("fedml_tpu lint: --runtime is a graftlint/graftshard "
                   "pass; graftiso's runtime witness is the swarm/chaos "
                   "thread-leak assertion (fedml_tpu swarm / chaos)")
+            return 2
+        if suite == "graftmem":
+            print("fedml_tpu lint: --runtime is a graftlint/graftshard "
+                  "pass; graftmem's runtime witness is the RSS-slope soak "
+                  "(fedml_tpu swarm --leak_check)")
             return 2
         cmd.append("--runtime")
     if getattr(args, "equiv", False):
@@ -849,6 +880,11 @@ def main(argv=None) -> int:
                         "ownership, tenant-isolation & thread-lifecycle "
                         "verification of the serving plane) instead of "
                         "graftlint")
+    p_lint.add_argument("--mem", action="store_true",
+                        help="run graftmem (tools/graftmem: unbounded-"
+                        "state & retention verification of the serving "
+                        "plane — bounded containers, drained parking, "
+                        "released payloads) instead of graftlint")
     p_lint.add_argument("--rep", action="store_true",
                         help="run graftrep (PRNG-key discipline, seed "
                         "provenance, unordered accumulation, dtype drift, "
@@ -1056,6 +1092,18 @@ def main(argv=None) -> int:
     p_swarm.add_argument("--trace_dir", default="",
                          help="span/flight dir (default: "
                          ".fedml_tpu_runs/trace_RUN_ID)")
+    p_swarm.add_argument("--leak_check", action="store_true",
+                         help="memory-leak witness (graftmem's runtime "
+                         "half): sample VmRSS across the soak, fail on a "
+                         "positive steady-state slope, and report the "
+                         "mem.* per-container occupancy gauges")
+    p_swarm.add_argument("--leak_interval", type=float, default=0.2,
+                         metavar="S",
+                         help="RSS sampling period in seconds")
+    p_swarm.add_argument("--leak_slope_mb_s", type=float, default=1.0,
+                         metavar="MB",
+                         help="max tolerated steady-state RSS slope "
+                         "(MB/s over the soak's second half)")
     # internal: one gRPC device-host process (the orchestrator's child)
     p_swarm.add_argument("--worker", action="store_true",
                          help=argparse.SUPPRESS)

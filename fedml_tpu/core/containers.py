@@ -1,0 +1,128 @@
+"""Bounded containers for serving-plane state (graftmem M001/M002).
+
+Every dict a handler can grow by a sender/round-derived key must be
+bounded (docs/graftmem.md): :class:`BoundedDict` is the substrate — a
+``dict`` subclass (JSON-serializable, ``isinstance(dict)``-true, so read
+sites and reports never change) with a hard capacity, oldest-first
+eviction (optionally LRU — reads refresh recency), and per-container
+occupancy accounting published to the ``mem.*`` telemetry family the
+swarm leak witness (``fedml_tpu swarm --leak_check``) gates on:
+
+- ``mem.<name>.occupancy`` (gauge): live entry count after each write;
+- ``mem.<name>.evictions`` (counter): entries dropped by the bound.
+
+Capacities are deliberately generous — orders of magnitude above any
+live working set, so eviction only ever removes state that a retry path
+can rebuild (an evicted dedup sender re-enters as "accept"; an evicted
+committed-round entry re-folds at worst one stale replay, which the
+round-index guard then drops). The bound converts "slow OOM at a million
+clients" into "bounded memory with a documented, recoverable worst case".
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+_TELEMETRY = None
+_TELEMETRY_LOCK = threading.Lock()
+
+
+def _telemetry():
+    """Lazy telemetry import: containers must be importable from anywhere
+    (including mlops itself) without an import cycle."""
+    global _TELEMETRY
+    if _TELEMETRY is None:
+        with _TELEMETRY_LOCK:
+            if _TELEMETRY is None:
+                from .mlops import telemetry as _t
+
+                _TELEMETRY = _t
+    return _TELEMETRY
+
+
+class BoundedDict(dict):
+    """A dict with a hard capacity and oldest-first (insertion-order or
+    LRU) eviction.
+
+    ``name`` (optional) publishes ``mem.<name>.occupancy`` /
+    ``mem.<name>.evictions`` after every mutating write. Not internally
+    locked — callers guard it with the same lock that guarded the plain
+    dict it replaces, exactly like ``dict``.
+    """
+
+    def __init__(self, capacity: int, *, lru: bool = False, name: str = "",
+                 seed: Optional[Dict] = None):
+        super().__init__()
+        if int(capacity) < 1:
+            raise ValueError(f"BoundedDict capacity must be >= 1, "
+                             f"got {capacity}")
+        self.capacity = int(capacity)
+        self.lru = bool(lru)
+        self.name = str(name)
+        self.evictions = 0
+        if seed:
+            self.update(seed)
+
+    # -- mutation (every write funnels through __setitem__) ------------------
+
+    def __setitem__(self, key, value) -> None:
+        if self.lru and super().__contains__(key):
+            super().__delitem__(key)  # reinsert at the recent end
+        super().__setitem__(key, value)
+        self._trim()
+
+    def setdefault(self, key, default=None):
+        if super().__contains__(key):
+            self._touch(key)
+            return super().__getitem__(key)
+        self[key] = default
+        return default
+
+    def update(self, other=(), **kw) -> None:  # type: ignore[override]
+        items: Iterable[Tuple[Any, Any]]
+        if hasattr(other, "items"):
+            items = other.items()
+        else:
+            items = other
+        for k, v in items:
+            self[k] = v
+        for k, v in kw.items():
+            self[k] = v
+
+    # -- reads (LRU refreshes recency) ---------------------------------------
+
+    def __getitem__(self, key):
+        self._touch(key)
+        return super().__getitem__(key)
+
+    def get(self, key, default=None):
+        if super().__contains__(key):
+            self._touch(key)
+            return super().__getitem__(key)
+        return default
+
+    # -- internals -----------------------------------------------------------
+
+    def _touch(self, key) -> None:
+        if self.lru and super().__contains__(key):
+            value = super().pop(key)
+            super().__setitem__(key, value)
+
+    def _trim(self) -> None:
+        evicted = 0
+        while len(self) > self.capacity:
+            oldest = next(iter(self))
+            super().__delitem__(oldest)
+            evicted += 1
+        if evicted:
+            self.evictions += evicted
+        self._account(evicted)
+
+    def _account(self, evicted: int) -> None:
+        if not self.name:
+            return
+        tel = _telemetry()
+        tel.gauge_set(f"mem.{self.name}.occupancy", float(len(self)))
+        if evicted:
+            tel.counter_inc(f"mem.{self.name}.evictions", float(evicted))

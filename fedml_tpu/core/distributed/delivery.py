@@ -36,6 +36,8 @@ from typing import Deque, Dict, Optional, Set, Tuple
 
 import numpy as np
 
+from ..containers import BoundedDict
+
 
 def safe_deserialize(data: bytes, transport: str = "comm"):
     """Decode wire bytes defensively: a frame that fails to parse or whose
@@ -158,13 +160,22 @@ class DedupWindow:
     with monotonic senders a seq that far behind can only be a replay.
     Thread-safe: delayed-delivery timers and multi-threaded transports may
     deliver concurrently with the receive loop.
+
+    The sender map itself is LRU-bounded (graftmem M001): at a million
+    clients an unbounded per-sender map is a slow OOM. Evicting the
+    coldest sender only weakens dedup for a sender silent past
+    ``max_senders`` other senders' traffic — its next message re-enters
+    as ``"accept"``, which the round-index guards upstream already
+    tolerate (the same rebuild path a server restart takes).
     """
 
-    def __init__(self, window: int = 4096):
+    def __init__(self, window: int = 4096, max_senders: int = 65536):
         self.window = max(int(window), 1)
         self._lock = threading.Lock()
-        # sender -> (epoch, seen-set, fifo of seqs, floor)
-        self._senders: Dict[int, Tuple[int, Set[int], Deque[int]]] = {}
+        # sender -> (epoch, seen-set, fifo of seqs); LRU over senders
+        self._senders: Dict[int, Tuple[int, Set[int], Deque[int]]] = \
+            BoundedDict(max(int(max_senders), 1), lru=True,
+                        name="delivery.dedup_senders")
 
     def accept(self, sender: int, epoch: int, seq: int) -> str:
         sender, epoch, seq = int(sender), int(epoch), int(seq)

@@ -140,6 +140,10 @@ class FedMLAlgorithmFlow(FedMLCommManager):
             self.executor.set_params(
                 _params_from_message_fields(msg.get("header"), msg.get_arrays())
             )
+            with self._lock:
+                # drop the readiness roster (graftmem M001): one entry per
+                # sender, and a finished flow never consults it again
+                self._ready.clear()
             self.done.set()
             self.finish()
             return
@@ -164,6 +168,8 @@ class FedMLAlgorithmFlow(FedMLCommManager):
                     m.add("header", header)
                     m.set_arrays(arrays)
                     self.send_message(m)
+            with self._lock:
+                self._ready.clear()
             self.done.set()
             self.finish()
             return

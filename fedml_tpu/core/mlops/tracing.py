@@ -50,6 +50,8 @@ import zlib
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ..containers import BoundedDict
+
 TRACE_VERSION = 1
 
 # span-record JSONL kind (rides the PR 2 sink next to round_record et al.)
@@ -273,7 +275,10 @@ class Tracer:
         self._tls = threading.local()
         self._ring: deque = deque(maxlen=FLIGHT_RING_CAPACITY)
         self._last_phase: Optional[Dict[str, Any]] = None
-        self._estimators: Dict[int, ClockOffsetEstimator] = {}
+        # per-peer clock filters, LRU-bounded (graftmem M001): a root
+        # probing 100k clients would otherwise pin one estimator each
+        self._estimators: Dict[int, ClockOffsetEstimator] = BoundedDict(
+            1024, lru=True, name="trace.clock_estimators")
         self._atexit_armed = False
 
     # -- configuration -------------------------------------------------------

@@ -637,6 +637,11 @@ class ClientMasterManager(FedMLCommManager):
         logger.info("client %d: finished", self.rank)
         if self.silo_plane is not None:
             self.silo_plane.broadcast_finish()
+        # release retained payloads (graftmem M005): the resync-replay copy
+        # of the last upload and the codec's broadcast reference are dead
+        # once the federation finishes — both pin full model arrays
+        self._last_model_msg = None
+        self._round_global_vec = None
         self.done.set()
         self.finish()
 

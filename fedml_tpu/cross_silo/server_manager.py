@@ -49,6 +49,7 @@ import numpy as np
 
 from .. import constants
 from ..core.aggregate import stack_trees, weighted_average
+from ..core.containers import BoundedDict
 from ..core.distributed import FedMLCommManager, Message
 from ..core.mlops.tracing import NULL_SPAN
 from ..core.dp import FedPrivacyMechanism
@@ -90,7 +91,10 @@ class FedMLServerManager(FedMLCommManager):
         # in-flight (sender, client_version) set — with _committed_client_
         # round it makes at-least-once summary delivery exactly-once
         self._edge_online: set = set()
-        self._edge_stats: Dict[int, dict] = {}
+        # bounded (graftmem M001): keyed by edge rank, evicted
+        # oldest-first well above any deployable edge-tier width
+        self._edge_stats: Dict[int, dict] = BoundedDict(
+            512, name="server.edge_stats")
         self._direct_clients: set = set()
         self._pending_folds: set = set()
         self._online = set()
@@ -128,8 +132,11 @@ class FedMLServerManager(FedMLCommManager):
         # aggregated (sync) or folded into a committed step (async) —
         # what the resync ack reports so a reconnecting client knows
         # whether to replay its last unACKed update. Rebuilt from the
-        # ledger on restart; guarded by self._lock.
-        self._committed_client_round: Dict[int, int] = {}
+        # ledger on restart; guarded by self._lock. LRU-bounded (graftmem
+        # M001): an evicted client's replay re-folds at most once and the
+        # round-index guard drops anything older than the current round.
+        self._committed_client_round: Dict[int, int] = BoundedDict(
+            65536, lru=True, name="server.committed_clients")
         # chaos kill switch (core/distributed/faults.py kill_server):
         # SIGKILL at a protocol phase — consulted via _maybe_kill
         self._fault_plan = getattr(args, "fault_plan", None)
@@ -223,8 +230,11 @@ class FedMLServerManager(FedMLCommManager):
         # per-round contribution counters: how many times each client's
         # model was ACCEPTED into a round's aggregation. The delivery-layer
         # dedup keeps every count at 1 even under retries/duplication —
-        # the chaos harness and the deadline-race tests assert exactly that
-        self.contrib_counts: Dict[int, Dict[int, int]] = {}
+        # the chaos harness and the deadline-race tests assert exactly that.
+        # Bounded (graftmem M001) by round: only the trailing rounds matter
+        # for dedup assertions; ancient rounds are evicted oldest-first.
+        self.contrib_counts: Dict[int, Dict[int, int]] = BoundedDict(
+            1024, name="server.contrib_rounds")
         # round checkpoint/resume (the reference restarts every killed run
         # from round 0 — SURVEY §5): with args.checkpoint_dir the aggregated
         # global + round index persist via Orbax after every round round
@@ -1355,6 +1365,12 @@ class FedMLServerManager(FedMLCommManager):
             if self._round_timer is not None:
                 self._round_timer.cancel()
                 self._round_timer = None
+            # drain membership/parking state (graftmem M001/M004): a
+            # finished federation holds no per-peer rosters or parked work
+            self._edge_online.clear()
+            self._direct_clients.clear()
+            self._pending_pulls.clear()
+            self._pending_folds.clear()
         self.done.set()
         self.finish()
 

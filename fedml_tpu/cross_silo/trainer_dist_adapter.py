@@ -37,6 +37,7 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..core.containers import BoundedDict
 from ..ml.losses import get_loss_fn
 from ..ml.optimizer import create_client_optimizer
 from .process_group import SILO_AXIS, SiloProcessGroup
@@ -156,7 +157,12 @@ class TrainerDistAdapter:
         self.trainer = trainer
         self.model = trainer.model  # bundle passthrough for manager FSMs
         self.group = process_group or SiloProcessGroup()
-        self._jitted: Dict[int, Any] = {}
+        # jit cache keyed by padded per-device capacity (graftmem M002):
+        # capacities are batch-multiples of a fixed geometry, so a handful
+        # of entries is steady state — the bound is a backstop against a
+        # pathological shard-size walk recompiling (and retaining) forever
+        self._jitted: Dict[int, Any] = BoundedDict(8, lru=True,
+                                                   name="trainer.jit_cache")
 
     # trainer facade ---------------------------------------------------------
     def get_model_params(self) -> PyTree:

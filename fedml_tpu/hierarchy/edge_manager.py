@@ -46,6 +46,7 @@ import numpy as np
 
 from .. import constants
 from ..core.distributed import FedMLCommManager, Message
+from ..core.containers import BoundedDict
 from ..delivery import VersionedModelStore, WireCodec, flatten_leaves
 from ..delivery.delta_codec import DELTA_KEY, payload_nbytes
 from ..cross_silo.message_define import MyMessage
@@ -76,8 +77,12 @@ class EdgeAggregatorManager(FedMLCommManager):
         self._dispatched: set = set()   # clients that got their first model
         # highest client_version this edge already SHIPPED per client — the
         # committed record its resync acks answer with (a contribution in a
-        # shipped summary is the edge's to re-deliver, not the client's)
-        self._forwarded: Dict[int, int] = {}
+        # shipped summary is the edge's to re-deliver, not the client's).
+        # LRU-bounded (graftmem M001): an evicted client's resync replays
+        # at most one already-shipped update, which the root's dedup and
+        # round-index guards drop.
+        self._forwarded: Dict[int, int] = BoundedDict(
+            65536, lru=True, name="edge.forwarded")
         self._acked: Dict[int, int] = {}  # client -> last ACKed version
         # -- model replica ----------------------------------------------------
         self.version = -1
@@ -502,6 +507,11 @@ class EdgeAggregatorManager(FedMLCommManager):
                 continue
         logger.info("edge %d: finished (relayed FINISH to %d clients)",
                     self.rank, len(targets))
+        with self._lock:
+            # release terminal state (graftmem M001/M005): the lease roster
+            # and the retained last-summary payload die with the federation
+            self._leased.clear()
+            self._last_summary_msg = None
         self.done.set()
         self.finish()
 
@@ -673,7 +683,9 @@ class EdgeAggregatorManager(FedMLCommManager):
             if not dup:
                 self._entries.append(entry)
                 self._stats["folds"] += 1
-                s = str(entry["staleness"])
+                # clamped histogram key (graftmem M001): staleness is
+                # unbounded under long partitions; 64+ is one bucket
+                s = str(min(int(entry["staleness"]), 64))
                 self._stats["staleness"][s] = \
                     self._stats["staleness"].get(s, 0) + 1
         if dup:

@@ -34,7 +34,7 @@ import subprocess
 import sys
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -56,6 +56,79 @@ def rss_peak_mb() -> float:
         return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
     except Exception:  # pragma: no cover - non-posix
         return 0.0
+
+
+def rss_now_mb() -> float:
+    """CURRENT resident set from /proc/self/status VmRSS (kB). ru_maxrss
+    is a high-water mark — useless for a leak slope, which needs the live
+    value falling as well as rising."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) / 1024.0
+    except Exception:  # pragma: no cover - non-linux
+        pass
+    return 0.0
+
+
+class RssSampler:
+    """The graftmem runtime witness's sampler: VmRSS on a fixed cadence
+    from a daemon thread, joined by :meth:`stop`.
+
+    :meth:`slope_mb_per_s` fits a least-squares line over the STEADY-STATE
+    half of the samples (the second half by time) — the first half is
+    warmup (imports, first compiles, buffer fills) and would make every
+    healthy soak look like a leak. A retention bug shows as a positive
+    slope that persists after warmup: one entry per message/sender/round
+    never released is linear growth under constant load by definition.
+    """
+
+    def __init__(self, interval_s: float = 0.2):
+        self.interval_s = max(float(interval_s), 0.01)
+        self._lock = threading.Lock()
+        self._samples: List[Tuple[float, float]] = []  # (t_monotonic, MB)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="rss-sampler")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def samples(self) -> List[Tuple[float, float]]:
+        with self._lock:
+            return list(self._samples)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                self._samples.append((time.monotonic(), rss_now_mb()))
+            self._stop.wait(self.interval_s)
+        with self._lock:
+            self._samples.append((time.monotonic(), rss_now_mb()))
+
+    def slope_mb_per_s(self) -> Optional[float]:
+        """Least-squares dRSS/dt over the steady-state (second) half; None
+        with fewer than 4 steady-state samples (no signal, not a pass)."""
+        samples = self.samples()
+        if not samples:
+            return None
+        t_mid = (samples[0][0] + samples[-1][0]) / 2.0
+        steady = [(t, m) for (t, m) in samples if t >= t_mid]
+        if len(steady) < 4:
+            return None
+        n = float(len(steady))
+        mean_t = sum(t for t, _ in steady) / n
+        mean_m = sum(m for _, m in steady) / n
+        var_t = sum((t - mean_t) ** 2 for t, _ in steady)
+        if var_t <= 0.0:
+            return None
+        cov = sum((t - mean_t) * (m - mean_m) for t, m in steady)
+        return cov / var_t
 
 
 # ---------------------------------------------------------------------------
@@ -671,6 +744,12 @@ def swarm_soak(a) -> Dict:
     # soak starts must be gone — or at least daemonic and world-joined —
     # after world shutdown; a leaked non-daemon thread fails the soak
     threads_before = world_mod.thread_snapshot()
+    # memory-leak witness (graftmem's runtime half): VmRSS sampled across
+    # the soak; a positive steady-state slope fails it
+    sampler: Optional[RssSampler] = None
+    if getattr(a, "leak_check", False):
+        sampler = RssSampler(float(getattr(a, "leak_interval", 0.2)))
+        sampler.start()
     t0 = time.monotonic()
 
     edges_n = _edge_count(a)
@@ -803,6 +882,8 @@ def swarm_soak(a) -> Dict:
         server.manager.finish()
         if server_thread is not None:
             server_thread.join(timeout=10.0)
+        if sampler is not None:
+            sampler.stop()
 
     leaked = world_mod.leaked_threads(threads_before)
 
@@ -872,6 +953,37 @@ def swarm_soak(a) -> Dict:
         "step_s": _percentiles(hists.get("traffic.step_s")),
         "rss_peak_mb": round(rss_peak_mb(), 1),
     }
+    if sampler is not None:
+        slope = sampler.slope_mb_per_s()
+        rss_samples = sampler.samples()
+        limit = float(getattr(a, "leak_slope_mb_s", 1.0))
+        # no-signal (too-short soak) fails: a leak gate that silently
+        # passes when it measured nothing is not a gate
+        mem_ok = slope is not None and slope <= limit
+        report["mem"] = {
+            "ok": mem_ok,
+            "rss_slope_mb_per_s": (None if slope is None
+                                   else round(slope, 4)),
+            "rss_slope_limit_mb_per_s": limit,
+            "rss_start_mb": round(rss_samples[0][1], 1),
+            "rss_end_mb": round(rss_samples[-1][1], 1),
+            "rss_samples": len(rss_samples),
+            # per-container occupancy: every BoundedDict in the serving
+            # plane publishes mem.<name>.occupancy/.evictions
+            "containers": {
+                name[len("mem."):-len(".occupancy")]: {
+                    "occupancy": value,
+                    "evictions": counters.get(
+                        name[:-len(".occupancy")] + ".evictions", 0.0),
+                }
+                for name, value in sorted(snap["gauges"].items())
+                if name.startswith("mem.")
+                and name.endswith(".occupancy")
+            },
+        }
+        report["ok"] = bool(report["ok"] and mem_ok)
+    else:
+        report["mem"] = None
     if edges_n:
         # edge tier block (docs/traffic.md): the root must fold ONLY edge
         # summaries — direct_client_updates > 0 means a device bypassed

@@ -1,0 +1,15 @@
+"""M001 bad: handler grows a sender-keyed dict with no eviction."""
+
+
+class BadGrowthManager:
+    def __init__(self):
+        self._seen_updates = {}
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler("sync", self._on_sync)
+
+    def register_message_receive_handler(self, msg_type, handler):
+        pass
+
+    def _on_sync(self, msg):
+        self._seen_updates[msg.sender] = msg.params

@@ -1,0 +1,19 @@
+"""M001 good: the sender-keyed dict is cleared on the finish path."""
+
+
+class GoodGrowthManager:
+    def __init__(self):
+        self._seen_updates = {}
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler("sync", self._on_sync)
+        self.register_message_receive_handler("finish", self._on_finish)
+
+    def register_message_receive_handler(self, msg_type, handler):
+        pass
+
+    def _on_sync(self, msg):
+        self._seen_updates[msg.sender] = msg.params
+
+    def _on_finish(self, msg):
+        self._seen_updates.clear()

@@ -1,0 +1,16 @@
+"""M002 good: the cache is bounded by construction (Bounded* ctor)."""
+
+
+class GoodCacheManager:
+    def __init__(self):
+        self._jit_cache = BoundedDict(8)
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler("train", self._on_train)
+
+    def register_message_receive_handler(self, msg_type, handler):
+        pass
+
+    def _on_train(self, msg):
+        if msg.shape not in self._jit_cache:
+            self._jit_cache[msg.shape] = object()

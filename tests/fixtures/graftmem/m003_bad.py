@@ -1,0 +1,12 @@
+"""M003 bad: sender id interpolated into a metric name."""
+
+
+class BadMetricsManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler("sync", self._on_sync)
+
+    def register_message_receive_handler(self, msg_type, handler):
+        pass
+
+    def _on_sync(self, msg):
+        self.telemetry.counter_inc(f"edge.{msg.sender}.folds")

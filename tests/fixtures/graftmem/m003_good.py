@@ -1,0 +1,13 @@
+"""M003 good: fixed metric vocabulary; the id rides as a value."""
+
+
+class GoodMetricsManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler("sync", self._on_sync)
+
+    def register_message_receive_handler(self, msg_type, handler):
+        pass
+
+    def _on_sync(self, msg):
+        self.telemetry.counter_inc("edge.folds")
+        self.telemetry.gauge_set("edge.last_sender", float(msg.sender))

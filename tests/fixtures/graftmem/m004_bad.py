@@ -1,0 +1,15 @@
+"""M004 bad: parked work with no drain reachable from shutdown."""
+
+
+class BadParkingManager:
+    def __init__(self):
+        self._pending_pulls = set()
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler("pull", self._on_pull)
+
+    def register_message_receive_handler(self, msg_type, handler):
+        pass
+
+    def _on_pull(self, msg):
+        self._pending_pulls.add(msg.sender)

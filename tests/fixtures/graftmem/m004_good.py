@@ -1,0 +1,18 @@
+"""M004 good: the parking set drains from the finish path."""
+
+
+class GoodParkingManager:
+    def __init__(self):
+        self._pending_pulls = set()
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler("pull", self._on_pull)
+
+    def register_message_receive_handler(self, msg_type, handler):
+        pass
+
+    def _on_pull(self, msg):
+        self._pending_pulls.add(msg.sender)
+
+    def finish(self):
+        self._pending_pulls.clear()

@@ -1,0 +1,15 @@
+"""M005 bad: the decoded Message payload is retained with no release."""
+
+
+class BadRetainManager:
+    def __init__(self):
+        self._last_model_msg: Optional[Message] = None
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler("model", self._on_model)
+
+    def register_message_receive_handler(self, msg_type, handler):
+        pass
+
+    def _on_model(self, msg):
+        self._last_model_msg = msg

@@ -1,0 +1,19 @@
+"""M005 good: the retained payload is released on the finish path."""
+
+
+class GoodRetainManager:
+    def __init__(self):
+        self._last_model_msg: Optional[Message] = None
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler("model", self._on_model)
+        self.register_message_receive_handler("finish", self._on_finish)
+
+    def register_message_receive_handler(self, msg_type, handler):
+        pass
+
+    def _on_model(self, msg):
+        self._last_model_msg = msg
+
+    def _on_finish(self, msg):
+        self._last_model_msg = None

@@ -1,0 +1,5 @@
+"""graftmem — static unbounded-state & retention verification of the
+serving plane (M001–M005), sixth suite on the shared graftlint driver.
+
+``python -m tools.graftmem [paths...]`` — see docs/graftmem.md.
+"""

@@ -1,0 +1,66 @@
+"""graftmem entry: scan → graftlint facts → retention model → M-rules →
+pragmas.
+
+Mirrors :func:`tools.graftiso.analyzer.analyze_paths`, with graftmem's own
+pragma marker (``# graftmem: disable=M001``) and baseline file
+(``tools/graftmem/baseline.json``). The whole pass is pure AST — no import
+of the analyzed code, no jax — so the tree gate stays sub-second. The
+runtime witness for the same contract is ``fedml_tpu swarm --leak_check``
+(RSS steady-state slope + ``mem.*`` occupancy gauges).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..graftlint.analyzer import Analyzer, collect_files, load_modules
+from ..graftlint.baseline import find_repo_root
+from ..graftlint.pragmas import is_suppressed, parse_pragmas
+from .findings import Finding
+from .model import RetentionModel, build_model
+from .rules import check_retention
+
+PRAGMA_TOOL = "graftmem"
+DEFAULT_BASELINE_RELPATH = os.path.join("tools", "graftmem", "baseline.json")
+
+
+def default_baseline_path(repo_root: str) -> str:
+    return os.path.join(repo_root, DEFAULT_BASELINE_RELPATH)
+
+
+def analyze_paths_with_model(
+    paths: Sequence[str], repo_root: Optional[str] = None
+) -> Tuple[List[Finding], RetentionModel]:
+    """Analyze files/dirs → (pragma-filtered findings, retention model).
+
+    The baseline is NOT applied here — that's the CLI/caller's job, like
+    the sibling suites.
+    """
+    if repo_root is None:
+        repo_root = find_repo_root(paths[0] if paths else os.getcwd())
+    files = collect_files(paths)
+    modules = load_modules(files, repo_root)
+    lint = Analyzer(modules)
+    lint.compute_facts()
+    model = build_model(modules, lint)
+    findings = check_retention(modules, lint, model)
+
+    out: List[Finding] = []
+    pragma_cache: Dict[str, Dict] = {}
+    mods_by_rel = {m.rel: m for m in modules.values()}
+    for f in findings:
+        mod = mods_by_rel.get(f.path)
+        if mod is not None:
+            pragmas = pragma_cache.setdefault(
+                f.path, parse_pragmas(mod.source, tool=PRAGMA_TOOL))
+            if is_suppressed(pragmas, f.rule, f.line):
+                continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out, model
+
+
+def analyze_paths(paths: Sequence[str],
+                  repo_root: Optional[str] = None) -> List[Finding]:
+    return analyze_paths_with_model(paths, repo_root)[0]

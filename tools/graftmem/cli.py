@@ -1,0 +1,60 @@
+"""graftmem CLI: ``python -m tools.graftmem [paths...]``.
+
+Thin suite definition over the shared driver
+(:mod:`tools.graftlint.clikit` — flags, baseline handling, rendering, and
+the exit-code contract live there, shared with the five sibling suites).
+Exit codes: 0 clean (after baseline + pragmas), 1 findings, 2 usage error
+OR analyzer crash.
+
+The default (and only) pass is pure AST — graftmem's runtime mode lives
+in the swarm harness instead: ``fedml_tpu swarm --leak_check`` samples
+RSS + the ``mem.*`` occupancy gauges across a soak and fails on a
+positive steady-state slope (docs/graftmem.md), so the static rules and
+the runtime gate pin each other.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Dict, List, Optional, Tuple
+
+from ..graftlint import clikit
+from ..graftlint.findings import Finding
+from .analyzer import DEFAULT_BASELINE_RELPATH, analyze_paths_with_model
+from .findings import MEM_RULES
+
+
+def _analyze(args: argparse.Namespace,
+             repo_root: str) -> Tuple[List[Finding], Dict]:
+    findings, model = analyze_paths_with_model(args.paths,
+                                               repo_root=repo_root)
+    extra: Dict = {
+        "mem": {
+            "classes": sorted(f"{m}.{c}"
+                              for m, c in model.analyzed_classes),
+            "helpers": sorted(f"{m}.{c}"
+                              for m, c in model.helper_classes),
+            "containers": len(model.containers),
+            "closure_size": len(model.serving.closure),
+        },
+    }
+    return findings, extra
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    return clikit.run_suite(
+        argv,
+        tool="graftmem",
+        description="static unbounded-state & retention verification of "
+                    "the serving plane: keyed growth without eviction, "
+                    "capacity-less caches, telemetry cardinality "
+                    "explosions, undrained parking containers, payload "
+                    "retention past commit",
+        rules=MEM_RULES,
+        analyze=_analyze,
+        baseline_relpath=DEFAULT_BASELINE_RELPATH,
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

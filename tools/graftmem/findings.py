@@ -1,0 +1,62 @@
+"""graftmem rule registry (M001–M005), merged into the shared graftlint
+Finding infrastructure so all six suites render/baseline/JSON identically.
+
+The M-rules statically enforce the serving plane's memory contract — the
+prerequisite for multi-tenant serving and the 50k–100k device soak
+(ROADMAP): every piece of state a handler/worker can grow must be
+provably bounded (capacity ring, clear-on-commit, TTL/LRU eviction) or
+released when the lifecycle that needed it ends. The runtime witness is
+``fedml_tpu swarm --leak_check`` (RSS steady-state slope + ``mem.*``
+occupancy gauges) — docs/graftmem.md pins the two ends together.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from ..graftlint.findings import Finding, register_rules
+
+# rule id -> (title, autofix hint)
+MEM_RULES: Dict[str, Tuple[str, str]] = {
+    "M001": (
+        "unbounded-keyed-growth",
+        "bound the container: BoundedDict/deque(maxlen=...) with a "
+        "generous capacity, a ring check (while len > capacity: del "
+        "oldest), clear-on-commit/finish for per-round state, or clamp "
+        "the key into a finite domain (min(k, CAP)) — a dict keyed by "
+        "sender/round data with no eviction is a slow OOM at a million "
+        "clients",
+    ),
+    "M002": (
+        "capacity-less-cache",
+        "give the cache a size bound (BoundedDict(capacity), LRU, or an "
+        "explicit ring sweep): memo/negative caches keyed by data grow "
+        "with the key domain, and a compile/encode cache that never "
+        "evicts pins every variant it ever saw",
+    ),
+    "M003": (
+        "telemetry-cardinality-explosion",
+        "keep metric NAMES to a fixed vocabulary and carry the variable "
+        "as the value (or a clamped bucket): interpolating a client/"
+        "round/version id into the name grows the process-wide registry "
+        "by one series per distinct id, forever",
+    ),
+    "M004": (
+        "undrained-parking",
+        "drain parked/pending/deferred containers from a shutdown/finish/"
+        "resync-reachable method (.clear() in the close path, or pop on "
+        "lease expiry): parked entries that only drain on the happy path "
+        "survive the federation that parked them",
+    ),
+    "M005": (
+        "payload-retention-past-commit",
+        "release message/payload references when their round commits or "
+        "the federation finishes (self.attr = None in the finish/commit "
+        "path): a retained decoded frame pins the whole payload buffer "
+        "for the life of the manager",
+    ),
+}
+
+register_rules(MEM_RULES)
+
+__all__ = ["Finding", "MEM_RULES"]

@@ -1,0 +1,504 @@
+"""Retention-model extraction for the M-rules.
+
+Builds on graftiso's :class:`~tools.graftiso.model.ServingModel` (serving
+classes + the handler/worker closure) and adds the three facts the memory
+rules need:
+
+1. **The analyzed universe.** Serving-class families (writes scoped to
+   the handler closure) PLUS *world-root* classes (``*World*``/``*Scope``
+   — graftiso's sanctioned state owners must have bounded state too) PLUS
+   *serving-helper* classes, to a fixpoint: any scanned class that an
+   analyzed class (a) constructs and binds to ``self.attr``, (b) obtains
+   from a module factory whose body constructs it
+   (``self.trace = tracing.tracer_for(...)`` → ``Tracer``), or (c)
+   constructs locally and passes into an analyzed class's constructor
+   (``trainer = TrainerDistAdapter(...); ClientMasterManager(args,
+   trainer)``). Helper methods are analyzed in full — they run on behalf
+   of handler code the closure can't see across the module boundary.
+2. **Container inventory.** Per analyzed family: every mutable container
+   attr (``self.x = {}``/``[]``/``set()``/``deque()``/ctor), whether it
+   is *bounded by construction* (``deque(maxlen=...)``, a
+   ``Bounded*``/``LRU*``/``Ring*``/``TTL*``-named ctor), and its
+   annotation text (the M005 ``Message`` signal).
+3. **Lifecycle facts**, computed lazily per (family, attr): eviction
+   sites (``.pop/.popitem/.clear/.remove/.discard/.popleft``,
+   ``del self.x[...]``, reassignment to a fresh empty container outside
+   ``__init__`` — including the tuple-unpack drain idiom
+   ``entries, self._entries = self._entries, []``), release sites
+   (``self.x = None``), and whether a site's method is reachable from a
+   shutdown/finish/resync-named method over family self-calls.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..graftlint.analyzer import (
+    Analyzer,
+    FuncInfo,
+    ModuleInfo,
+    _walk_shallow,
+    dotted,
+)
+from ..graftiso.model import (
+    CONTAINER_CTORS,
+    SHUTDOWN_TOKENS,
+    ServingModel,
+    build_model as build_serving_model,
+)
+
+# ctor-name tokens that make a container bounded by construction
+BOUNDED_CTOR_TOKENS = ("bounded", "lru", "ring", "ttl")
+
+# world-root classes join the analyzed universe: graftiso sanctions them
+# as state owners, so their state is exactly what must stay bounded
+WORLD_ROOT_TOKENS = ("World", "Scope")
+
+# methods that shrink a container
+EVICT_METHODS = {"pop", "popitem", "clear", "remove", "discard", "popleft"}
+
+# method-name tokens rooting the drain-reachability BFS (M004): the
+# shutdown family plus the lifecycle edges the serving plane drains on
+DRAIN_ROOT_TOKENS = SHUTDOWN_TOKENS + ("finish", "resync", "drain",
+                                       "flush", "commit", "reset")
+
+_DICT_CTORS = {"dict", "defaultdict", "OrderedDict", "Counter"}
+
+
+def container_kind(v: ast.expr) -> Optional[Tuple[str, bool]]:
+    """``(kind, bounded_by_construction)`` for a container-valued expr."""
+    if isinstance(v, (ast.Dict, ast.DictComp)):
+        return ("dict", False)
+    if isinstance(v, (ast.List, ast.ListComp)):
+        return ("list", False)
+    if isinstance(v, (ast.Set, ast.SetComp)):
+        return ("set", False)
+    if isinstance(v, ast.Call):
+        ds = dotted(v.func)
+        if not ds:
+            return None
+        tail = ds.split(".")[-1]
+        if tail in _DICT_CTORS:
+            return ("dict", False)
+        if tail == "list":
+            return ("list", False)
+        if tail == "set":
+            return ("set", False)
+        if tail == "deque":
+            bounded = any(kw.arg == "maxlen" and not (
+                isinstance(kw.value, ast.Constant) and kw.value.value is None)
+                for kw in v.keywords)
+            if not bounded and len(v.args) >= 2:
+                bounded = True  # deque(iterable, maxlen)
+            return ("deque", bounded)
+        if tail[:1].isupper() and any(
+                tok in tail.lower() for tok in BOUNDED_CTOR_TOKENS):
+            return ("dict", True)
+    return None
+
+
+@dataclasses.dataclass
+class ContainerInfo:
+    module: str          # defining module name
+    cls: str             # defining class name
+    attr: str
+    line: int
+    kind: str            # "dict" | "list" | "set" | "deque"
+    bounded: bool        # bounded by construction
+    annotation: str = ""  # AnnAssign annotation text, "" when absent
+
+
+@dataclasses.dataclass
+class LifecycleFacts:
+    """Eviction/release facts for one (family, attr), family-wide."""
+    evict_sites: List[FuncInfo] = dataclasses.field(default_factory=list)
+    release_sites: List[FuncInfo] = dataclasses.field(default_factory=list)
+
+    @property
+    def has_eviction(self) -> bool:
+        return bool(self.evict_sites)
+
+    @property
+    def has_release(self) -> bool:
+        return bool(self.release_sites)
+
+
+def _self_attr(e: ast.expr) -> Optional[str]:
+    if (isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name)
+            and e.value.id == "self"):
+        return e.attr
+    return None
+
+
+def subscript_base_attr(t: ast.expr) -> Tuple[Optional[str], List[ast.expr]]:
+    """``self.a["x"][k]`` → ("a", [key exprs outer→inner]); (None, [])
+    when the base is not a self attr."""
+    keys: List[ast.expr] = []
+    while isinstance(t, ast.Subscript):
+        keys.append(t.slice)
+        t = t.value
+    return _self_attr(t), keys
+
+
+def _empty_container(v: ast.expr) -> bool:
+    if isinstance(v, (ast.Dict, ast.List, ast.Set)):
+        return not getattr(v, "keys", None) and not getattr(v, "elts", None)
+    ck = container_kind(v)
+    if ck is None:
+        return False
+    if isinstance(v, ast.Call) and not v.args:
+        return True
+    return False
+
+
+class RetentionModel:
+    def __init__(self, modules: Dict[str, ModuleInfo], lint: Analyzer,
+                 serving: ServingModel):
+        self.modules = modules
+        self.lint = lint
+        self.serving = serving
+        # (module, class) of every class whose state the M-rules police
+        self.analyzed_classes: Set[Tuple[str, str]] = set()
+        self.helper_classes: Set[Tuple[str, str]] = set()
+        # (module, class, attr) -> ContainerInfo, keyed by defining class
+        self.containers: Dict[Tuple[str, str, str], ContainerInfo] = {}
+        self._facts_cache: Dict[Tuple[str, str, str], LifecycleFacts] = {}
+        self._drain_cache: Dict[Tuple[str, str], Set[int]] = {}
+        self._build()
+
+    # -- universe ------------------------------------------------------------
+
+    def _build(self) -> None:
+        work: Set[Tuple[str, str]] = set(self.serving.serving_classes)
+        for mod in self.modules.values():
+            for cls in mod.classes:
+                if any(tok in cls for tok in WORLD_ROOT_TOKENS):
+                    for fam in self.serving.family(mod.name, cls):
+                        work.add(fam)
+        self.analyzed_classes = set(work)
+        # helper fixpoint
+        while True:
+            new = self._expand_helpers() - self.analyzed_classes
+            if not new:
+                break
+            self.analyzed_classes |= new
+            self.helper_classes |= new
+        self._inventory_containers()
+
+    def _resolve_class_name(self, mod: ModuleInfo,
+                            name: str) -> Optional[Tuple[str, str]]:
+        if name in mod.classes:
+            return (mod.name, name)
+        fi = mod.from_imports.get(name)
+        if fi:
+            target = self.modules.get(fi[0])
+            if target and fi[1] in target.classes:
+                return (fi[0], fi[1])
+            # re-export hop (package __init__)
+            resolved = self.serving._follow_export(fi[0], fi[1])
+            if resolved is not None:
+                return resolved
+        return None
+
+    def _ctor_class(self, mod: ModuleInfo,
+                    call: ast.Call) -> Optional[Tuple[str, str]]:
+        """The scanned class a ``Ctor(...)`` call constructs, if any."""
+        ds = dotted(call.func)
+        if not ds:
+            return None
+        parts = ds.split(".")
+        if len(parts) == 1:
+            return self._resolve_class_name(mod, parts[0])
+        tgt = mod.imports.get(parts[0])
+        if tgt and tgt in self.modules and len(parts) == 2:
+            target = self.modules[tgt]
+            if parts[1] in target.classes:
+                return (tgt, parts[1])
+        return None
+
+    def _factory_classes(self, mod: ModuleInfo, fi: Optional[FuncInfo],
+                         call: ast.Call) -> List[Tuple[str, str]]:
+        """Classes constructed inside a resolvable factory call's body
+        (``tracing.tracer_for(...)`` → ``Tracer``)."""
+        targets: List[FuncInfo] = []
+        func = call.func
+        if isinstance(func, ast.Name):
+            targets = self.lint.resolve_name(mod, fi, func.id)
+        elif isinstance(func, ast.Attribute) and isinstance(
+                func.value, ast.Name):
+            tgt = mod.imports.get(func.value.id)
+            if tgt is None and func.value.id in mod.from_imports:
+                b, orig = mod.from_imports[func.value.id]
+                full = f"{b}.{orig}" if b else orig
+                tgt = full if full in self.modules else None
+            if tgt and tgt in self.modules:
+                target = self.modules[tgt]
+                if func.attr in target.toplevel:
+                    targets = [target.toplevel[func.attr]]
+        out: List[Tuple[str, str]] = []
+        for tf in targets:
+            for node in _walk_shallow(tf.node):
+                if isinstance(node, ast.Call):
+                    c = self._ctor_class(tf.module, node)
+                    if c is not None:
+                        out.append(c)
+        return out
+
+    def _expand_helpers(self) -> Set[Tuple[str, str]]:
+        found: Set[Tuple[str, str]] = set()
+        for mod_name, cls in list(self.analyzed_classes):
+            mod = self.modules.get(mod_name)
+            if mod is None:
+                continue
+            for fi in mod.classes.get(cls, {}).values():
+                found |= self._helper_edges(mod, fi)
+        # edge (c): local ctor passed into an analyzed class's constructor,
+        # anywhere in the scanned tree (runner glue lives outside classes)
+        for mod in self.modules.values():
+            for fi in mod.funcs_by_node.values():
+                found |= self._arg_helper_edges(mod, fi)
+        expanded: Set[Tuple[str, str]] = set()
+        for key in found:
+            for fam in self.serving.family(*key):
+                expanded.add(fam)
+        return expanded
+
+    def _helper_edges(self, mod: ModuleInfo,
+                      fi: FuncInfo) -> Set[Tuple[str, str]]:
+        out: Set[Tuple[str, str]] = set()
+        for node in _walk_shallow(fi.node):
+            targets: List[ast.expr] = []
+            value: Optional[ast.expr] = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None or not isinstance(value, ast.Call):
+                continue
+            if not any(_self_attr(t) for t in targets):
+                continue
+            c = self._ctor_class(mod, value)
+            if c is not None:
+                out.add(c)
+                continue
+            for fc in self._factory_classes(mod, fi, value):
+                out.add(fc)
+        return out
+
+    def _arg_helper_edges(self, mod: ModuleInfo,
+                          fi: FuncInfo) -> Set[Tuple[str, str]]:
+        out: Set[Tuple[str, str]] = set()
+        local_ctors: Dict[str, Tuple[str, str]] = {}
+        for node in _walk_shallow(fi.node):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)):
+                c = self._ctor_class(mod, node.value)
+                if c is not None:
+                    local_ctors[node.targets[0].id] = c
+        if not local_ctors:
+            return out
+        for node in _walk_shallow(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            c = self._ctor_class(mod, node)
+            if c is None or c not in self.analyzed_classes:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in local_ctors:
+                    out.add(local_ctors[arg.id])
+        return out
+
+    # -- container inventory -------------------------------------------------
+
+    def _inventory_containers(self) -> None:
+        for mod_name, cls in self.analyzed_classes:
+            mod = self.modules.get(mod_name)
+            if mod is None:
+                continue
+            for fi in mod.classes.get(cls, {}).values():
+                for node in _walk_shallow(fi.node):
+                    targets: List[ast.expr] = []
+                    value: Optional[ast.expr] = None
+                    ann = ""
+                    if isinstance(node, ast.Assign):
+                        targets, value = node.targets, node.value
+                    elif isinstance(node, ast.AnnAssign):
+                        targets, value = [node.target], node.value
+                        try:
+                            ann = ast.unparse(node.annotation)
+                        except Exception:  # pragma: no cover - exotic ann
+                            ann = ""
+                    for t in targets:
+                        attr = _self_attr(t)
+                        if attr is None:
+                            continue
+                        key = (mod_name, cls, attr)
+                        if value is not None:
+                            ck = container_kind(value)
+                            if ck is not None:
+                                prev = self.containers.get(key)
+                                if prev is None:
+                                    self.containers[key] = ContainerInfo(
+                                        mod_name, cls, attr, node.lineno,
+                                        ck[0], ck[1], ann)
+                                elif ck[1]:
+                                    prev.bounded = True
+                                continue
+                        if ann and key not in self.containers \
+                                and "Message" in ann:
+                            # Message-typed attr with a non-container
+                            # initializer (usually None): M005 inventory
+                            self.containers[key] = ContainerInfo(
+                                mod_name, cls, attr, node.lineno,
+                                "ref", False, ann)
+
+    def find_container(self, mod_name: str, cls: str,
+                       attr: str) -> Optional[ContainerInfo]:
+        for m, c in self.serving.family(mod_name, cls):
+            info = self.containers.get((m, c, attr))
+            if info is not None:
+                return info
+        return None
+
+    # -- analyzed functions --------------------------------------------------
+
+    def is_analyzed(self, fi: FuncInfo) -> bool:
+        """Growth-site scope: closure functions of serving classes, every
+        method of helper/world-root classes, plus nested defs thereof."""
+        f = fi
+        while f is not None and f.class_name is None and f.parent is not None:
+            f = f.parent
+        if f is None or f.class_name is None:
+            return fi in self.serving.closure
+        key = (f.module.name, f.class_name)
+        if key not in self.analyzed_classes:
+            return False
+        if key in self.serving.serving_classes:
+            return fi in self.serving.closure or f in self.serving.closure
+        return True
+
+    def family_methods(self, mod_name: str, cls: str) -> List[FuncInfo]:
+        out: List[FuncInfo] = []
+        for m, c in self.serving.family(mod_name, cls):
+            mod = self.modules.get(m)
+            if mod is None:
+                continue
+            out.extend(mod.classes.get(c, {}).values())
+        return out
+
+    # -- lifecycle facts -----------------------------------------------------
+
+    def facts(self, mod_name: str, cls: str, attr: str) -> LifecycleFacts:
+        key = (mod_name, cls, attr)
+        cached = self._facts_cache.get(key)
+        if cached is not None:
+            return cached
+        facts = LifecycleFacts()
+        for fi in self.family_methods(mod_name, cls):
+            if self._method_evicts(fi, attr):
+                facts.evict_sites.append(fi)
+            if self._method_releases(fi, attr):
+                facts.release_sites.append(fi)
+        self._facts_cache[key] = facts
+        return facts
+
+    @staticmethod
+    def _method_evicts(fi: FuncInfo, attr: str) -> bool:
+        is_init = fi.name == "__init__" if hasattr(fi, "name") else False
+        for node in _walk_shallow(fi.node):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (isinstance(f, ast.Attribute)
+                        and f.attr in EVICT_METHODS
+                        and _self_attr(f.value) == attr):
+                    return True
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    base, keys = subscript_base_attr(t)
+                    if keys and base == attr:
+                        return True
+                    if not keys and _self_attr(t) == attr:
+                        return True
+            elif isinstance(node, ast.Assign):
+                # reassignment to a fresh empty container outside __init__
+                # (reset/drain), incl. the tuple-unpack drain idiom
+                for t, v in _assign_pairs(node):
+                    if _self_attr(t) == attr and _empty_container(v) \
+                            and not is_init \
+                            and fi.qualname.rsplit(".", 1)[-1] != "__init__":
+                        return True
+        return False
+
+    @staticmethod
+    def _method_releases(fi: FuncInfo, attr: str) -> bool:
+        for node in _walk_shallow(fi.node):
+            if isinstance(node, ast.Assign):
+                for t, v in _assign_pairs(node):
+                    if (_self_attr(t) == attr
+                            and isinstance(v, ast.Constant)
+                            and v.value is None):
+                        return True
+        return False
+
+    # -- drain reachability (M004) -------------------------------------------
+
+    def drain_reachable(self, mod_name: str, cls: str) -> Set[int]:
+        """ids of FuncInfos reachable from a shutdown/finish/resync-named
+        family method over ``self.*`` calls."""
+        key = (mod_name, cls)
+        cached = self._drain_cache.get(key)
+        if cached is not None:
+            return cached
+        seeds: List[FuncInfo] = []
+        for fi in self.family_methods(mod_name, cls):
+            name = fi.qualname.rsplit(".", 1)[-1]
+            if any(tok in name.lower() for tok in DRAIN_ROOT_TOKENS):
+                seeds.append(fi)
+        seen: Set[int] = set()
+        out: List[FuncInfo] = []
+        work = list(seeds)
+        while work:
+            fi = work.pop()
+            if id(fi) in seen:
+                continue
+            seen.add(id(fi))
+            out.append(fi)
+            work.extend(fi.nested.values())
+            for node in _walk_shallow(fi.node):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"):
+                    t = self.serving.family_method(mod_name, cls,
+                                                   node.func.attr)
+                    if t is not None:
+                        work.append(t)
+        self._drain_cache[key] = seen
+        return seen
+
+    def drains_on_shutdown(self, mod_name: str, cls: str,
+                           attr: str) -> bool:
+        reachable = self.drain_reachable(mod_name, cls)
+        return any(id(fi) in reachable
+                   for fi in self.facts(mod_name, cls, attr).evict_sites)
+
+
+def _assign_pairs(node: ast.Assign) -> List[Tuple[ast.expr, ast.expr]]:
+    """(target, value) pairs, unzipping parallel tuple assignment."""
+    out: List[Tuple[ast.expr, ast.expr]] = []
+    for t in node.targets:
+        if (isinstance(t, ast.Tuple) and isinstance(node.value, ast.Tuple)
+                and len(t.elts) == len(node.value.elts)):
+            out.extend(zip(t.elts, node.value.elts))
+        else:
+            out.append((t, node.value))
+    return out
+
+
+def build_model(modules: Dict[str, ModuleInfo],
+                lint: Analyzer) -> RetentionModel:
+    serving = build_serving_model(modules, lint)
+    return RetentionModel(modules, lint, serving)

@@ -1,0 +1,431 @@
+"""Rule checkers M001–M005 over the :class:`~tools.graftmem.model.RetentionModel`.
+
+The M-rules statically enforce the serving plane's memory contract
+(docs/graftmem.md):
+
+- **M001** unbounded keyed growth: a container attr written from
+  handler/worker/helper code with a key (or appended value) derived from
+  message/sender/round data — sender ids, round indices, versions, peer
+  ranks — and no reachable eviction in the owning family.
+- **M002** capacity-less cache: memo/negative-cache attrs
+  (``*cache*``/``*memo*``/``*jit*``/``*compiled*``) with no size bound
+  and no eviction.
+- **M003** telemetry cardinality explosion: message/round-derived values
+  interpolated into metric NAMES (f-string/``%``/``+``/``.format``), one
+  registry series per distinct id, forever.
+- **M004** undrained parking: parked/pending/deferred containers whose
+  drain is not reachable from a shutdown/finish/resync-named method —
+  happy-path-only drains survive the federation that parked them.
+- **M005** payload retention past commit: ``Message``-typed attrs (or
+  attrs assigned a constructed ``Message``) with no release site
+  (``self.attr = None``) in the owning family.
+
+Accepted boundedness idioms (the dogfooded tree uses all of them, see
+docs/graftmem.md): ``deque(maxlen=...)``; ``Bounded*``/``LRU*``/
+``Ring*``/``TTL*``-named ctors; a ring check (``while len(...) >
+capacity: del self.x[oldest]``); ``.pop/.discard`` lifecycle eviction;
+clear-on-commit/finish (``.clear()`` or reassignment to a fresh empty
+container outside ``__init__``, including the tuple-unpack drain
+``entries, self._entries = self._entries, []``); and clamped keys
+(``min(k, CAP)``-shaped — a finite key domain needs no eviction).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..graftlint.analyzer import (
+    Analyzer,
+    FuncInfo,
+    ModuleInfo,
+    _walk_shallow,
+    dotted,
+)
+from .findings import Finding
+from .model import (
+    RetentionModel,
+    _assign_pairs,
+    _self_attr,
+    subscript_base_attr,
+)
+
+# identifier/string tokens marking a value as message/sender/round-derived
+TAINT_TOKENS = ("sender", "client", "round", "version", "peer", "rank",
+                "edge", "msg", "message", "seq", "stalen", "epoch",
+                "tenant", "uuid")
+
+# attr-name tokens marking a memoization/negative cache (M002)
+CACHE_TOKENS = ("cache", "memo", "jit", "compiled", "interned")
+
+# attr-name tokens marking a parking container (M004)
+PARKING_TOKENS = ("pending", "parked", "defer", "inflight", "backlog",
+                  "unsent", "queued", "waiting")
+
+# call-name tails that create/update a telemetry series (M003)
+TELEMETRY_TAILS = {"counter_inc", "gauge_set", "observe", "inc"}
+
+# growth mutators and the argument that acts as the key/value
+_KEYED_MUTATORS = {"setdefault": 0, "add": 0}
+_VALUE_MUTATORS = {"append": 0, "appendleft": 0, "extend": 0, "update": 0}
+
+
+def _mk(mod: ModuleInfo, rule: str, line: int, col: int,
+        message: str) -> Finding:
+    return Finding(rule=rule, path=mod.rel, line=line, col=col,
+                   message=message, line_text=mod.line_text(line))
+
+
+def _local_aliases(fi: FuncInfo) -> Dict[str, ast.expr]:
+    """local name -> last assigned value expr (one-level resolution)."""
+    out: Dict[str, ast.expr] = {}
+    for node in _walk_shallow(fi.node):
+        if isinstance(node, ast.Assign):
+            for t, v in _assign_pairs(node):
+                if isinstance(t, ast.Name):
+                    out[t.id] = v
+    return out
+
+
+def _is_clamp_call(node: ast.Call) -> bool:
+    ds = dotted(node.func) or ""
+    tail = ds.split(".")[-1].lower()
+    if tail == "min" and len(node.args) >= 2:
+        return True
+    return "clamp" in tail or "bucket" in tail
+
+
+def _token_match(text: str) -> bool:
+    low = text.lower()
+    return any(tok in low for tok in TAINT_TOKENS)
+
+
+def tainted(expr: ast.expr, aliases: Dict[str, ast.expr],
+            depth: int = 0) -> bool:
+    """The expr carries message/sender/round-derived data: a taint-token
+    identifier, attribute, or string constant — unless the whole value is
+    clamped into a finite domain (``min(k, CAP)``/``*clamp*``/``*bucket*``
+    call)."""
+    if depth > 3:
+        return False
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and _is_clamp_call(node):
+            return False
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name):
+            if _token_match(node.id):
+                return True
+            alias = aliases.get(node.id)
+            if alias is not None and alias is not expr:
+                if tainted(alias, aliases, depth + 1):
+                    return True
+        elif isinstance(node, ast.Attribute) and _token_match(node.attr):
+            return True
+        elif (isinstance(node, ast.Constant)
+              and isinstance(node.value, str) and _token_match(node.value)):
+            return True
+    return False
+
+
+class _WriteSite:
+    __slots__ = ("mod", "fi", "line", "col", "attr", "keys", "via")
+
+    def __init__(self, mod: ModuleInfo, fi: FuncInfo, line: int, col: int,
+                 attr: str, keys: List[ast.expr], via: str):
+        self.mod = mod
+        self.fi = fi
+        self.line = line
+        self.col = col
+        self.attr = attr
+        self.keys = keys
+        self.via = via
+
+
+def _collect_writes(mod: ModuleInfo, fi: FuncInfo) -> List[_WriteSite]:
+    """Growth writes to ``self.*`` containers in one function."""
+    out: List[_WriteSite] = []
+    for node in _walk_shallow(fi.node):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Tuple):
+                    continue  # parallel assignment: drain idiom, not growth
+                base, keys = subscript_base_attr(t)
+                if base is not None and keys:
+                    out.append(_WriteSite(mod, fi, node.lineno,
+                                          node.col_offset, base, keys,
+                                          "subscript write"))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            attr = _self_attr(f.value)
+            if attr is None:
+                continue
+            if f.attr in _KEYED_MUTATORS and node.args:
+                out.append(_WriteSite(mod, fi, node.lineno,
+                                      node.col_offset, attr,
+                                      [node.args[_KEYED_MUTATORS[f.attr]]],
+                                      f".{f.attr}(...)"))
+            elif f.attr in _VALUE_MUTATORS and node.args:
+                out.append(_WriteSite(mod, fi, node.lineno,
+                                      node.col_offset, attr,
+                                      list(node.args),
+                                      f".{f.attr}(...)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# M001 / M002 / M004 — container growth vs. eviction
+# ---------------------------------------------------------------------------
+
+
+def _is_cache_attr(attr: str) -> bool:
+    return any(tok in attr.lower() for tok in CACHE_TOKENS)
+
+
+def _is_parking_attr(attr: str) -> bool:
+    return any(tok in attr.lower() for tok in PARKING_TOKENS)
+
+
+def _family_has_write(model: RetentionModel, mod_name: str, cls: str,
+                      attr: str) -> Optional[_WriteSite]:
+    for fi in model.family_methods(mod_name, cls):
+        if fi.qualname.rsplit(".", 1)[-1] == "__init__":
+            continue
+        for w in _collect_writes(fi.module, fi):
+            if w.attr == attr:
+                return w
+    return None
+
+
+def check_growth(model: RetentionModel) -> List[Finding]:
+    """M001/M002/M004 in one pass so each attr yields ONE finding, the
+    most specific rule first (cache > parking > keyed growth)."""
+    findings: List[Finding] = []
+    claimed: Set[Tuple[str, str, str]] = set()
+
+    # M002: definition-driven — a cache-named container must be bounded
+    for (mod_name, cls, attr), info in sorted(model.containers.items()):
+        if info.kind == "ref" or not _is_cache_attr(attr):
+            continue
+        if info.bounded:
+            continue
+        facts = model.facts(mod_name, cls, attr)
+        if facts.has_eviction:
+            continue
+        w = _family_has_write(model, mod_name, cls, attr)
+        if w is None:
+            continue
+        claimed.add((mod_name, cls, attr))
+        mod = model.modules[mod_name]
+        findings.append(_mk(
+            mod, "M002", info.line, 0,
+            f"cache `{cls}.{attr}` has no size bound and no eviction — "
+            f"it is written in `{w.fi.qualname}` and keeps every variant "
+            "it ever saw; give it a capacity (BoundedDict/LRU/ring "
+            "sweep)"))
+
+    # M004: parking-named containers must drain from the shutdown path
+    for (mod_name, cls, attr), info in sorted(model.containers.items()):
+        key = (mod_name, cls, attr)
+        if key in claimed or info.kind == "ref":
+            continue
+        if not _is_parking_attr(attr) or info.bounded:
+            continue
+        w = _family_has_write(model, mod_name, cls, attr)
+        if w is None:
+            continue
+        if model.drains_on_shutdown(mod_name, cls, attr):
+            continue
+        claimed.add(key)
+        mod = model.modules[mod_name]
+        facts = model.facts(mod_name, cls, attr)
+        how = ("its only drains are happy-path" if facts.has_eviction
+               else "it is never drained at all")
+        findings.append(_mk(
+            mod, "M004", info.line, 0,
+            f"parking container `{cls}.{attr}` — {how}: no drain is "
+            "reachable from a shutdown/finish/resync method, so parked "
+            "entries survive the federation that parked them; clear it "
+            "in the close/finish path"))
+
+    # M001: tainted-key growth without eviction, write-site driven
+    reported: Set[Tuple[str, str, str]] = set()
+    for mod in model.modules.values():
+        for fi in mod.funcs_by_node.values():
+            if not model.is_analyzed(fi):
+                continue
+            owner = _owning_class(fi)
+            if owner is None:
+                continue
+            aliases = _local_aliases(fi)
+            for w in _collect_writes(mod, fi):
+                info = model.find_container(owner[0], owner[1], w.attr)
+                if info is None or info.bounded or info.kind == "ref":
+                    continue
+                key = (info.module, info.cls, info.attr)
+                if key in claimed or key in reported:
+                    continue
+                # a bare string-constant key is ONE fixed slot, not a
+                # growth axis (self._stats["folds"] += 1)
+                live_keys = [k for k in w.keys
+                             if not isinstance(k, ast.Constant)]
+                if not any(tainted(k, aliases) for k in live_keys):
+                    continue
+                facts = model.facts(owner[0], owner[1], w.attr)
+                if facts.has_eviction:
+                    continue
+                reported.add(key)
+                findings.append(_mk(
+                    mod, "M001", w.line, w.col,
+                    f"`{info.cls}.{w.attr}` grows by message/round-derived "
+                    f"key via {w.via} in `{fi.qualname}` with no eviction "
+                    "anywhere in the owning family — one entry per "
+                    "distinct sender/round, forever; bound it or clear it "
+                    "on commit"))
+    return findings
+
+
+def _owning_class(fi: FuncInfo) -> Optional[Tuple[str, str]]:
+    f = fi
+    while f is not None and f.class_name is None:
+        f = f.parent
+    if f is None or f.class_name is None:
+        return None
+    return (f.module.name, f.class_name)
+
+
+# ---------------------------------------------------------------------------
+# M003 — telemetry cardinality explosion
+# ---------------------------------------------------------------------------
+
+
+def _dynamic_name_taint(expr: ast.expr,
+                        aliases: Dict[str, ast.expr]) -> Optional[str]:
+    """Why a metric-name expr has unbounded cardinality, or None."""
+    if isinstance(expr, ast.JoinedStr):
+        for v in expr.values:
+            if isinstance(v, ast.FormattedValue) \
+                    and tainted(v.value, aliases):
+                return "f-string interpolates"
+    elif isinstance(expr, ast.BinOp) and isinstance(expr.op,
+                                                    (ast.Add, ast.Mod)):
+        for side in (expr.left, expr.right):
+            if not (isinstance(side, ast.Constant)
+                    and isinstance(side.value, str)) \
+                    and tainted(side, aliases):
+                return "concatenation embeds"
+    elif (isinstance(expr, ast.Call)
+          and isinstance(expr.func, ast.Attribute)
+          and expr.func.attr == "format"):
+        for a in list(expr.args) + [kw.value for kw in expr.keywords]:
+            if tainted(a, aliases):
+                return ".format() embeds"
+    return None
+
+
+def check_m003(model: RetentionModel) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in model.modules.values():
+        for fi in mod.funcs_by_node.values():
+            if not model.is_analyzed(fi):
+                continue
+            aliases = _local_aliases(fi)
+            for node in _walk_shallow(fi.node):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                ds = dotted(node.func) or ""
+                if ds.split(".")[-1] not in TELEMETRY_TAILS:
+                    continue
+                why = _dynamic_name_taint(node.args[0], aliases)
+                if why is None:
+                    continue
+                findings.append(_mk(
+                    mod, "M003", node.lineno, node.col_offset,
+                    f"metric name {why} a message/round-derived value in "
+                    f"`{fi.qualname}` — the registry grows one series per "
+                    "distinct id; keep names to a fixed vocabulary and "
+                    "carry the id as a value or clamped bucket"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# M005 — payload retention past commit
+# ---------------------------------------------------------------------------
+
+
+def check_m005(model: RetentionModel) -> List[Finding]:
+    findings: List[Finding] = []
+    # annotation-declared Message attrs: a plain/Optional Message
+    # reference, NOT a container OF handlers (Dict[str, MessageHandler])
+    retaining: Dict[Tuple[str, str, str], Tuple[ModuleInfo, int]] = {}
+    for (mod_name, cls, attr), info in model.containers.items():
+        if info.kind != "ref":
+            continue
+        if re.search(r"\bMessage\b", info.annotation or ""):
+            retaining[(mod_name, cls, attr)] = (
+                model.modules[mod_name], info.line)
+    # write-declared: ``self.attr = Message(...)`` (or a local bound to
+    # one) in analyzed code
+    for mod in model.modules.values():
+        for fi in mod.funcs_by_node.values():
+            if not model.is_analyzed(fi):
+                continue
+            owner = _owning_class(fi)
+            if owner is None:
+                continue
+            aliases = _local_aliases(fi)
+            for node in _walk_shallow(fi.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t, v in _assign_pairs(node):
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    if _constructs_message(v, aliases):
+                        key = (owner[0], owner[1], attr)
+                        if key not in retaining:
+                            retaining[key] = (mod, node.lineno)
+    for (mod_name, cls, attr), (mod, line) in sorted(retaining.items()):
+        facts = model.facts(mod_name, cls, attr)
+        if facts.has_release:
+            continue
+        findings.append(_mk(
+            mod, "M005", line, 0,
+            f"`{cls}.{attr}` retains a Message payload with no release "
+            "site (`self." + attr + " = None`) in the owning family — the "
+            "decoded payload stays live after its round commits; release "
+            "it in the finish/commit path"))
+    return findings
+
+
+def _constructs_message(v: ast.expr, aliases: Dict[str, ast.expr],
+                        depth: int = 0) -> bool:
+    if depth > 2:
+        return False
+    if isinstance(v, ast.Call):
+        ds = dotted(v.func) or ""
+        if ds.split(".")[-1] == "Message":
+            return True
+    if isinstance(v, ast.Name):
+        alias = aliases.get(v.id)
+        if alias is not None and alias is not v:
+            return _constructs_message(alias, aliases, depth + 1)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Entry
+# ---------------------------------------------------------------------------
+
+
+def check_retention(modules: Dict[str, ModuleInfo], lint: Analyzer,
+                    model: RetentionModel) -> List[Finding]:
+    findings: List[Finding] = []
+    findings += check_growth(model)
+    findings += check_m003(model)
+    findings += check_m005(model)
+    return findings

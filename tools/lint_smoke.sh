@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# CI smoke for ALL FIVE static-analysis gates:
+# CI smoke for ALL SIX static-analysis gates:
 #  - graftlint  (G001–G005, JAX trace/donation/recompile/thread safety)
 #  - graftproto (P001–P009, comm-plane protocol + lock-order verification)
 #  - graftshard (S001–S005, sharding/HBM verification of the TPU
@@ -8,18 +8,21 @@
 #                equivalence of the trust pipeline)
 #  - graftiso   (I001–I005, serving-plane state ownership, tenant
 #                isolation & thread lifecycle)
+#  - graftmem   (M001–M005, serving-plane retention: bounded containers,
+#                capped caches, fixed metric vocabularies, drained
+#                parking, released payloads)
 # The shipped tree must have ZERO non-baselined findings in each suite
 # (tools/<suite>/baseline.json holds the suppressed-but-visible debt —
-# graftshard's, graftrep's and graftiso's ship EMPTY), the JSON reports
-# must parse, and each gate must bite on a known-bad fixture.
+# graftshard's, graftrep's, graftiso's and graftmem's ship EMPTY), the
+# JSON reports must parse, and each gate must bite on a known-bad fixture.
 #
 # Exit-code contract (all suites): 0 clean, 1 findings, 2 analyzer crash —
 # a CI failure here is diagnosable at a glance.
 #
 # This is the cheap half of the tier-1 lint gate (tests/test_graftlint.py
 # + test_graftproto.py + test_graftshard.py + test_graftrep.py +
-# test_graftiso.py are the full ones): pure-AST, no jax import,
-# sub-second.
+# test_graftiso.py + test_graftmem.py are the full ones): pure-AST, no
+# jax import, sub-second.
 #
 # Usage: tools/lint_smoke.sh          (CI: exits non-zero on any regression)
 set -uo pipefail
@@ -203,6 +206,47 @@ fi
 if python -m tools.graftiso tests/fixtures/graftiso/i005_bad.py \
         --no-baseline >/dev/null 2>&1; then
     echo "lint_smoke: FAIL — graftiso passed a known-bad fixture" >&2
+    exit 1
+fi
+
+# ---- graftmem: the retention pass, machine-readable ------------------------
+mem_out=$(timeout -k 10 120 python -m tools.graftmem fedml_tpu/ --json)
+rc=$?
+
+if [ "$rc" -ne 0 ]; then
+    echo "lint_smoke: FAIL — graftmem exited rc=$rc" >&2
+    printf '%s\n' "$mem_out" >&2
+    exit 1
+fi
+
+python - "$mem_out" <<'EOF'
+import json
+import sys
+
+payload = json.loads(sys.argv[1])
+assert payload["exit_code"] == 0, payload
+assert payload["findings"] == [], payload["findings"]
+# graftmem's baseline must stay EMPTY: every piece of serving-plane state
+# is bounded/drained/released, debt is fixed not suppressed
+assert payload["baselined"] == 0, payload
+# the retention model must actually have seen the plane — an empty
+# container inventory would mean the gate silently analyzed nothing
+mem = payload["mem"]
+assert mem["classes"], "no analyzed classes found"
+assert mem["containers"] > 0, mem
+print(f"lint_smoke: graftmem OK — 0 findings (baseline empty, "
+      f"{len(mem['classes'])} analyzed classes, "
+      f"{mem['containers']} containers)")
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "lint_smoke: FAIL — graftmem JSON output did not validate" >&2
+    exit 1
+fi
+
+if python -m tools.graftmem tests/fixtures/graftmem/m001_bad.py \
+        --no-baseline >/dev/null 2>&1; then
+    echo "lint_smoke: FAIL — graftmem passed a known-bad fixture" >&2
     exit 1
 fi
 

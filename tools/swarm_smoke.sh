@@ -25,7 +25,15 @@
 #     the trace's Σ(fold + queue_wait) must reconcile with the
 #     traffic.dispatch_ready_s histogram sum within 5% — two instruments,
 #     one truth.
-#  leg 6 (edge tier):  --tiers 2 at swarm scale (docs/traffic.md
+#  leg 6 (leak check): a longer loopback soak under --leak_check
+#     (docs/graftmem.md, the static retention suite's runtime half): VmRSS
+#     is sampled across the soak and the report's mem block must show a
+#     NON-positive steady-state slope (≤ the MB/s tolerance) — a retention
+#     bug (one entry per sender/round never released) is linear growth
+#     under constant load by definition. The per-container mem.* occupancy
+#     gauges must be present and every bounded container at or under its
+#     capacity.
+#  leg 7 (edge tier):  --tiers 2 at swarm scale (docs/traffic.md
 #     "Hierarchical edge tier"): ~200 devices homed onto 2 edge
 #     aggregators over REAL multiprocess gRPC. The root must fold ONLY
 #     edge summaries (edge_tier.direct_client_updates == 0 — a nonzero
@@ -208,6 +216,44 @@ print("swarm_smoke: traced-grpc OK —",
 EOF
 [ $? -ne 0 ] && { echo "swarm_smoke: FAIL — traced-grpc verdict" >&2; rm -rf "$trace_dir"; exit 1; }
 rm -rf "$trace_dir"
+
+leak=$(run_leg --clients 32 --steps 24 --buffer 8 --think_s 0.25 \
+    --seed 7 --timeout 200 --leak_check --leak_slope_mb_s 1.0 \
+    --run_id swarm-smoke-leak)
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "swarm_smoke: FAIL — leak-check leg exited rc=$rc" >&2
+    printf '%s\n' "$leak" >&2
+    exit 1
+fi
+
+python - "$leak" <<'EOF'
+import json
+import sys
+
+r = json.loads(sys.argv[1])
+assert r["ok"], r
+assert r["steps_completed"] == r["steps_requested"], r
+m = r["mem"]
+assert m and m["ok"], m
+# the witness measured a real steady state, not a vacuous pass
+assert m["rss_slope_mb_per_s"] is not None, m
+assert m["rss_slope_mb_per_s"] <= m["rss_slope_limit_mb_per_s"], m
+assert m["rss_samples"] >= 8, m
+# the mem.* telemetry family actually flowed: the serving plane's bounded
+# containers published their occupancy
+assert m["containers"], m
+assert "server.committed_clients" in m["containers"], m["containers"]
+occ = m["containers"]["server.committed_clients"]["occupancy"]
+assert occ <= r["clients"], m["containers"]
+print("swarm_smoke: leak-check OK —",
+      f"slope {m['rss_slope_mb_per_s']:+.3f} MB/s",
+      f"(limit {m['rss_slope_limit_mb_per_s']:.1f}),",
+      f"rss {m['rss_start_mb']:.0f}→{m['rss_end_mb']:.0f} MB",
+      f"over {m['rss_samples']} samples,",
+      f"{len(m['containers'])} tracked containers")
+EOF
+[ $? -ne 0 ] && { echo "swarm_smoke: FAIL — leak-check verdict" >&2; exit 1; }
 
 tiered=$(run_leg --clients 200 --steps 4 --buffer 32 --think_s 0.01 \
     --backend grpc --procs 4 --ranks_per_port 50 --port 18974 \
